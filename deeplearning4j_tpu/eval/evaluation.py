@@ -1,0 +1,141 @@
+"""Classification evaluation.
+
+Equivalent of the reference's `eval/Evaluation.java:55,145` — accuracy,
+precision, recall, F1 via a confusion matrix; top-N accuracy; merge-able for
+distributed eval (reference `IEvaluation.merge`). Counts accumulate in host
+numpy — evaluation is not on the hot path.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class ConfusionMatrix:
+    """Dense confusion matrix (reference: `eval/ConfusionMatrix.java`)."""
+
+    def __init__(self, num_classes: int):
+        self.matrix = np.zeros((num_classes, num_classes), np.int64)
+
+    def add(self, actual: int, predicted: int, count: int = 1):
+        self.matrix[actual, predicted] += count
+
+    def get_count(self, actual: int, predicted: int) -> int:
+        return int(self.matrix[actual, predicted])
+
+    def merge(self, other: "ConfusionMatrix"):
+        self.matrix += other.matrix
+
+
+class Evaluation:
+    """Accumulating classification metrics (see module docstring)."""
+
+    def __init__(self, num_classes: Optional[int] = None, top_n: int = 1,
+                 labels: Optional[Sequence[str]] = None):
+        self.num_classes = num_classes
+        self.label_names = list(labels) if labels else None
+        self.top_n = top_n
+        self.confusion: Optional[ConfusionMatrix] = None
+        self.top_n_correct = 0
+        self.total = 0
+
+    def _ensure(self, n: int):
+        if self.confusion is None:
+            self.num_classes = self.num_classes or n
+            self.confusion = ConfusionMatrix(self.num_classes)
+
+    def eval(self, labels, predictions, mask=None):
+        """Accumulate a batch. labels/predictions: [b, c] or [b, t, c]
+        (one-hot labels, probability predictions); mask: [b, t]."""
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim == 3:
+            if mask is not None:
+                keep = np.asarray(mask).reshape(-1) > 0
+            else:
+                keep = np.ones(labels.shape[0] * labels.shape[1], bool)
+            labels = labels.reshape(-1, labels.shape[-1])[keep]
+            predictions = predictions.reshape(-1, predictions.shape[-1])[keep]
+        self._ensure(labels.shape[-1])
+        actual = np.argmax(labels, axis=-1)
+        pred = np.argmax(predictions, axis=-1)
+        for a, p in zip(actual, pred):
+            self.confusion.add(int(a), int(p))
+        self.total += len(actual)
+        if self.top_n > 1:
+            top = np.argsort(-predictions, axis=-1)[:, : self.top_n]
+            self.top_n_correct += int(np.sum(top == actual[:, None]))
+        else:
+            self.top_n_correct += int(np.sum(actual == pred))
+
+    # ------------------------------------------------------------- metrics
+
+    def _tp(self, c) -> int:
+        return self.confusion.get_count(c, c)
+
+    def _fp(self, c) -> int:
+        return int(self.confusion.matrix[:, c].sum() - self._tp(c))
+
+    def _fn(self, c) -> int:
+        return int(self.confusion.matrix[c, :].sum() - self._tp(c))
+
+    def accuracy(self) -> float:
+        if self.total == 0:
+            return 0.0
+        return float(np.trace(self.confusion.matrix)) / self.total
+
+    def top_n_accuracy(self) -> float:
+        return self.top_n_correct / self.total if self.total else 0.0
+
+    def precision(self, cls: Optional[int] = None) -> float:
+        if cls is not None:
+            denom = self._tp(cls) + self._fp(cls)
+            return self._tp(cls) / denom if denom else 0.0
+        vals = [self.precision(c) for c in range(self.num_classes)]
+        return float(np.mean(vals))
+
+    def recall(self, cls: Optional[int] = None) -> float:
+        if cls is not None:
+            denom = self._tp(cls) + self._fn(cls)
+            return self._tp(cls) / denom if denom else 0.0
+        vals = [self.recall(c) for c in range(self.num_classes)]
+        return float(np.mean(vals))
+
+    def f1(self, cls: Optional[int] = None) -> float:
+        p, r = self.precision(cls), self.recall(cls)
+        return 2 * p * r / (p + r) if (p + r) else 0.0
+
+    def false_positive_rate(self, cls: int) -> float:
+        tn = self.total - self._tp(cls) - self._fp(cls) - self._fn(cls)
+        denom = self._fp(cls) + tn
+        return self._fp(cls) / denom if denom else 0.0
+
+    def merge(self, other: "Evaluation"):
+        """Merge another evaluation (distributed eval, reference `IEvaluation.merge`)."""
+        if other.confusion is None:
+            return self
+        if self.confusion is None:
+            self.num_classes = other.num_classes
+            self.confusion = ConfusionMatrix(other.num_classes)
+        self.confusion.merge(other.confusion)
+        self.total += other.total
+        self.top_n_correct += other.top_n_correct
+        return self
+
+    def stats(self) -> str:
+        name = lambda c: (self.label_names[c] if self.label_names else str(c))
+        lines = [
+            "========================Evaluation Metrics========================",
+            f" # of classes:  {self.num_classes}",
+            f" Examples:      {self.total}",
+            f" Accuracy:      {self.accuracy():.4f}",
+            f" Precision:     {self.precision():.4f}",
+            f" Recall:        {self.recall():.4f}",
+            f" F1 Score:      {self.f1():.4f}",
+        ]
+        if self.top_n > 1:
+            lines.append(f" Top-{self.top_n} acc:   {self.top_n_accuracy():.4f}")
+        lines.append("==================================================================")
+        return "\n".join(lines)

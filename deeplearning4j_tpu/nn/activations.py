@@ -1,0 +1,69 @@
+"""Activation function registry.
+
+TPU-native equivalent of ND4J's `IActivation` SPI (referenced from every layer's
+`activation(...)` builder setting; see reference `nn/conf/layers/*` and SURVEY.md
+§2.4). Implemented as pure jax functions so XLA fuses them into the surrounding
+matmul — there is no per-op dispatch as in the reference's op executioner.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.enums import Activation
+
+ActivationFn = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+def _rational_tanh(x):
+    # Rational approximation of tanh (reference: ND4J RationalTanh):
+    # f(x) = 1.7159 * tanh_approx(2x/3), tanh_approx(y) = sign(y) * (1 - 1/(1+|y|+y^2+1.41645*y^4))
+    y = 2.0 * x / 3.0
+    a = jnp.abs(y)
+    approx = jnp.sign(y) * (1.0 - 1.0 / (1.0 + a + y * y + 1.41645 * (y ** 4)))
+    return 1.7159 * approx
+
+
+def _hard_sigmoid(x):
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+_REGISTRY: dict[str, ActivationFn] = {
+    Activation.SIGMOID.value: jax.nn.sigmoid,
+    Activation.TANH.value: jnp.tanh,
+    Activation.SOFTMAX.value: lambda x: jax.nn.softmax(x, axis=-1),
+    Activation.IDENTITY.value: lambda x: x,
+    Activation.RELU.value: jax.nn.relu,
+    Activation.LEAKYRELU.value: lambda x: jax.nn.leaky_relu(x, negative_slope=0.01),
+    Activation.ELU.value: jax.nn.elu,
+    Activation.CUBE.value: lambda x: x ** 3,
+    Activation.SOFTPLUS.value: jax.nn.softplus,
+    Activation.SOFTSIGN.value: jax.nn.soft_sign,
+    Activation.RATIONALTANH.value: _rational_tanh,
+    Activation.RECTIFIEDTANH.value: lambda x: jnp.maximum(jnp.tanh(x), 0.0),
+    Activation.HARDSIGMOID.value: _hard_sigmoid,
+    Activation.HARDTANH.value: jax.nn.hard_tanh,
+    Activation.SELU.value: jax.nn.selu,
+    Activation.GELU.value: jax.nn.gelu,
+    Activation.SWISH.value: jax.nn.swish,
+}
+
+
+def resolve(activation: Union[str, Activation, ActivationFn, None]) -> ActivationFn:
+    """Resolve an activation spec (enum/string/callable) to a jax function."""
+    if activation is None:
+        return _REGISTRY[Activation.IDENTITY.value]
+    if callable(activation) and not isinstance(activation, (str, Activation)):
+        return activation
+    key = activation.value if isinstance(activation, Activation) else str(activation).lower()
+    if key not in _REGISTRY:
+        raise ValueError(f"Unknown activation: {activation!r}. Known: {sorted(_REGISTRY)}")
+    return _REGISTRY[key]
+
+
+def register(name: str, fn: ActivationFn) -> None:
+    """Register a custom activation (reference: custom `IActivation` subtype support)."""
+    _REGISTRY[name.lower()] = fn

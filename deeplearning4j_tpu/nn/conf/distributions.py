@@ -1,0 +1,76 @@
+"""Weight distributions for WeightInit.DISTRIBUTION.
+
+JSON-serializable equivalents of the reference's `nn/conf/distribution/`
+(NormalDistribution, UniformDistribution, BinomialDistribution, GaussianDistribution).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, asdict
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass
+class Distribution:
+    def sample(self, rng, shape, dtype=jnp.float32):
+        raise NotImplementedError
+
+    def to_dict(self):
+        d = asdict(self)
+        d["@dist"] = type(self).__name__
+        return d
+
+    @staticmethod
+    def from_dict(d):
+        if d is None:
+            return None
+        d = dict(d)
+        kind = d.pop("@dist")
+        cls = _DISTRIBUTIONS[kind]
+        return cls(**d)
+
+
+@dataclass
+class NormalDistribution(Distribution):
+    mean: float = 0.0
+    std: float = 1.0
+
+    def sample(self, rng, shape, dtype=jnp.float32):
+        return self.mean + self.std * jax.random.normal(rng, shape, dtype)
+
+
+# Reference treats Gaussian/Normal as synonyms (`nn/conf/distribution/GaussianDistribution`).
+@dataclass
+class GaussianDistribution(NormalDistribution):
+    pass
+
+
+@dataclass
+class UniformDistribution(Distribution):
+    lower: float = -1.0
+    upper: float = 1.0
+
+    def sample(self, rng, shape, dtype=jnp.float32):
+        return jax.random.uniform(rng, shape, dtype, minval=self.lower, maxval=self.upper)
+
+
+@dataclass
+class BinomialDistribution(Distribution):
+    number_of_trials: int = 1
+    probability_of_success: float = 0.5
+
+    def sample(self, rng, shape, dtype=jnp.float32):
+        draws = jax.random.bernoulli(
+            rng, self.probability_of_success, (self.number_of_trials,) + tuple(shape)
+        )
+        return jnp.sum(draws, axis=0).astype(dtype)
+
+
+_DISTRIBUTIONS = {
+    "NormalDistribution": NormalDistribution,
+    "GaussianDistribution": GaussianDistribution,
+    "UniformDistribution": UniformDistribution,
+    "BinomialDistribution": BinomialDistribution,
+}

@@ -1,0 +1,176 @@
+"""Core enumerations of the configuration DSL.
+
+Mirrors the reference's enum surface (activations/losses/updaters/weight-init/
+gradient-normalization/etc.; see reference `nn/conf/`, `nn/weights/WeightInit.java`,
+`nn/conf/GradientNormalization.java`, `nn/api/OptimizationAlgorithm.java`) so a
+DL4J user finds the same vocabulary, but values are plain strings so every config
+JSON round-trips without a JVM.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class _StrEnum(str, enum.Enum):
+    """String-valued enum: JSON-serializes to its value, compares to strings."""
+
+    def __str__(self) -> str:  # pragma: no cover - trivial
+        return self.value
+
+    @classmethod
+    def of(cls, v):
+        if v is None or isinstance(v, cls):
+            return v
+        return cls(str(v).lower())
+
+
+class Activation(_StrEnum):
+    """Activation functions (reference: ND4J `Activation` enum / `IActivation` SPI)."""
+
+    SIGMOID = "sigmoid"
+    TANH = "tanh"
+    SOFTMAX = "softmax"
+    IDENTITY = "identity"
+    RELU = "relu"
+    LEAKYRELU = "leakyrelu"
+    ELU = "elu"
+    CUBE = "cube"
+    SOFTPLUS = "softplus"
+    SOFTSIGN = "softsign"
+    RATIONALTANH = "rationaltanh"
+    RECTIFIEDTANH = "rectifiedtanh"
+    HARDSIGMOID = "hardsigmoid"
+    HARDTANH = "hardtanh"
+    SELU = "selu"
+    GELU = "gelu"
+    SWISH = "swish"
+
+
+class LossFunction(_StrEnum):
+    """Loss functions (reference: ND4J `ILossFunction` impls; SURVEY.md §2.4)."""
+
+    MSE = "mse"
+    L1 = "l1"
+    L2 = "l2"
+    SQUARED_LOSS = "squared_loss"
+    MEAN_ABSOLUTE_ERROR = "mean_absolute_error"
+    MEAN_ABSOLUTE_PERCENTAGE_ERROR = "mean_absolute_percentage_error"
+    MEAN_SQUARED_LOGARITHMIC_ERROR = "mean_squared_logarithmic_error"
+    XENT = "xent"  # binary cross entropy
+    MCXENT = "mcxent"  # multi-class cross entropy
+    NEGATIVELOGLIKELIHOOD = "negativeloglikelihood"
+    RECONSTRUCTION_CROSSENTROPY = "reconstruction_crossentropy"
+    COSINE_PROXIMITY = "cosine_proximity"
+    HINGE = "hinge"
+    SQUARED_HINGE = "squared_hinge"
+    KL_DIVERGENCE = "kl_divergence"
+    POISSON = "poisson"
+    RMSE_XENT = "rmse_xent"
+
+
+class Updater(_StrEnum):
+    """Gradient updaters (reference: `nn/updater/LayerUpdater.java:240-272`)."""
+
+    SGD = "sgd"
+    ADAM = "adam"
+    ADAMAX = "adamax"
+    ADADELTA = "adadelta"
+    NESTEROVS = "nesterovs"
+    ADAGRAD = "adagrad"
+    RMSPROP = "rmsprop"
+    NONE = "none"
+
+
+class WeightInit(_StrEnum):
+    """Weight initialization schemes (reference: `nn/weights/WeightInit.java`)."""
+
+    ZERO = "zero"
+    ONES = "ones"
+    UNIFORM = "uniform"
+    XAVIER = "xavier"
+    XAVIER_UNIFORM = "xavier_uniform"
+    XAVIER_FAN_IN = "xavier_fan_in"
+    XAVIER_LEGACY = "xavier_legacy"
+    RELU = "relu"
+    RELU_UNIFORM = "relu_uniform"
+    SIGMOID_UNIFORM = "sigmoid_uniform"
+    LECUN_NORMAL = "lecun_normal"
+    LECUN_UNIFORM = "lecun_uniform"
+    NORMALIZED = "normalized"
+    SIZE = "size"
+    VI = "vi"
+    DISTRIBUTION = "distribution"
+    IDENTITY = "identity"
+
+
+class GradientNormalization(_StrEnum):
+    """Gradient normalization/clipping (reference: `nn/updater/LayerUpdater.java:181-221`)."""
+
+    NONE = "none"
+    RENORMALIZE_L2_PER_LAYER = "renormalizel2perlayer"
+    RENORMALIZE_L2_PER_PARAM_TYPE = "renormalizel2perparamtype"
+    CLIP_ELEMENT_WISE_ABSOLUTE_VALUE = "clipelementwiseabsolutevalue"
+    CLIP_L2_PER_LAYER = "clipl2perlayer"
+    CLIP_L2_PER_PARAM_TYPE = "clipl2perparamtype"
+
+
+class OptimizationAlgorithm(_StrEnum):
+    """Optimization algorithms (reference: `nn/api/OptimizationAlgorithm.java`)."""
+
+    STOCHASTIC_GRADIENT_DESCENT = "stochastic_gradient_descent"
+    LINE_GRADIENT_DESCENT = "line_gradient_descent"
+    CONJUGATE_GRADIENT = "conjugate_gradient"
+    LBFGS = "lbfgs"
+
+
+class ConvolutionMode(_StrEnum):
+    """Convolution padding semantics (reference: `nn/conf/ConvolutionMode.java:9-19`)."""
+
+    STRICT = "strict"
+    TRUNCATE = "truncate"
+    SAME = "same"
+
+
+class PoolingType(_StrEnum):
+    """Pooling types (reference: `nn/conf/layers/PoolingType`-style; GlobalPooling SUM/AVG/MAX/PNORM)."""
+
+    MAX = "max"
+    AVG = "avg"
+    SUM = "sum"
+    PNORM = "pnorm"
+    NONE = "none"
+
+
+class BackpropType(_StrEnum):
+    """Backprop style (reference: `MultiLayerConfiguration.java:66-68`)."""
+
+    STANDARD = "standard"
+    TRUNCATED_BPTT = "truncatedbptt"
+
+
+class LearningRatePolicy(_StrEnum):
+    """LR decay policies (reference: `nn/updater/LayerUpdater.java:134-158`)."""
+
+    NONE = "none"
+    EXPONENTIAL = "exponential"
+    INVERSE = "inverse"
+    POLY = "poly"
+    SIGMOID = "sigmoid"
+    STEP = "step"
+    TORCH_STEP = "torchstep"
+    SCHEDULE = "schedule"
+    SCORE = "score"
+
+
+class MaskState(_StrEnum):
+    """Mask propagation state (reference: `nn/api/MaskState.java:19`)."""
+
+    ACTIVE = "active"
+    PASSTHROUGH = "passthrough"
+
+
+class CacheMode(_StrEnum):
+    NONE = "none"
+    DEVICE = "device"
+    HOST = "host"

@@ -1,0 +1,317 @@
+"""Graph vertex configurations for ComputationGraph.
+
+Equivalent of the reference's `nn/conf/graph/` vertex configs (Merge,
+ElementWise, Subset, Stack, Unstack, Scale, L2, L2Normalize, Preprocessor,
+rnn/{LastTimeStep, DuplicateToTimeSeries}; see `nn/graph/vertex/impl/`).
+Vertices are pure functions of their input arrays; backward is autodiff.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import Layer, layer_from_dict
+from deeplearning4j_tpu.nn.conf.preprocessors import (
+    InputPreProcessor,
+    preprocessor_from_dict,
+)
+
+_VERTEX_REGISTRY: Dict[str, type] = {}
+
+
+def register_vertex(cls):
+    _VERTEX_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def vertex_from_dict(d: dict):
+    d = dict(d)
+    kind = d.pop("@class")
+    cls = _VERTEX_REGISTRY.get(kind)
+    if cls is None:
+        raise ValueError(f"Unknown graph vertex: {kind}")
+    return cls.from_dict(d)
+
+
+@dataclass
+class GraphVertexConf:
+    """Base vertex config (reference SPI: `nn/graph/vertex/GraphVertex.java:37`)."""
+
+    def apply(self, inputs, masks=None):
+        raise NotImplementedError
+
+    def get_output_type(self, *input_types: InputType) -> InputType:
+        return input_types[0]
+
+    def to_dict(self):
+        d = {"@class": type(self).__name__}
+        for k, v in self.__dict__.items():
+            if k.startswith("_") or v is None:
+                continue
+            if isinstance(v, Layer):
+                v = v.to_dict()
+            elif isinstance(v, InputPreProcessor):
+                v = v.to_dict()
+            elif isinstance(v, tuple):
+                v = list(v)
+            d[k] = v
+        return d
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(**d)
+
+
+@register_vertex
+@dataclass
+class LayerVertex(GraphVertexConf):
+    """Wraps a layer (+ optional preprocessor) as a vertex (reference: `LayerVertex.java`)."""
+
+    layer: Optional[Layer] = None
+    preprocessor: Optional[InputPreProcessor] = None
+
+    def get_output_type(self, *input_types):
+        it = input_types[0]
+        if self.preprocessor is not None:
+            it = self.preprocessor.get_output_type(it)
+        return self.layer.get_output_type(it)
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(
+            layer=layer_from_dict(d["layer"]) if d.get("layer") else None,
+            preprocessor=preprocessor_from_dict(d.get("preprocessor")),
+        )
+
+
+@register_vertex
+@dataclass
+class MergeVertex(GraphVertexConf):
+    """Concatenate along the feature (last) axis (reference: `MergeVertex.java`;
+    the reference concatenates along dim 1 = channels/features in NCHW — the
+    feature axis is last here)."""
+
+    def apply(self, inputs, masks=None):
+        return jnp.concatenate(inputs, axis=-1)
+
+    def get_output_type(self, *input_types):
+        first = input_types[0]
+        if first.kind == "cnn":
+            return InputType.convolutional(
+                first.height, first.width, sum(t.channels for t in input_types)
+            )
+        total = sum(t.flat_size() for t in input_types)
+        if first.kind == "rnn":
+            return InputType.recurrent(total, first.timeseries_length)
+        return InputType.feed_forward(total)
+
+
+@register_vertex
+@dataclass
+class ElementWiseVertex(GraphVertexConf):
+    """Pointwise Add/Subtract/Product/Average/Max of equal-shape inputs
+    (reference: `ElementWiseVertex.java`)."""
+
+    op: str = "add"  # add | subtract | product | average | max
+
+    def apply(self, inputs, masks=None):
+        op = self.op.lower()
+        out = inputs[0]
+        if op == "add":
+            for x in inputs[1:]:
+                out = out + x
+        elif op == "subtract":
+            if len(inputs) != 2:
+                raise ValueError("ElementWiseVertex subtract requires exactly 2 inputs")
+            out = inputs[0] - inputs[1]
+        elif op == "product":
+            for x in inputs[1:]:
+                out = out * x
+        elif op == "average":
+            out = sum(inputs) / len(inputs)
+        elif op == "max":
+            for x in inputs[1:]:
+                out = jnp.maximum(out, x)
+        else:
+            raise ValueError(f"Unknown ElementWiseVertex op: {self.op}")
+        return out
+
+
+@register_vertex
+@dataclass
+class SubsetVertex(GraphVertexConf):
+    """Feature-axis slice [from, to] inclusive (reference: `SubsetVertex.java`)."""
+
+    from_index: int = 0
+    to_index: int = 0
+
+    def apply(self, inputs, masks=None):
+        return inputs[0][..., self.from_index : self.to_index + 1]
+
+    def get_output_type(self, *input_types):
+        n = self.to_index - self.from_index + 1
+        it = input_types[0]
+        if it.kind == "rnn":
+            return InputType.recurrent(n, it.timeseries_length)
+        if it.kind == "cnn":
+            return InputType.convolutional(it.height, it.width, n)
+        return InputType.feed_forward(n)
+
+
+@register_vertex
+@dataclass
+class StackVertex(GraphVertexConf):
+    """Stack along batch axis (reference: `StackVertex.java`)."""
+
+    def apply(self, inputs, masks=None):
+        return jnp.concatenate(inputs, axis=0)
+
+
+@register_vertex
+@dataclass
+class UnstackVertex(GraphVertexConf):
+    """Unstack: take slice `from_index` of `stack_size` along batch axis
+    (reference: `UnstackVertex.java`)."""
+
+    from_index: int = 0
+    stack_size: int = 1
+
+    def apply(self, inputs, masks=None):
+        x = inputs[0]
+        step = x.shape[0] // self.stack_size
+        return x[self.from_index * step : (self.from_index + 1) * step]
+
+
+@register_vertex
+@dataclass
+class ScaleVertex(GraphVertexConf):
+    """Multiply by a fixed scalar (reference: `ScaleVertex.java`)."""
+
+    scale_factor: float = 1.0
+
+    def apply(self, inputs, masks=None):
+        return inputs[0] * self.scale_factor
+
+
+@register_vertex
+@dataclass
+class ShiftVertex(GraphVertexConf):
+    """Add a fixed scalar (reference: `ShiftVertex.java`)."""
+
+    shift_factor: float = 0.0
+
+    def apply(self, inputs, masks=None):
+        return inputs[0] + self.shift_factor
+
+
+@register_vertex
+@dataclass
+class L2Vertex(GraphVertexConf):
+    """Pairwise L2 distance between two inputs (reference: `L2Vertex.java`).
+    Output [batch, 1]."""
+
+    eps: float = 1e-8
+
+    def apply(self, inputs, masks=None):
+        a, b = inputs
+        d2 = jnp.sum((a - b) ** 2, axis=tuple(range(1, a.ndim)))
+        return jnp.sqrt(jnp.maximum(d2, self.eps))[:, None]
+
+    def get_output_type(self, *input_types):
+        return InputType.feed_forward(1)
+
+
+@register_vertex
+@dataclass
+class L2NormalizeVertex(GraphVertexConf):
+    """L2-normalize along feature axes (reference: `L2NormalizeVertex.java`)."""
+
+    eps: float = 1e-8
+
+    def apply(self, inputs, masks=None):
+        x = inputs[0]
+        norm = jnp.sqrt(jnp.sum(x ** 2, axis=tuple(range(1, x.ndim)), keepdims=True))
+        return x / jnp.maximum(norm, self.eps)
+
+
+@register_vertex
+@dataclass
+class PreprocessorVertex(GraphVertexConf):
+    """Standalone preprocessor as a vertex (reference: `PreprocessorVertex.java`)."""
+
+    preprocessor: Optional[InputPreProcessor] = None
+
+    def apply(self, inputs, masks=None):
+        out, _ = self.preprocessor(inputs[0], masks[0] if masks else None)
+        return out
+
+    def get_output_type(self, *input_types):
+        return self.preprocessor.get_output_type(input_types[0])
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(preprocessor=preprocessor_from_dict(d.get("preprocessor")))
+
+
+@register_vertex
+@dataclass
+class LastTimeStepVertex(GraphVertexConf):
+    """[b,t,f] -> [b,f] at the last unmasked step (reference:
+    `rnn/LastTimeStepVertex.java`)."""
+
+    mask_array_input: Optional[str] = None
+
+    def apply(self, inputs, masks=None):
+        x = inputs[0]
+        mask = masks[0] if masks else None
+        if mask is None:
+            return x[:, -1, :]
+        idx = jnp.maximum(jnp.sum(mask, axis=1).astype(jnp.int32) - 1, 0)
+        return jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0, :]
+
+    def get_output_type(self, *input_types):
+        return InputType.feed_forward(input_types[0].size)
+
+
+@register_vertex
+@dataclass
+class DuplicateToTimeSeriesVertex(GraphVertexConf):
+    """[b,f] -> [b,t,f], t taken from a reference input (reference:
+    `rnn/DuplicateToTimeSeriesVertex.java`)."""
+
+    input_name: Optional[str] = None
+    _time_steps: Optional[int] = None  # resolved at apply time by the engine
+
+    def apply(self, inputs, masks=None, time_steps=None):
+        x = inputs[0]
+        t = time_steps if time_steps is not None else self._time_steps
+        return jnp.broadcast_to(x[:, None, :], (x.shape[0], t, x.shape[1]))
+
+    def get_output_type(self, *input_types):
+        return InputType.recurrent(input_types[0].flat_size())
+
+
+@register_vertex
+@dataclass
+class ReverseTimeSeriesVertex(GraphVertexConf):
+    """Reverse along time, respecting masks (reference: `ReverseTimeSeriesVertex.java`):
+    with a mask, only each example's unmasked prefix [0, len) is reversed in
+    place; padding stays at the tail."""
+
+    mask_array_input: Optional[str] = None
+
+    def apply(self, inputs, masks=None):
+        x = inputs[0]
+        mask = masks[0] if masks else None
+        if mask is None:
+            return x[:, ::-1, :]
+        t = x.shape[1]
+        lengths = jnp.sum(mask, axis=1).astype(jnp.int32)  # [b]
+        pos = jnp.arange(t)[None, :]  # [1, t]
+        # Index (len - 1 - pos) inside the prefix, identity in the padding.
+        src = jnp.where(pos < lengths[:, None], lengths[:, None] - 1 - pos, pos)
+        return jnp.take_along_axis(x, src[:, :, None], axis=1)

@@ -1,0 +1,74 @@
+"""Input types for shape inference.
+
+Equivalent of the reference's `nn/conf/inputs/InputType.java:41-77`
+(FF / RNN / CNN / CNNFlat). Used by the builders to infer each layer's `n_in`
+and to auto-insert input preprocessors between layer families.
+
+Layout note (TPU-first): activations are feature-last —
+FF `[batch, size]`, RNN `[batch, time, size]`, CNN NHWC `[batch, h, w, c]` —
+because the last axis maps to the TPU lane dimension and NHWC is XLA's
+preferred conv layout. The reference uses NCW/NCHW; converters at the
+import/serialization boundary handle that.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class InputType:
+    kind: str = "ff"  # ff | rnn | cnn | cnnflat
+    size: int = 0  # ff/rnn feature size
+    timeseries_length: Optional[int] = None  # rnn (None = variable)
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    @staticmethod
+    def feed_forward(size: int) -> "InputType":
+        return InputType(kind="ff", size=size)
+
+    @staticmethod
+    def recurrent(size: int, timeseries_length: Optional[int] = None) -> "InputType":
+        return InputType(kind="rnn", size=size, timeseries_length=timeseries_length)
+
+    @staticmethod
+    def convolutional(height: int, width: int, channels: int) -> "InputType":
+        return InputType(kind="cnn", height=height, width=width, channels=channels)
+
+    @staticmethod
+    def convolutional_flat(height: int, width: int, channels: int) -> "InputType":
+        return InputType(
+            kind="cnnflat", height=height, width=width, channels=channels,
+            size=height * width * channels,
+        )
+
+    def flat_size(self) -> int:
+        if self.kind in ("ff", "rnn"):
+            return self.size
+        return self.height * self.width * self.channels
+
+    def to_dict(self):
+        d = {"kind": self.kind}
+        if self.kind in ("ff", "rnn"):
+            d["size"] = self.size
+        if self.kind == "rnn" and self.timeseries_length is not None:
+            d["timeseries_length"] = self.timeseries_length
+        if self.kind in ("cnn", "cnnflat"):
+            d.update(height=self.height, width=self.width, channels=self.channels)
+        return d
+
+    @staticmethod
+    def from_dict(d):
+        if d is None:
+            return None
+        return InputType(
+            kind=d.get("kind", "ff"),
+            size=d.get("size", 0),
+            timeseries_length=d.get("timeseries_length"),
+            height=d.get("height", 0),
+            width=d.get("width", 0),
+            channels=d.get("channels", 0),
+        )

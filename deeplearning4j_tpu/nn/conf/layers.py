@@ -1,0 +1,619 @@
+"""Layer configuration classes.
+
+Equivalent of the reference's `nn/conf/layers/*` (one config class per layer
+type; inventory in SURVEY.md §2). Configs are JSON-serializable dataclasses
+carrying hyperparameters and shape-inference logic; the forward math lives in
+`deeplearning4j_tpu.nn.layers.*` and is looked up by config class name — the
+TPU analog of the reference's conf/impl split, minus the helper SPI (XLA lowers
+conv/BN/LSTM directly; no cuDNN-style plug-in point is needed).
+
+Unset per-layer hyperparameters (None) inherit the builder's global defaults at
+build time, matching `NeuralNetConfiguration.Builder` semantics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from deeplearning4j_tpu.nn.conf.distributions import Distribution
+from deeplearning4j_tpu.nn.conf.enums import (
+    Activation,
+    ConvolutionMode,
+    GradientNormalization,
+    LossFunction,
+    PoolingType,
+    Updater,
+    WeightInit,
+)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+_LAYER_REGISTRY: Dict[str, type] = {}
+
+
+def register_layer(cls):
+    _LAYER_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def layer_from_dict(d: dict):
+    d = dict(d)
+    kind = d.pop("@class")
+    cls = _LAYER_REGISTRY.get(kind)
+    if cls is None:
+        raise ValueError(f"Unknown layer type in config JSON: {kind}")
+    return cls.from_dict(d)
+
+
+def is_bias_param(name: str) -> bool:
+    """Single source of truth for bias-vs-weight param classification
+    (shared with `nn/params.py` init and the engines' L1/L2 penalty)."""
+    return (
+        name in ("b", "vb", "beta")
+        or name.startswith(("b_", "eb", "db"))
+        or name.endswith("B")
+    )
+
+
+def _tuple2(v) -> Tuple[int, int]:
+    if isinstance(v, int):
+        return (v, v)
+    t = tuple(int(x) for x in v)
+    if len(t) == 1:
+        return (t[0], t[0])
+    return t  # type: ignore[return-value]
+
+
+@dataclass
+class Layer:
+    """Base layer config: per-layer hyperparameter overrides (None = inherit global).
+
+    Mirrors the reference's `nn/conf/layers/Layer.java` builder fields.
+    """
+
+    name: Optional[str] = None
+    activation: Optional[Any] = None
+    weight_init: Optional[Any] = None
+    dist: Optional[Distribution] = None
+    learning_rate: Optional[float] = None
+    bias_learning_rate: Optional[float] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    dropout: Optional[float] = None  # retain probability; 0/1/None disables
+    bias_init: Optional[float] = None
+    updater: Optional[Any] = None
+    momentum: Optional[float] = None
+    adam_mean_decay: Optional[float] = None
+    adam_var_decay: Optional[float] = None
+    rho: Optional[float] = None
+    rms_decay: Optional[float] = None
+    epsilon: Optional[float] = None
+    gradient_normalization: Optional[Any] = None
+    gradient_normalization_threshold: Optional[float] = None
+
+    # ---- shape inference ----
+    def get_output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def set_n_in(self, input_type: InputType, override: bool) -> None:
+        """Infer n_in from the previous layer's output type (no-op by default)."""
+
+    def default_preprocessor(self, input_type: InputType):
+        return None
+
+    # ---- params ----
+    def param_shapes(self) -> Dict[str, Tuple[int, ...]]:
+        """Ordered mapping param-name -> shape (defines the flat-view order)."""
+        return {}
+
+    def state_shapes(self) -> Dict[str, Tuple[int, ...]]:
+        """Non-trainable state (e.g. batchnorm running stats)."""
+        return {}
+
+    def weight_param_keys(self) -> Sequence[str]:
+        """Params treated as weights for L1/L2 and weight-init purposes.
+        Biases are never regularized (reference semantics)."""
+        return [k for k in self.param_shapes() if not is_bias_param(k)]
+
+    def has_params(self) -> bool:
+        return bool(self.param_shapes())
+
+    def is_pretrainable(self) -> bool:
+        return False
+
+    # ---- serde ----
+    def to_dict(self) -> dict:
+        d: Dict[str, Any] = {"@class": type(self).__name__}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if v is None:
+                continue
+            if isinstance(v, Distribution):
+                v = v.to_dict()
+            elif isinstance(v, (Activation, WeightInit, Updater, LossFunction,
+                                GradientNormalization, PoolingType, ConvolutionMode)):
+                v = v.value
+            elif isinstance(v, tuple):
+                v = list(v)
+            d[f.name] = v
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict):
+        kwargs = dict(d)
+        if "dist" in kwargs and isinstance(kwargs["dist"], dict):
+            kwargs["dist"] = Distribution.from_dict(kwargs["dist"])
+        names = {f.name for f in dataclasses.fields(cls)}
+        kwargs = {k: v for k, v in kwargs.items() if k in names}
+        for key in ("kernel_size", "stride", "padding", "pooling_dimensions",
+                    "encoder_layer_sizes", "decoder_layer_sizes"):
+            if key in kwargs and isinstance(kwargs[key], list):
+                kwargs[key] = tuple(kwargs[key])
+        return cls(**kwargs)
+
+
+@dataclass
+class FeedForwardLayer(Layer):
+    """Base for layers with explicit n_in/n_out (reference: `FeedForwardLayer.java`)."""
+
+    n_in: int = 0
+    n_out: int = 0
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        if input_type.kind == "rnn":
+            return InputType.recurrent(self.n_out, input_type.timeseries_length)
+        return InputType.feed_forward(self.n_out)
+
+    def set_n_in(self, input_type: InputType, override: bool) -> None:
+        if override or not self.n_in:
+            self.n_in = input_type.flat_size()
+
+    def default_preprocessor(self, input_type: InputType):
+        from deeplearning4j_tpu.nn.conf.preprocessors import CnnToFeedForwardPreProcessor
+        if input_type.kind == "cnn":
+            return CnnToFeedForwardPreProcessor(
+                input_type.height, input_type.width, input_type.channels
+            )
+        return None
+
+    def param_shapes(self) -> Dict[str, Tuple[int, ...]]:
+        return {"W": (self.n_in, self.n_out), "b": (self.n_out,)}
+
+
+@register_layer
+@dataclass
+class DenseLayer(FeedForwardLayer):
+    """Fully-connected layer (reference: `nn/conf/layers/DenseLayer.java`)."""
+
+
+@register_layer
+@dataclass
+class BaseOutputLayer(FeedForwardLayer):
+    loss_function: Any = LossFunction.MCXENT
+
+    def to_dict(self):
+        d = super().to_dict()
+        lf = self.loss_function
+        d["loss_function"] = lf.value if isinstance(lf, LossFunction) else str(lf)
+        return d
+
+
+@register_layer
+@dataclass
+class OutputLayer(BaseOutputLayer):
+    """Dense + loss output layer (reference: `nn/conf/layers/OutputLayer.java`)."""
+
+
+@register_layer
+@dataclass
+class RnnOutputLayer(BaseOutputLayer):
+    """Per-timestep output layer for RNNs (reference: `RnnOutputLayer.java`)."""
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, input_type.timeseries_length)
+
+    def default_preprocessor(self, input_type: InputType):
+        from deeplearning4j_tpu.nn.conf.preprocessors import FeedForwardToRnnPreProcessor
+        if input_type.kind == "ff":
+            return FeedForwardToRnnPreProcessor()
+        return None
+
+
+@register_layer
+@dataclass
+class LossLayer(BaseOutputLayer):
+    """Loss-only layer, no params (reference: `nn/conf/layers/LossLayer.java`)."""
+
+    def param_shapes(self):
+        return {}
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def set_n_in(self, input_type, override):
+        self.n_in = self.n_out = input_type.flat_size()
+
+
+@register_layer
+@dataclass
+class CenterLossOutputLayer(BaseOutputLayer):
+    """Output layer with center loss (reference: `CenterLossOutputLayer.java`).
+
+    Maintains per-class feature centers as non-trainable state updated with
+    EMA rate `alpha`; adds `lambda_ * ||f - c_y||^2 / 2` to the loss.
+    """
+
+    alpha: float = 0.05
+    lambda_: float = 2e-4
+
+    def state_shapes(self):
+        return {"centers": (self.n_out, self.n_in)}
+
+
+@register_layer
+@dataclass
+class ActivationLayer(Layer):
+    """Activation-only layer (reference: `ActivationLayer.java`)."""
+
+    n_in: int = 0
+    n_out: int = 0
+
+    def set_n_in(self, input_type, override):
+        self.n_in = self.n_out = input_type.flat_size()
+
+
+@register_layer
+@dataclass
+class DropoutLayer(FeedForwardLayer):
+    """Dropout-only layer (reference: `DropoutLayer.java`)."""
+
+    def param_shapes(self):
+        return {}
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def set_n_in(self, input_type, override):
+        self.n_in = self.n_out = input_type.flat_size()
+
+
+@register_layer
+@dataclass
+class EmbeddingLayer(FeedForwardLayer):
+    """Index -> vector lookup (reference: `EmbeddingLayer.java`).
+
+    Input: integer indices `[batch]` or one-hot `[batch, n_in]`. TPU-native
+    implementation is a gather (`take`), not a onehot-matmul.
+    """
+
+    has_bias: bool = True
+
+    def param_shapes(self):
+        shapes = {"W": (self.n_in, self.n_out)}
+        if self.has_bias:
+            shapes["b"] = (self.n_out,)
+        return shapes
+
+
+@register_layer
+@dataclass
+class ConvolutionLayer(FeedForwardLayer):
+    """2-D convolution (reference: `nn/conf/layers/ConvolutionLayer.java`).
+
+    n_in = input channels, n_out = output filters. Kernel stored HWIO
+    `[kh, kw, in, out]` (XLA-native); NHWC activations.
+    """
+
+    kernel_size: Tuple[int, int] = (5, 5)
+    stride: Tuple[int, int] = (1, 1)
+    padding: Tuple[int, int] = (0, 0)
+    convolution_mode: Optional[Any] = None  # None -> builder global (default TRUNCATE)
+    dilation: Tuple[int, int] = (1, 1)
+    has_bias: bool = True
+
+    def __post_init__(self):
+        self.kernel_size = _tuple2(self.kernel_size)
+        self.stride = _tuple2(self.stride)
+        self.padding = _tuple2(self.padding)
+        self.dilation = _tuple2(self.dilation)
+
+    def _out_hw(self, h: int, w: int) -> Tuple[int, int]:
+        mode = ConvolutionMode.of(self.convolution_mode) or ConvolutionMode.TRUNCATE
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        ph, pw = self.padding
+        if mode == ConvolutionMode.SAME:
+            return (-(-h // sh), -(-w // sw))
+        oh = (h + 2 * ph - kh) // sh + 1
+        ow = (w + 2 * pw - kw) // sw + 1
+        if mode == ConvolutionMode.STRICT:
+            if (h + 2 * ph - kh) % sh != 0 or (w + 2 * pw - kw) % sw != 0:
+                raise ValueError(
+                    f"ConvolutionMode.STRICT: input {h}x{w} with kernel {self.kernel_size}, "
+                    f"stride {self.stride}, padding {self.padding} doesn't tile exactly "
+                    f"(reference `ConvolutionMode.java` semantics; use TRUNCATE or SAME)"
+                )
+        return (oh, ow)
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        oh, ow = self._out_hw(input_type.height, input_type.width)
+        return InputType.convolutional(oh, ow, self.n_out)
+
+    def set_n_in(self, input_type: InputType, override: bool) -> None:
+        if override or not self.n_in:
+            self.n_in = input_type.channels
+
+    def default_preprocessor(self, input_type: InputType):
+        from deeplearning4j_tpu.nn.conf.preprocessors import FeedForwardToCnnPreProcessor
+        if input_type.kind == "cnnflat":
+            return FeedForwardToCnnPreProcessor(
+                input_type.height, input_type.width, input_type.channels
+            )
+        return None
+
+    def param_shapes(self):
+        kh, kw = self.kernel_size
+        shapes = {"W": (kh, kw, self.n_in, self.n_out)}
+        if self.has_bias:
+            shapes["b"] = (self.n_out,)
+        return shapes
+
+
+@register_layer
+@dataclass
+class SubsamplingLayer(Layer):
+    """Spatial pooling (reference: `SubsamplingLayer.java`). No params."""
+
+    pooling_type: Any = PoolingType.MAX
+    kernel_size: Tuple[int, int] = (2, 2)
+    stride: Tuple[int, int] = (2, 2)
+    padding: Tuple[int, int] = (0, 0)
+    convolution_mode: Optional[Any] = None
+    pnorm: int = 2
+
+    def __post_init__(self):
+        self.kernel_size = _tuple2(self.kernel_size)
+        self.stride = _tuple2(self.stride)
+        self.padding = _tuple2(self.padding)
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        helper = ConvolutionLayer(
+            kernel_size=self.kernel_size, stride=self.stride, padding=self.padding,
+            convolution_mode=self.convolution_mode, n_out=input_type.channels,
+        )
+        oh, ow = helper._out_hw(input_type.height, input_type.width)
+        return InputType.convolutional(oh, ow, input_type.channels)
+
+
+@register_layer
+@dataclass
+class BatchNormalization(FeedForwardLayer):
+    """Batch normalization (reference: `nn/conf/layers/BatchNormalization.java:28-30`:
+    decay 0.9, eps 1e-5, minibatch flag, optional locked gamma/beta)."""
+
+    decay: float = 0.9
+    eps: float = 1e-5
+    is_minibatch: bool = True
+    lock_gamma_beta: bool = False
+    gamma: float = 1.0
+    beta: float = 0.0
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def set_n_in(self, input_type, override):
+        if override or not self.n_out:
+            self.n_in = self.n_out = input_type.flat_size() if input_type.kind in ("ff", "rnn") \
+                else input_type.channels
+
+    def default_preprocessor(self, input_type):
+        return None
+
+    def param_shapes(self):
+        if self.lock_gamma_beta:
+            return {}
+        return {"gamma": (self.n_out,), "beta": (self.n_out,)}
+
+    def state_shapes(self):
+        return {"mean": (self.n_out,), "var": (self.n_out,)}
+
+
+@register_layer
+@dataclass
+class LocalResponseNormalization(Layer):
+    """Cross-channel LRN (reference: `LocalResponseNormalization.java`;
+    defaults k=2, n=5, alpha=1e-4, beta=0.75). No params."""
+
+    k: float = 2.0
+    n: float = 5.0
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+
+@register_layer
+@dataclass
+class BaseRecurrentLayer(FeedForwardLayer):
+    def get_output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, input_type.timeseries_length)
+
+    def default_preprocessor(self, input_type: InputType):
+        from deeplearning4j_tpu.nn.conf.preprocessors import (
+            FeedForwardToRnnPreProcessor, CnnToRnnPreProcessor,
+        )
+        if input_type.kind == "ff":
+            return FeedForwardToRnnPreProcessor()
+        if input_type.kind == "cnn":
+            return CnnToRnnPreProcessor(input_type.height, input_type.width, input_type.channels)
+        return None
+
+
+@register_layer
+@dataclass
+class GravesLSTM(BaseRecurrentLayer):
+    """LSTM with peephole connections (reference: `nn/conf/layers/GravesLSTM.java`,
+    impl semantics `nn/layers/recurrent/LSTMHelpers.java:58-160`).
+
+    Params: `W` input weights `[n_in, 4*n_out]` (gate order i,f,o,g),
+    `RW` recurrent weights `[n_out, 4*n_out]`, `pW` peepholes `[3*n_out]`
+    (f,o,g order as in the reference's 3 extra columns), `b` `[4*n_out]` with
+    forget-gate bias init. The reference packs peepholes into RW's last 3
+    columns; we keep a separate leaf (same dof, cleaner sharding).
+    """
+
+    forget_gate_bias_init: float = 1.0
+    gate_activation: Any = Activation.SIGMOID
+
+    def param_shapes(self):
+        return {
+            "W": (self.n_in, 4 * self.n_out),
+            "RW": (self.n_out, 4 * self.n_out),
+            "pW": (3 * self.n_out,),
+            "b": (4 * self.n_out,),
+        }
+
+
+@register_layer
+@dataclass
+class LSTM(BaseRecurrentLayer):
+    """Standard LSTM without peepholes (cuDNN-compatible variant)."""
+
+    forget_gate_bias_init: float = 1.0
+    gate_activation: Any = Activation.SIGMOID
+
+    def param_shapes(self):
+        return {
+            "W": (self.n_in, 4 * self.n_out),
+            "RW": (self.n_out, 4 * self.n_out),
+            "b": (4 * self.n_out,),
+        }
+
+
+@register_layer
+@dataclass
+class GravesBidirectionalLSTM(BaseRecurrentLayer):
+    """Bidirectional peephole LSTM (reference: `GravesBidirectionalLSTM.java`).
+    Output is the sum of forward and backward passes (reference semantics)."""
+
+    forget_gate_bias_init: float = 1.0
+    gate_activation: Any = Activation.SIGMOID
+
+    def param_shapes(self):
+        return {
+            "W_f": (self.n_in, 4 * self.n_out),
+            "RW_f": (self.n_out, 4 * self.n_out),
+            "pW_f": (3 * self.n_out,),
+            "b_f": (4 * self.n_out,),
+            "W_b": (self.n_in, 4 * self.n_out),
+            "RW_b": (self.n_out, 4 * self.n_out),
+            "pW_b": (3 * self.n_out,),
+            "b_b": (4 * self.n_out,),
+        }
+
+
+@register_layer
+@dataclass
+class SimpleRnn(BaseRecurrentLayer):
+    """Vanilla RNN: h_t = act(x_t W + h_{t-1} RW + b)."""
+
+    def param_shapes(self):
+        return {
+            "W": (self.n_in, self.n_out),
+            "RW": (self.n_out, self.n_out),
+            "b": (self.n_out,),
+        }
+
+
+@register_layer
+@dataclass
+class GlobalPoolingLayer(Layer):
+    """Global pooling over time or space (reference: `GlobalPoolingLayer.java`;
+    SUM/AVG/MAX/PNORM, mask-aware)."""
+
+    pooling_type: Any = PoolingType.MAX
+    pooling_dimensions: Optional[Tuple[int, ...]] = None
+    collapse_dimensions: bool = True
+    pnorm: int = 2
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        if input_type.kind == "rnn":
+            return InputType.feed_forward(input_type.size)
+        if input_type.kind == "cnn":
+            return InputType.feed_forward(input_type.channels)
+        return input_type
+
+
+@register_layer
+@dataclass
+class AutoEncoder(FeedForwardLayer):
+    """Denoising autoencoder (reference: `nn/conf/layers/AutoEncoder.java`,
+    impl `nn/layers/feedforward/autoencoder/AutoEncoder.java`). Pretrainable."""
+
+    corruption_level: float = 0.3
+    sparsity: float = 0.0
+    loss_function: Any = LossFunction.RECONSTRUCTION_CROSSENTROPY
+
+    def param_shapes(self):
+        return {"W": (self.n_in, self.n_out), "b": (self.n_out,), "vb": (self.n_in,)}
+
+    def is_pretrainable(self):
+        return True
+
+
+@register_layer
+@dataclass
+class RBM(FeedForwardLayer):
+    """Restricted Boltzmann machine (reference: `nn/conf/layers/RBM.java:83-86`,
+    contrastive divergence in `nn/layers/feedforward/rbm/RBM.java:101`).
+    Visible/hidden unit types: binary | gaussian | softmax | rectified."""
+
+    visible_unit: str = "binary"
+    hidden_unit: str = "binary"
+    k: int = 1  # CD-k steps
+    sparsity: float = 0.0
+    loss_function: Any = LossFunction.RECONSTRUCTION_CROSSENTROPY
+
+    def param_shapes(self):
+        return {"W": (self.n_in, self.n_out), "b": (self.n_out,), "vb": (self.n_in,)}
+
+    def is_pretrainable(self):
+        return True
+
+
+@register_layer
+@dataclass
+class VariationalAutoencoder(FeedForwardLayer):
+    """VAE (reference: `nn/conf/layers/variational/VariationalAutoencoder.java`,
+    impl `nn/layers/variational/VariationalAutoencoder.java:48-79`): own
+    encoder/decoder MLP stacks, pluggable reconstruction distribution,
+    n_out = latent size. Pretrainable; supervised forward uses the mean."""
+
+    encoder_layer_sizes: Tuple[int, ...] = (100,)
+    decoder_layer_sizes: Tuple[int, ...] = (100,)
+    reconstruction_distribution: str = "gaussian"  # gaussian | bernoulli
+    pzx_activation: Any = Activation.IDENTITY
+    num_samples: int = 1
+
+    def param_shapes(self):
+        shapes: Dict[str, Tuple[int, ...]] = {}
+        prev = self.n_in
+        for i, size in enumerate(self.encoder_layer_sizes):
+            shapes[f"eW{i}"] = (prev, size)
+            shapes[f"eb{i}"] = (size,)
+            prev = size
+        shapes["pZXMeanW"] = (prev, self.n_out)
+        shapes["pZXMeanB"] = (self.n_out,)
+        shapes["pZXLogStd2W"] = (prev, self.n_out)
+        shapes["pZXLogStd2B"] = (self.n_out,)
+        prev = self.n_out
+        for i, size in enumerate(self.decoder_layer_sizes):
+            shapes[f"dW{i}"] = (prev, size)
+            shapes[f"db{i}"] = (size,)
+            prev = size
+        dist_mult = 2 if self.reconstruction_distribution == "gaussian" else 1
+        shapes["pXZW"] = (prev, self.n_in * dist_mult)
+        shapes["pXZB"] = (self.n_in * dist_mult,)
+        return shapes
+
+    def is_pretrainable(self):
+        return True

@@ -1,0 +1,203 @@
+"""Input preprocessors: shape adapters between layer families.
+
+Equivalent of the reference's `nn/conf/preprocessor/` (CnnToFeedForward,
+FeedForwardToCnn, CnnToRnn, RnnToCnn, FeedForwardToRnn, RnnToFeedForward,
+Reshape, Composable). Only forward transforms are defined — backward shape
+restoration is autodiff's job in the TPU build.
+
+Layouts are feature-last (NHWC / [batch, time, features]); see
+`nn/conf/inputs.py`. Because dense layers here operate on the last axis and
+broadcast over leading axes, Rnn<->FeedForward preprocessors are identity on
+data and exist for config parity and mask handling.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+_PREPROCESSOR_REGISTRY: Dict[str, type] = {}
+
+
+def register_preprocessor(cls):
+    _PREPROCESSOR_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def preprocessor_from_dict(d):
+    if d is None:
+        return None
+    d = dict(d)
+    kind = d.pop("@class")
+    if kind == "ComposableInputPreProcessor":
+        return ComposableInputPreProcessor(
+            *[preprocessor_from_dict(p) for p in d["preprocessors"]]
+        )
+    cls = _PREPROCESSOR_REGISTRY.get(kind)
+    if cls is None:
+        raise ValueError(f"Unknown preprocessor: {kind}")
+    for key in ("target_shape",):
+        if key in d and isinstance(d[key], list):
+            d[key] = tuple(d[key])
+    return cls(**d)
+
+
+@dataclass
+class InputPreProcessor:
+    def __call__(self, x, mask=None):
+        """Returns (transformed activations, transformed mask)."""
+        return x, mask
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def to_dict(self):
+        d = {"@class": type(self).__name__}
+        d.update({k: v for k, v in self.__dict__.items() if not k.startswith("_")})
+        return d
+
+
+@register_preprocessor
+@dataclass
+class CnnToFeedForwardPreProcessor(InputPreProcessor):
+    """[b,h,w,c] -> [b, h*w*c] (reference: `CnnToFeedForwardPreProcessor.java`)."""
+
+    input_height: int = 0
+    input_width: int = 0
+    num_channels: int = 0
+
+    def __call__(self, x, mask=None):
+        return x.reshape(x.shape[0], -1), mask
+
+    def get_output_type(self, input_type):
+        return InputType.feed_forward(
+            input_type.height * input_type.width * input_type.channels
+        )
+
+
+@register_preprocessor
+@dataclass
+class FeedForwardToCnnPreProcessor(InputPreProcessor):
+    """[b, h*w*c] -> [b,h,w,c] (reference: `FeedForwardToCnnPreProcessor.java`).
+
+    Note: the reference unflattens NCHW; we unflatten NHWC. Flat inputs in the
+    reference's channel-major order must be converted at the data boundary
+    (see `datasets/`): the MNIST-style c=1 case is layout-identical.
+    """
+
+    input_height: int = 0
+    input_width: int = 0
+    num_channels: int = 0
+
+    def __call__(self, x, mask=None):
+        return x.reshape(x.shape[0], self.input_height, self.input_width, self.num_channels), mask
+
+    def get_output_type(self, input_type):
+        return InputType.convolutional(self.input_height, self.input_width, self.num_channels)
+
+
+@register_preprocessor
+@dataclass
+class FeedForwardToRnnPreProcessor(InputPreProcessor):
+    """[b*t, f] -> [b, t, f] in the reference; identity here (dense ops are
+    feature-last and broadcast over time)."""
+
+    def get_output_type(self, input_type):
+        if input_type.kind == "ff":
+            return InputType.recurrent(input_type.size)
+        return input_type
+
+
+@register_preprocessor
+@dataclass
+class RnnToFeedForwardPreProcessor(InputPreProcessor):
+    """[b, t, f] -> [b*t, f] in the reference; identity here."""
+
+    def get_output_type(self, input_type):
+        if input_type.kind == "rnn":
+            return InputType.feed_forward(input_type.size)
+        return input_type
+
+
+@register_preprocessor
+@dataclass
+class CnnToRnnPreProcessor(InputPreProcessor):
+    """[b,h,w,c] -> [b, 1, h*w*c]: CNN features as a single-timestep sequence
+    (reference: `CnnToRnnPreProcessor.java`, which maps [b*t,c,h,w] -> [b,c*h*w,t])."""
+
+    input_height: int = 0
+    input_width: int = 0
+    num_channels: int = 0
+
+    def __call__(self, x, mask=None):
+        if x.ndim == 4:  # [b,h,w,c] — single step
+            return x.reshape(x.shape[0], 1, -1), mask
+        # [b,t,h,w,c]
+        return x.reshape(x.shape[0], x.shape[1], -1), mask
+
+    def get_output_type(self, input_type):
+        return InputType.recurrent(
+            input_type.height * input_type.width * input_type.channels
+        )
+
+
+@register_preprocessor
+@dataclass
+class RnnToCnnPreProcessor(InputPreProcessor):
+    """[b,t,h*w*c] -> [b*t or b,t,h,w,c] (reference: `RnnToCnnPreProcessor.java`)."""
+
+    input_height: int = 0
+    input_width: int = 0
+    num_channels: int = 0
+
+    def __call__(self, x, mask=None):
+        b, t = x.shape[0], x.shape[1]
+        return (
+            x.reshape(b, t, self.input_height, self.input_width, self.num_channels),
+            mask,
+        )
+
+    def get_output_type(self, input_type):
+        return InputType.convolutional(self.input_height, self.input_width, self.num_channels)
+
+
+@register_preprocessor
+@dataclass
+class ReshapePreProcessor(InputPreProcessor):
+    """Free-form reshape keeping the batch axis (reference: `ReshapePreProcessor.java`)."""
+
+    target_shape: Optional[Tuple[int, ...]] = None
+
+    def __call__(self, x, mask=None):
+        return x.reshape((x.shape[0],) + tuple(self.target_shape or ())), mask
+
+
+@dataclass
+class ComposableInputPreProcessor(InputPreProcessor):
+    """Chain of preprocessors (reference: `ComposableInputPreProcessor.java`)."""
+
+    def __init__(self, *preprocessors):
+        self.preprocessors = list(preprocessors)
+
+    def __call__(self, x, mask=None):
+        for p in self.preprocessors:
+            x, mask = p(x, mask)
+        return x, mask
+
+    def get_output_type(self, input_type):
+        for p in self.preprocessors:
+            input_type = p.get_output_type(input_type)
+        return input_type
+
+    def to_dict(self):
+        return {
+            "@class": "ComposableInputPreProcessor",
+            "preprocessors": [p.to_dict() for p in self.preprocessors],
+        }
+
+
+_PREPROCESSOR_REGISTRY["ComposableInputPreProcessor"] = ComposableInputPreProcessor

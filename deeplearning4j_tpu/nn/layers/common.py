@@ -1,0 +1,27 @@
+"""Shared helpers for layer implementations."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def inverted_dropout(x: jnp.ndarray, retain: Optional[float], rng, train: bool) -> jnp.ndarray:
+    """Inverted dropout on input activations (reference: `util/Dropout.java`).
+
+    `retain` is the probability of keeping a unit; 0/1/None disables. Scaling
+    by 1/retain at train time keeps inference a no-op.
+    """
+    if not train or retain is None or retain <= 0.0 or retain >= 1.0 or rng is None:
+        return x
+    keep = jax.random.bernoulli(rng, retain, x.shape)
+    return jnp.where(keep, x / retain, 0.0)
+
+
+def apply_mask(x: jnp.ndarray, mask: Optional[jnp.ndarray]) -> jnp.ndarray:
+    """Zero masked timesteps. x: [b, t, f], mask: [b, t]."""
+    if mask is None:
+        return x
+    return x * mask[..., None]

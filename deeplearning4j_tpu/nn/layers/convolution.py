@@ -1,0 +1,89 @@
+"""Convolution and pooling layer implementations.
+
+Equivalent of the reference's `nn/layers/convolution/` (ConvolutionLayer
+im2col+gemm path + cuDNN helper, SubsamplingLayer). TPU-native: a single
+`lax.conv_general_dilated` in NHWC/HWIO — XLA tiles it onto the MXU directly,
+so the reference's im2col staging and the cuDNN helper SPI both disappear
+(`ConvolutionLayer.java:265`, `ConvolutionHelper.java:32-38`).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import activations
+from deeplearning4j_tpu.nn.conf.enums import ConvolutionMode, PoolingType
+from deeplearning4j_tpu.nn.layers.common import inverted_dropout
+
+_DIMS = ("NHWC", "HWIO", "NHWC")
+
+
+def _conv_padding(conf, h, w):
+    mode = ConvolutionMode.of(conf.convolution_mode) or ConvolutionMode.TRUNCATE
+    if mode == ConvolutionMode.SAME:
+        return "SAME"
+    ph, pw = conf.padding
+    return [(ph, ph), (pw, pw)]
+
+
+def conv2d_apply(conf, params, state, x, *, rng=None, train=False, mask=None):
+    x = inverted_dropout(x, conf.dropout, rng, train)
+    out = jax.lax.conv_general_dilated(
+        x,
+        params["W"].astype(x.dtype),
+        window_strides=conf.stride,
+        padding=_conv_padding(conf, x.shape[1], x.shape[2]),
+        rhs_dilation=conf.dilation,
+        dimension_numbers=_DIMS,
+    )
+    if "b" in params:
+        out = out + params["b"].astype(out.dtype)
+    out = activations.resolve(conf.activation)(out)
+    return out, state, mask
+
+
+def subsampling_apply(conf, params, state, x, *, rng=None, train=False, mask=None):
+    ptype = PoolingType.of(conf.pooling_type) or PoolingType.MAX
+    kh, kw = conf.kernel_size
+    sh, sw = conf.stride
+    mode = ConvolutionMode.of(conf.convolution_mode) or ConvolutionMode.TRUNCATE
+    if mode == ConvolutionMode.SAME:
+        padding = "SAME"
+    else:
+        ph, pw = conf.padding
+        padding = [(0, 0), (ph, ph), (pw, pw), (0, 0)]
+    window = (1, kh, kw, 1)
+    strides = (1, sh, sw, 1)
+
+    if ptype == PoolingType.MAX:
+        out = jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max, window, strides, padding
+        )
+    elif ptype in (PoolingType.AVG, PoolingType.SUM):
+        out = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, padding)
+        if ptype == PoolingType.AVG:
+            out = out / (kh * kw)
+    elif ptype == PoolingType.PNORM:
+        p = float(conf.pnorm)
+        out = jax.lax.reduce_window(
+            jnp.abs(x) ** p, 0.0, jax.lax.add, window, strides, padding
+        ) ** (1.0 / p)
+    else:
+        raise ValueError(f"Unsupported pooling type: {conf.pooling_type}")
+    return out, state, mask
+
+
+def lrn_apply(conf, params, state, x, *, rng=None, train=False, mask=None):
+    """Cross-channel local response normalization (reference:
+    `nn/layers/normalization/LocalResponseNormalization.java:66`):
+    y = x / (k + alpha * sum_{window n} x_j^2)^beta, channels last."""
+    n = int(conf.n)
+    sq = x * x
+    window_sum = jax.lax.reduce_window(
+        sq, 0.0, jax.lax.add,
+        window_dimensions=(1, 1, 1, n),
+        window_strides=(1, 1, 1, 1),
+        padding=[(0, 0), (0, 0), (0, 0), (n // 2, (n - 1) // 2)],
+    )
+    return x / (conf.k + conf.alpha * window_sum) ** conf.beta, state, mask

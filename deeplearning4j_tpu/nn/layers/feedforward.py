@@ -1,0 +1,93 @@
+"""Feed-forward layer implementations: Dense, Output, Embedding, Activation,
+Dropout, AutoEncoder, RBM (supervised path), CenterLossOutput features.
+
+Equivalent of the reference's `nn/layers/feedforward/` + `BaseLayer.java`
+forward math. All functions are pure; backward is autodiff. Dense ops act on
+the LAST axis and broadcast over leading axes, so the same code serves
+[batch, f] and [batch, time, f] (the reference reshapes via Rnn<->FF
+preprocessors instead).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import activations
+from deeplearning4j_tpu.nn.layers.common import inverted_dropout
+
+
+def dense_apply(conf, params, state, x, *, rng=None, train=False, mask=None):
+    x = inverted_dropout(x, conf.dropout, rng, train)
+    out = x @ params["W"]
+    if "b" in params:
+        out = out + params["b"]
+    out = activations.resolve(conf.activation)(out)
+    return out, state, mask
+
+
+def preoutput(conf, params, state, x, *, rng=None, train=False, mask=None):
+    """Linear pre-activation (used by output layers for stable fused losses)."""
+    x = inverted_dropout(x, conf.dropout, rng, train)
+    out = x @ params["W"]
+    if "b" in params:
+        out = out + params["b"]
+    return out, state, mask
+
+
+def embedding_apply(conf, params, state, x, *, rng=None, train=False, mask=None):
+    """Embedding lookup (reference: `nn/layers/feedforward/embedding/EmbeddingLayer.java`).
+
+    TPU-native: a gather instead of the reference's onehot-matmul. Accepts
+    integer indices [b], [b,1], [b,t] or one-hot [..., n_in].
+    """
+    if jnp.issubdtype(x.dtype, jnp.floating) and x.shape[-1] == conf.n_in:
+        idx = jnp.argmax(x, axis=-1)
+    else:
+        idx = x.astype(jnp.int32)
+        if idx.ndim >= 2 and idx.shape[-1] == 1:
+            idx = idx[..., 0]
+    out = jnp.take(params["W"], idx, axis=0)
+    if "b" in params:
+        out = out + params["b"]
+    out = activations.resolve(conf.activation)(out)
+    return out, state, mask
+
+
+def activation_apply(conf, params, state, x, *, rng=None, train=False, mask=None):
+    return activations.resolve(conf.activation)(x), state, mask
+
+
+def dropout_apply(conf, params, state, x, *, rng=None, train=False, mask=None):
+    return inverted_dropout(x, conf.dropout, rng, train), state, mask
+
+
+def autoencoder_apply(conf, params, state, x, *, rng=None, train=False, mask=None):
+    """Supervised forward = encode (reference: `AutoEncoder.java` encode)."""
+    return dense_apply(conf, params, state, x, rng=rng, train=train, mask=mask)
+
+
+def autoencoder_reconstruct(conf, params, x, rng=None, corrupt=False):
+    """Encode+decode with optional masking-noise corruption (pretrain path;
+    reference: `AutoEncoder.java` getCorruptedInput/encode/decode)."""
+    act = activations.resolve(conf.activation)
+    if corrupt and rng is not None and conf.corruption_level > 0:
+        keep = jax.random.bernoulli(rng, 1.0 - conf.corruption_level, x.shape)
+        x = jnp.where(keep, x, 0.0)
+    y = act(x @ params["W"] + params["b"])
+    z = act(y @ params["W"].T + params["vb"])
+    return z
+
+
+def rbm_apply(conf, params, state, x, *, rng=None, train=False, mask=None):
+    """Supervised forward = propUp (reference: `nn/layers/feedforward/rbm/RBM.java`)."""
+    pre = x @ params["W"] + params["b"]
+    if conf.hidden_unit == "gaussian":
+        out = pre
+    elif conf.hidden_unit == "rectified":
+        out = jax.nn.relu(pre)
+    elif conf.hidden_unit == "softmax":
+        out = jax.nn.softmax(pre, axis=-1)
+    else:
+        out = jax.nn.sigmoid(pre)
+    return out, state, mask

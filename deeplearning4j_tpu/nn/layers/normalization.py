@@ -1,0 +1,39 @@
+"""Batch normalization implementation.
+
+Equivalent of the reference's `nn/layers/normalization/BatchNormalization.java:55`
+(+ cuDNN helper path, subsumed by XLA fusion). Works for dense [b,f], sequence
+[b,t,f], and NHWC [b,h,w,c] inputs — stats reduce over all axes but the last.
+
+Running stats live in the layer *state* pytree (decay-EMA, reference decay 0.9,
+eps 1e-5); train/inference selection is a static python flag, so each mode
+compiles to its own fused XLA program (no in-graph branching).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import activations
+
+
+def batchnorm_apply(conf, params, state, x, *, rng=None, train=False, mask=None):
+    axes = tuple(range(x.ndim - 1))
+    if train and conf.is_minibatch:
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+        decay = conf.decay
+        new_state = {
+            "mean": decay * state["mean"] + (1.0 - decay) * mean,
+            "var": decay * state["var"] + (1.0 - decay) * var,
+        }
+    else:
+        mean = state["mean"]
+        var = state["var"]
+        new_state = state
+    xhat = (x - mean) / jnp.sqrt(var + conf.eps)
+    if conf.lock_gamma_beta or not params:
+        out = conf.gamma * xhat + conf.beta
+    else:
+        out = params["gamma"] * xhat + params["beta"]
+    out = activations.resolve(conf.activation)(out)
+    return out, new_state, mask

@@ -1,0 +1,47 @@
+"""Global pooling layer implementation.
+
+Equivalent of the reference's `nn/layers/pooling/GlobalPoolingLayer.java:41`:
+pool over time ([b,t,f] -> [b,f], mask-aware) or space ([b,h,w,c] -> [b,c]),
+types SUM/AVG/MAX/PNORM.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.enums import PoolingType
+
+
+def global_pooling_apply(conf, params, state, x, *, rng=None, train=False, mask=None):
+    ptype = PoolingType.of(conf.pooling_type) or PoolingType.MAX
+    if x.ndim == 3:  # [b, t, f] over time
+        axes = (1,)
+        m = mask[..., None] if mask is not None else None
+    elif x.ndim == 4:  # [b, h, w, c] over space
+        axes = (1, 2)
+        m = None
+    else:
+        raise ValueError(f"GlobalPooling expects 3-D or 4-D input, got {x.ndim}-D")
+
+    if ptype == PoolingType.MAX:
+        if m is not None:
+            x = jnp.where(m > 0, x, -jnp.inf)
+        out = jnp.max(x, axis=axes)
+    elif ptype == PoolingType.SUM:
+        if m is not None:
+            x = x * m
+        out = jnp.sum(x, axis=axes)
+    elif ptype == PoolingType.AVG:
+        if m is not None:
+            out = jnp.sum(x * m, axis=axes) / jnp.maximum(jnp.sum(m, axis=axes), 1.0)
+        else:
+            out = jnp.mean(x, axis=axes)
+    elif ptype == PoolingType.PNORM:
+        p = float(conf.pnorm)
+        if m is not None:
+            x = x * m
+        out = jnp.sum(jnp.abs(x) ** p, axis=axes) ** (1.0 / p)
+    else:
+        raise ValueError(f"Unsupported global pooling type: {conf.pooling_type}")
+    # Mask is consumed: output is per-example (reference collapseDimensions).
+    return out, state, None

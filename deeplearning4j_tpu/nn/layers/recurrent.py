@@ -1,0 +1,139 @@
+"""Recurrent layer implementations: GravesLSTM, LSTM, bidirectional, SimpleRnn.
+
+Equivalent of the reference's `nn/layers/recurrent/LSTMHelpers.java:58-160`
+(activateHelper) — but as a `lax.scan` over time: the per-timestep Java loop
+with gemm+axpy becomes one compiled scan whose body is a single fused
+`[b, n_in + n_out] x [n_in + n_out, 4*n_out]` matmul on the MXU.
+
+Semantics (reference parity):
+- gate order i, f, o, g in the packed weight matrices;
+- Graves peepholes: i and f see c_{t-1}, o sees c_t (`pW` = [p_i, p_f, p_o]);
+- gate activation sigmoid (or hard-sigmoid), cell/output activation from conf
+  (default tanh);
+- masking: at masked steps state carries through and output is zeroed
+  (variable-length sequences, reference `GravesLSTM.feedForwardMaskArray`);
+- bidirectional output = forward + backward sum (reference
+  `GravesBidirectionalLSTM` ADD mode).
+
+Layout: x is [batch, time, features] (feature-last; reference is [b, f, t]).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import activations
+from deeplearning4j_tpu.nn.layers.common import inverted_dropout
+
+
+def _lstm_scan(conf, params, x, mask, h0, c0, peephole: bool, reverse: bool = False,
+               suffix: str = ""):
+    """Run an LSTM over [b,t,f]; returns (outputs [b,t,n_out], (hT, cT))."""
+    W = params["W" + suffix]
+    RW = params["RW" + suffix]
+    b = params["b" + suffix]
+    n_out = conf.n_out
+    gate_act = activations.resolve(conf.gate_activation)
+    cell_act = activations.resolve(conf.activation)
+    if peephole:
+        pW = params["pW" + suffix]
+        p_i, p_f, p_o = pW[:n_out], pW[n_out:2 * n_out], pW[2 * n_out:]
+
+    # Precompute input projections for all timesteps in one big MXU matmul.
+    xw = x @ W + b  # [b, t, 4*n_out]
+
+    def step(carry, inp):
+        h_prev, c_prev = carry
+        xw_t, m_t = inp
+        z = xw_t + h_prev @ RW
+        zi, zf, zo, zg = jnp.split(z, 4, axis=-1)
+        if peephole:
+            zi = zi + c_prev * p_i
+            zf = zf + c_prev * p_f
+        i = gate_act(zi)
+        f = gate_act(zf)
+        g = cell_act(zg)
+        c = f * c_prev + i * g
+        if peephole:
+            zo = zo + c * p_o
+        o = gate_act(zo)
+        h = o * cell_act(c)
+        if m_t is not None:
+            m = m_t[:, None]
+            h = m * h + (1.0 - m) * h_prev
+            c = m * c + (1.0 - m) * c_prev
+            out = m * h
+        else:
+            out = h
+        return (h, c), out
+
+    xs = jnp.swapaxes(xw, 0, 1)  # [t, b, 4n]
+    ms = jnp.swapaxes(mask, 0, 1) if mask is not None else None
+    (hT, cT), outs = jax.lax.scan(
+        step, (h0, c0), (xs, ms), reverse=reverse
+    )
+    return jnp.swapaxes(outs, 0, 1), (hT, cT)
+
+
+def _zeros_state(x, n_out):
+    b = x.shape[0]
+    return jnp.zeros((b, n_out), x.dtype), jnp.zeros((b, n_out), x.dtype)
+
+
+def lstm_apply(conf, params, state, x, *, rng=None, train=False, mask=None,
+               peephole=True):
+    """GravesLSTM / LSTM forward. `state` (if non-None dict with h/c) seeds the
+    initial hidden state — used by `rnn_time_step` stateful inference
+    (reference: `MultiLayerNetwork.rnnTimeStep:2230`)."""
+    x = inverted_dropout(x, conf.dropout, rng, train)
+    if state and "h" in state:
+        h0, c0 = state["h"], state["c"]
+    else:
+        h0, c0 = _zeros_state(x, conf.n_out)
+    outs, (hT, cT) = _lstm_scan(conf, params, x, mask, h0, c0, peephole)
+    return outs, {"h": hT, "c": cT}, mask
+
+
+def graves_lstm_apply(conf, params, state, x, **kw):
+    return lstm_apply(conf, params, state, x, peephole=True, **kw)
+
+
+def standard_lstm_apply(conf, params, state, x, **kw):
+    return lstm_apply(conf, params, state, x, peephole=False, **kw)
+
+
+def bidirectional_lstm_apply(conf, params, state, x, *, rng=None, train=False, mask=None):
+    x = inverted_dropout(x, conf.dropout, rng, train)
+    h0, c0 = _zeros_state(x, conf.n_out)
+    fwd, _ = _lstm_scan(conf, params, x, mask, h0, c0, True, reverse=False, suffix="_f")
+    bwd, _ = _lstm_scan(conf, params, x, mask, h0, c0, True, reverse=True, suffix="_b")
+    return fwd + bwd, state, mask
+
+
+def simple_rnn_apply(conf, params, state, x, *, rng=None, train=False, mask=None):
+    x = inverted_dropout(x, conf.dropout, rng, train)
+    act = activations.resolve(conf.activation)
+    if state and "h" in state:
+        h0 = state["h"]
+    else:
+        h0 = jnp.zeros((x.shape[0], conf.n_out), x.dtype)
+    xw = x @ params["W"] + params["b"]
+
+    def step(h_prev, inp):
+        xw_t, m_t = inp
+        h = act(xw_t + h_prev @ params["RW"])
+        if m_t is not None:
+            m = m_t[:, None]
+            h = m * h + (1.0 - m) * h_prev
+        return h, h
+
+    xs = jnp.swapaxes(xw, 0, 1)
+    ms = jnp.swapaxes(mask, 0, 1) if mask is not None else None
+    hT, outs = jax.lax.scan(step, h0, (xs, ms))
+    outs = jnp.swapaxes(outs, 0, 1)
+    if mask is not None:
+        outs = outs * mask[..., None]
+    return outs, {"h": hT}, mask

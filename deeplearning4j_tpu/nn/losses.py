@@ -1,0 +1,142 @@
+"""Loss functions.
+
+TPU-native equivalent of ND4J's `ILossFunction` impls (consumed by the reference's
+output layers; inventory in SURVEY.md §2.4). Each loss takes the *pre-activation*
+(`preout`) plus the output activation name, so that softmax+MCXENT and
+sigmoid+XENT lower to numerically-stable fused log-softmax / logit forms — the
+gradient comes from jax autodiff of the jitted score, not hand-written backprop.
+
+Shape convention: features on the LAST axis. `[batch, features]` for dense,
+`[batch, time, features]` for sequences (the reference uses NCW `[batch, nOut, time]`;
+feature-last is the TPU-friendly layout — lane dimension = features).
+Masks are `[batch]` or `[batch, time]`, 1.0 = keep.
+
+Returns per-example (and per-timestep) losses with the feature axis reduced;
+callers average over examples to produce the score (reference semantics:
+loss / minibatch + L1/L2 terms, `MultiLayerNetwork.java:1838`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import activations
+from deeplearning4j_tpu.nn.conf.enums import Activation, LossFunction
+
+_EPS = 1e-7
+
+
+def _act_name(activation) -> str:
+    if isinstance(activation, Activation):
+        return activation.value
+    if isinstance(activation, str):
+        return activation.lower()
+    return ""
+
+
+def compute_per_example(
+    loss: Union[str, LossFunction],
+    labels: jnp.ndarray,
+    preout: jnp.ndarray,
+    activation: Union[str, Activation, None] = Activation.IDENTITY,
+    mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Per-example loss, feature axis reduced. Mask (if given) zeroes masked steps."""
+    key = loss.value if isinstance(loss, LossFunction) else str(loss).lower()
+    act = _act_name(activation)
+
+    if key in (LossFunction.MCXENT.value, LossFunction.NEGATIVELOGLIKELIHOOD.value):
+        if act == Activation.SOFTMAX.value:
+            logp = jax.nn.log_softmax(preout, axis=-1)
+        else:
+            out = activations.resolve(activation)(preout)
+            logp = jnp.log(jnp.clip(out, _EPS, 1.0))
+        per = -jnp.sum(labels * logp, axis=-1)
+    elif key == LossFunction.XENT.value:
+        if act == Activation.SIGMOID.value:
+            # stable binary cross-entropy from logits
+            per = jnp.sum(
+                jnp.maximum(preout, 0) - preout * labels + jnp.log1p(jnp.exp(-jnp.abs(preout))),
+                axis=-1,
+            )
+        else:
+            out = jnp.clip(activations.resolve(activation)(preout), _EPS, 1.0 - _EPS)
+            per = -jnp.sum(labels * jnp.log(out) + (1.0 - labels) * jnp.log(1.0 - out), axis=-1)
+    elif key == LossFunction.RECONSTRUCTION_CROSSENTROPY.value:
+        out = jnp.clip(activations.resolve(activation)(preout), _EPS, 1.0 - _EPS)
+        per = -jnp.sum(labels * jnp.log(out) + (1.0 - labels) * jnp.log(1.0 - out), axis=-1)
+    elif key in (LossFunction.MSE.value, LossFunction.SQUARED_LOSS.value, LossFunction.L2.value):
+        out = activations.resolve(activation)(preout)
+        per = jnp.sum((out - labels) ** 2, axis=-1)
+        if key == LossFunction.MSE.value:
+            per = per / labels.shape[-1]
+    elif key in (LossFunction.L1.value, LossFunction.MEAN_ABSOLUTE_ERROR.value):
+        out = activations.resolve(activation)(preout)
+        per = jnp.sum(jnp.abs(out - labels), axis=-1)
+        if key == LossFunction.MEAN_ABSOLUTE_ERROR.value:
+            per = per / labels.shape[-1]
+    elif key == LossFunction.MEAN_ABSOLUTE_PERCENTAGE_ERROR.value:
+        out = activations.resolve(activation)(preout)
+        per = 100.0 * jnp.mean(jnp.abs((labels - out) / jnp.where(jnp.abs(labels) < _EPS, _EPS, labels)), axis=-1)
+    elif key == LossFunction.MEAN_SQUARED_LOGARITHMIC_ERROR.value:
+        out = activations.resolve(activation)(preout)
+        per = jnp.mean((jnp.log1p(jnp.maximum(out, -1 + _EPS)) - jnp.log1p(jnp.maximum(labels, -1 + _EPS))) ** 2, axis=-1)
+    elif key == LossFunction.COSINE_PROXIMITY.value:
+        out = activations.resolve(activation)(preout)
+        num = jnp.sum(labels * out, axis=-1)
+        den = jnp.linalg.norm(labels, axis=-1) * jnp.linalg.norm(out, axis=-1)
+        per = -num / jnp.maximum(den, _EPS)
+    elif key == LossFunction.HINGE.value:
+        out = activations.resolve(activation)(preout)
+        per = jnp.sum(jnp.maximum(0.0, 1.0 - labels * out), axis=-1)
+    elif key == LossFunction.SQUARED_HINGE.value:
+        out = activations.resolve(activation)(preout)
+        per = jnp.sum(jnp.maximum(0.0, 1.0 - labels * out) ** 2, axis=-1)
+    elif key == LossFunction.KL_DIVERGENCE.value:
+        out = jnp.clip(activations.resolve(activation)(preout), _EPS, 1.0)
+        lab = jnp.clip(labels, _EPS, 1.0)
+        per = jnp.sum(lab * (jnp.log(lab) - jnp.log(out)), axis=-1)
+    elif key == LossFunction.POISSON.value:
+        out = jnp.clip(activations.resolve(activation)(preout), _EPS, None)
+        per = jnp.sum(out - labels * jnp.log(out), axis=-1)
+    elif key == LossFunction.RMSE_XENT.value:
+        out = jnp.clip(activations.resolve(activation)(preout), _EPS, 1.0 - _EPS)
+        xent = -(labels * jnp.log(out) + (1.0 - labels) * jnp.log(1.0 - out))
+        per = jnp.sqrt(jnp.sum(xent ** 2, axis=-1))
+    else:
+        raise ValueError(f"Unknown loss function: {loss!r}")
+
+    if mask is not None:
+        per = per * mask
+    return per
+
+
+def score(
+    loss: Union[str, LossFunction],
+    labels: jnp.ndarray,
+    preout: jnp.ndarray,
+    activation: Union[str, Activation, None] = Activation.IDENTITY,
+    mask: Optional[jnp.ndarray] = None,
+    average: bool = True,
+) -> jnp.ndarray:
+    """Scalar score: per-example losses reduced over the batch (and time).
+
+    Reference semantics (`BaseOutputLayer.computeScore`): sum of per-example
+    losses divided by minibatch size when `average`. With a time mask, the
+    divisor is the number of *unmasked* (batch, time) entries, matching the
+    reference's masked score normalization.
+    """
+    per = compute_per_example(loss, labels, preout, activation, mask)
+    total = jnp.sum(per)
+    if not average:
+        return total
+    if mask is not None:
+        denom = jnp.maximum(jnp.sum(mask), 1.0)
+    elif per.ndim >= 2:
+        denom = float(per.shape[0] * per.shape[1])
+    else:
+        denom = float(per.shape[0])
+    return total / denom

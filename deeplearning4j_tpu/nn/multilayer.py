@@ -1,0 +1,542 @@
+"""MultiLayerNetwork: the sequential network engine.
+
+Equivalent of the reference's `nn/multilayer/MultiLayerNetwork.java` (2527 LoC)
+— but where the reference is a mutable object graph dispatching per-op kernels,
+this engine compiles the whole model into pure jitted programs:
+
+- `init()` builds the params/state pytrees (the reference's flattened param
+  view `:384-473` is available via `params()`/`set_params()` for checkpoint
+  parity, but the pytree is the source of truth);
+- `fit()` drives one jitted `train_step` per minibatch: forward + loss +
+  autodiff backward + gradient normalization + updater + param update all fuse
+  into a single XLA executable with donated buffers (the reference's
+  Solver/StochasticGradientDescent/updater/stepFunction stack,
+  `optimize/solvers/StochasticGradientDescent.java:51-72`, collapses into it);
+- truncated BPTT (`doTruncatedBPTT:1138`) = chunked scan with state carried
+  across chunks as data (gradient truncation falls out of step boundaries);
+- `rnn_time_step` (`:2230`) = same forward with persistent hidden state.
+"""
+
+from __future__ import annotations
+
+import copy
+import math
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn import activations as activations_mod
+from deeplearning4j_tpu.nn import losses as losses_mod
+from deeplearning4j_tpu.nn import params as params_mod
+from deeplearning4j_tpu.nn.conf.enums import BackpropType, LossFunction
+from deeplearning4j_tpu.nn.conf.layers import CenterLossOutputLayer
+from deeplearning4j_tpu.nn.conf.neural_net import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.layers import OUTPUT_LAYER_TYPES, get_impl
+from deeplearning4j_tpu.ops import grad_norm as grad_norm_mod
+from deeplearning4j_tpu.ops import schedules as schedules_mod
+from deeplearning4j_tpu.ops import updaters as updaters_mod
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+
+def _as_dataset(data, labels=None) -> DataSet:
+    if isinstance(data, DataSet):
+        return data
+    return DataSet(np.asarray(data), None if labels is None else np.asarray(labels))
+
+
+class MultiLayerNetwork:
+    """Sequential network engine (see module docstring)."""
+
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.layers = conf.layers
+        self.layer_keys = [f"layer_{i}" for i in range(len(conf.layers))]
+        self.params_tree: Optional[Dict[str, Dict[str, jnp.ndarray]]] = None
+        self.state: Dict[str, Dict[str, jnp.ndarray]] = {}
+        self.opt_state: Optional[Dict[str, Any]] = None
+        self.iteration = 0
+        self.epoch = 0
+        self.score_value = float("nan")
+        self.listeners: List[Any] = []
+        self._rnn_state: Dict[str, Dict[str, jnp.ndarray]] = {}
+        self._initialized = False
+        self._compute_dtype = {
+            "bfloat16": jnp.bfloat16, "float64": jnp.float64,
+        }.get(conf.global_conf.dtype, jnp.float32)
+        self._loss_dtype = (
+            jnp.float64 if conf.global_conf.dtype == "float64" else jnp.float32
+        )
+        self._jit_cache: Dict[Any, Any] = {}
+
+    # ------------------------------------------------------------------ init
+
+    def init(self, params: Optional[Dict[str, Dict[str, jnp.ndarray]]] = None) -> "MultiLayerNetwork":
+        g = self.conf.global_conf
+        root = jax.random.PRNGKey(g.seed)
+        pdt = jnp.float64 if g.dtype == "float64" else jnp.float32
+        keys = jax.random.split(root, max(len(self.layers), 1))
+        if params is None:
+            params = {
+                lk: params_mod.init_layer_params(layer, keys[i], dtype=pdt)
+                for i, (lk, layer) in enumerate(zip(self.layer_keys, self.layers))
+            }
+        self.params_tree = params
+        self.state = {
+            lk: params_mod.init_layer_state(layer, dtype=pdt)
+            for lk, layer in zip(self.layer_keys, self.layers)
+            if layer.state_shapes()
+        }
+        self._updaters = [
+            updaters_mod.create(
+                layer.updater,
+                momentum=layer.momentum if layer.momentum is not None else g.momentum,
+                adam_mean_decay=layer.adam_mean_decay if layer.adam_mean_decay is not None else g.adam_mean_decay,
+                adam_var_decay=layer.adam_var_decay if layer.adam_var_decay is not None else g.adam_var_decay,
+                rho=layer.rho if layer.rho is not None else g.rho,
+                rms_decay=layer.rms_decay if layer.rms_decay is not None else g.rms_decay,
+                epsilon=layer.epsilon if layer.epsilon is not None else g.epsilon,
+            )
+            for layer in self.layers
+        ]
+        self._schedules = [
+            schedules_mod.make_schedule(
+                float(layer.learning_rate if layer.learning_rate is not None else g.learning_rate),
+                g.lr_policy, g.lr_policy_decay_rate, g.lr_policy_power,
+                g.lr_policy_steps, g.max_num_iterations, g.lr_schedule,
+            )
+            for layer in self.layers
+        ]
+        self.opt_state = {
+            lk: self._updaters[i].init(self.params_tree[lk])
+            for i, lk in enumerate(self.layer_keys)
+        }
+        self._train_rng = jax.random.PRNGKey(g.seed ^ 0x5EED)
+        self._initialized = True
+        return self
+
+    # --------------------------------------------------------------- forward
+
+    def _forward_fn(self, params, state, x, rng, train: bool, fmask,
+                    upto: Optional[int] = None, collect: bool = False,
+                    keep_rnn_state: bool = False):
+        """Pure forward pass (traced). Returns (final, new_state, activations, aux)."""
+        cdt = self._compute_dtype
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+            x = jnp.asarray(x, cdt)
+        mask = fmask
+        new_state: Dict[str, Any] = {}
+        acts: List[jnp.ndarray] = []
+        aux: Dict[str, Any] = {}
+        n = len(self.layers) if upto is None else upto
+        for i in range(n):
+            layer = self.layers[i]
+            lk = self.layer_keys[i]
+            if i in self.conf.input_preprocessors:
+                x, mask = self.conf.input_preprocessors[i](x, mask)
+            if isinstance(layer, CenterLossOutputLayer):
+                aux["center_loss_input"] = x
+                aux["centers"] = state.get(lk, {}).get("centers")
+            lrng = jax.random.fold_in(rng, i) if rng is not None else None
+            lparams = jax.tree_util.tree_map(lambda a: a.astype(cdt) if jnp.issubdtype(a.dtype, jnp.floating) else a,
+                                             params.get(lk, {}))
+            lstate = state.get(lk, {})
+            x, lstate_new, mask = get_impl(layer)(
+                layer, lparams, lstate, x, rng=lrng, train=train, mask=mask
+            )
+            if lstate_new:
+                # Only persist what the layer declares (BN stats) unless the
+                # caller wants rnn hidden state carried (tbptt / rnn_time_step).
+                declared = set(layer.state_shapes())
+                keep = {k: v for k, v in lstate_new.items()
+                        if k in declared or keep_rnn_state}
+                if keep:
+                    new_state[lk] = keep
+            if collect:
+                acts.append(x)
+        return x, new_state, acts, aux
+
+    def _output_activation(self, preout):
+        layer = self.layers[-1]
+        if type(layer).__name__ in OUTPUT_LAYER_TYPES:
+            return activations_mod.resolve(layer.activation)(preout)
+        return preout
+
+    def _get_jit(self, kind: str, **static):
+        key = (kind, tuple(sorted(static.items())))
+        if key in self._jit_cache:
+            return self._jit_cache[key]
+        fn = self._build_jit(kind, **static)
+        self._jit_cache[key] = fn
+        return fn
+
+    def _build_jit(self, kind: str, train=False, keep_rnn_state=False, with_aux=False):
+        if kind == "output":
+            def output_fn(params, state, x, fmask, rng):
+                final, new_state, _, _ = self._forward_fn(
+                    params, state, x, rng, train, fmask, keep_rnn_state=keep_rnn_state
+                )
+                out = self._output_activation(final.astype(self._loss_dtype))
+                return out, new_state
+            return jax.jit(output_fn)
+        if kind == "score":
+            def score_fn(params, state, x, y, fmask, lmask):
+                preout, _, _, aux = self._forward_fn(params, state, x, None, False, fmask)
+                return self._loss_from_preout(params, preout, y, lmask, aux)[0]
+            return jax.jit(score_fn)
+        if kind == "train_step":
+            def step_plain(params, state, opt_state, x, y, fmask, lmask, step, rng):
+                return self._train_step(params, state, opt_state, x, y, fmask,
+                                        lmask, step, rng, carry_rnn=False)
+            return jax.jit(step_plain, donate_argnums=(0, 2))
+        if kind == "train_step_tbptt":
+            def step_tbptt(params, state, opt_state, x, y, fmask, lmask, step, rng):
+                return self._train_step(params, state, opt_state, x, y, fmask,
+                                        lmask, step, rng, carry_rnn=True)
+            return jax.jit(step_tbptt, donate_argnums=(0, 2))
+        if kind == "feedforward":
+            def ff_fn(params, state, x, fmask, rng):
+                _, new_state, acts, _ = self._forward_fn(
+                    params, state, x, rng, train, fmask, collect=True
+                )
+                return acts, new_state
+            return jax.jit(ff_fn)
+        raise ValueError(kind)
+
+    # ----------------------------------------------------------------- loss
+
+    def _l1_l2_penalty(self, params):
+        """L1/L2 terms added at score time (reference: `Layer.calcL1/calcL2`,
+        score semantics SURVEY.md §2.4). Applied to weight params only."""
+        total = 0.0
+        for lk, layer in zip(self.layer_keys, self.layers):
+            l1 = float(layer.l1 or 0.0)
+            l2 = float(layer.l2 or 0.0)
+            if (l1 == 0.0 and l2 == 0.0) or lk not in params:
+                continue
+            for wk in layer.weight_param_keys():
+                if wk not in params[lk]:
+                    continue
+                w = params[lk][wk].astype(self._loss_dtype)
+                if l2:
+                    total = total + 0.5 * l2 * jnp.sum(w * w)
+                if l1:
+                    total = total + l1 * jnp.sum(jnp.abs(w))
+        return total
+
+    def _loss_from_preout(self, params, preout, y, lmask, aux):
+        layer = self.layers[-1]
+        name = type(layer).__name__
+        if name not in OUTPUT_LAYER_TYPES:
+            raise ValueError(
+                f"Last layer ({name}) is not an output layer; cannot compute loss"
+            )
+        preout = preout.astype(self._loss_dtype)
+        data_loss = losses_mod.score(
+            layer.loss_function, y, preout, layer.activation, lmask
+        )
+        extra_state = {}
+        if isinstance(layer, CenterLossOutputLayer):
+            feats = aux["center_loss_input"].astype(self._loss_dtype)
+            centers = aux["centers"]
+            cls = jnp.argmax(y, axis=-1)
+            c = centers[cls]
+            data_loss = data_loss + 0.5 * layer.lambda_ * jnp.mean(
+                jnp.sum((feats - c) ** 2, axis=-1)
+            )
+            # EMA center update (reference: CenterLossOutputLayer center updates)
+            diff = c - feats
+            num = jax.ops.segment_sum(diff, cls, num_segments=layer.n_out)
+            cnt = jax.ops.segment_sum(jnp.ones_like(cls, jnp.float32), cls,
+                                      num_segments=layer.n_out)
+            new_centers = centers - layer.alpha * num / (1.0 + cnt)[:, None]
+            extra_state = {self.layer_keys[-1]: {"centers": new_centers}}
+        return data_loss + self._l1_l2_penalty(params), extra_state
+
+    # ----------------------------------------------------------- train step
+
+    def _train_step(self, params, state, opt_state, x, y, fmask, lmask, step, rng,
+                    carry_rnn=False):
+        def loss_fn(p):
+            preout, new_state, _, aux = self._forward_fn(
+                p, state, x, rng, True, fmask, keep_rnn_state=carry_rnn
+            )
+            loss, extra_state = self._loss_from_preout(p, preout, y, lmask, aux)
+            for lk, s in extra_state.items():
+                new_state.setdefault(lk, {}).update(s)
+            return loss, new_state
+
+        (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+
+        g = self.conf.global_conf
+        sign = 1.0 if g.minimize else -1.0
+        new_params: Dict[str, Any] = {}
+        new_opt: Dict[str, Any] = {}
+        for i, (lk, layer) in enumerate(zip(self.layer_keys, self.layers)):
+            lgrads = grads.get(lk, {})
+            if not lgrads:
+                new_params[lk] = params.get(lk, {})
+                new_opt[lk] = opt_state.get(lk, ())
+                continue
+            lgrads = grad_norm_mod.normalize_layer_gradients(
+                lgrads, layer.gradient_normalization,
+                float(layer.gradient_normalization_threshold or 1.0),
+            )
+            lr = self._schedules[i](step)
+            st, deltas = self._updaters[i].update(opt_state[lk], lgrads, lr, step)
+            base_lr = float(layer.learning_rate if layer.learning_rate is not None else g.learning_rate)
+            bias_lr = float(layer.bias_learning_rate if layer.bias_learning_rate is not None else base_lr)
+            if bias_lr != base_lr and base_lr != 0.0:
+                factor = bias_lr / base_lr
+                deltas = {k: (d * factor if k in ("b",) else d) for k, d in deltas.items()}
+            new_params[lk] = {
+                k: params[lk][k] - sign * deltas[k] for k in params[lk]
+            }
+            new_opt[lk] = st
+        # Merge persistent-state updates (BN stats / rnn carries) over old state.
+        merged_state = dict(state)
+        for lk, s in new_state.items():
+            merged = dict(merged_state.get(lk, {}))
+            merged.update(s)
+            merged_state[lk] = merged
+        return new_params, merged_state, new_opt, loss
+
+    # ------------------------------------------------------------------ fit
+
+    def fit(self, data, labels=None):
+        """Train over an iterator/DataSet/(x, y) pair — one pass
+        (reference: `MultiLayerNetwork.fit(DataSetIterator)` `:976`)."""
+        if not self._initialized:
+            self.init()
+        if labels is not None or isinstance(data, DataSet):
+            iterator = [_as_dataset(data, labels)]
+        else:
+            iterator = data
+        if hasattr(iterator, "reset"):
+            try:
+                iterator.reset()
+            except Exception:
+                pass
+
+        g = self.conf.global_conf
+        tbptt = BackpropType.of(self.conf.backprop_type) == BackpropType.TRUNCATED_BPTT
+        for ds in iterator:
+            for _ in range(max(1, g.iterations)):
+                if tbptt and ds.features.ndim == 3 and ds.features.shape[1] > self.conf.tbptt_fwd_length:
+                    self._fit_tbptt(ds)
+                else:
+                    self._fit_one(ds)
+        self.epoch += 1
+        return self
+
+    def _next_rng(self):
+        self._train_rng, sub = jax.random.split(self._train_rng)
+        return sub
+
+    def _fit_one(self, ds: DataSet):
+        step_fn = self._get_jit("train_step")
+        step = jnp.asarray(self.iteration, jnp.float32)
+        self.params_tree, self.state, self.opt_state, loss = step_fn(
+            self.params_tree, self.state, self.opt_state,
+            jnp.asarray(ds.features),
+            jnp.asarray(ds.labels),
+            None if ds.features_mask is None else jnp.asarray(ds.features_mask),
+            None if ds.labels_mask is None else jnp.asarray(ds.labels_mask),
+            step, self._next_rng(),
+        )
+        self.score_value = float(loss)
+        self.iteration += 1
+        for listener in self.listeners:
+            listener.iteration_done(self, self.iteration)
+
+    def _fit_tbptt(self, ds: DataSet):
+        """Truncated BPTT (reference: `doTruncatedBPTT:1138`): chunk the time
+        axis; rnn state carries across chunks as data (implicit gradient
+        truncation at chunk boundaries)."""
+        fwd = self.conf.tbptt_fwd_length
+        t = ds.features.shape[1]
+        n_chunks = math.ceil(t / fwd)
+        saved_state = self.state
+        for ci in range(n_chunks):
+            sl = slice(ci * fwd, min((ci + 1) * fwd, t))
+            if ds.labels is None or ds.labels.ndim != 3:
+                raise ValueError(
+                    "Truncated BPTT requires 3-D per-timestep labels [b, t, c] "
+                    "(reference doTruncatedBPTT semantics)"
+                )
+            chunk = DataSet(
+                ds.features[:, sl],
+                ds.labels[:, sl],
+                ds.features_mask[:, sl] if ds.features_mask is not None else None,
+                ds.labels_mask[:, sl] if ds.labels_mask is not None else None,
+            )
+            step_fn = self._get_jit("train_step_tbptt")
+            step = jnp.asarray(self.iteration, jnp.float32)
+            self.params_tree, self.state, self.opt_state, loss = step_fn(
+                self.params_tree, self.state, self.opt_state,
+                jnp.asarray(chunk.features),
+                jnp.asarray(chunk.labels),
+                None if chunk.features_mask is None else jnp.asarray(chunk.features_mask),
+                None if chunk.labels_mask is None else jnp.asarray(chunk.labels_mask),
+                step, self._next_rng(),
+            )
+            self.score_value = float(loss)
+        # Reset rnn carries after the sequence; keep persistent (BN) state.
+        self.state = {
+            lk: {k: v for k, v in s.items() if k in dict(self._declared_state()).get(lk, ())}
+            for lk, s in self.state.items()
+        }
+        self.state = {lk: s for lk, s in self.state.items() if s}
+        # Restore any BN stats that were present before if lost (safety).
+        for lk, s in saved_state.items():
+            self.state.setdefault(lk, s)
+        self.iteration += 1
+        for listener in self.listeners:
+            listener.iteration_done(self, self.iteration)
+
+    def _declared_state(self):
+        return {
+            lk: tuple(layer.state_shapes())
+            for lk, layer in zip(self.layer_keys, self.layers)
+        }
+
+    # -------------------------------------------------------------- predict
+
+    def output(self, x, train: bool = False, features_mask=None) -> np.ndarray:
+        """Inference forward (reference: `output()` `:1519-1601`)."""
+        fn = self._get_jit("output", train=train)
+        out, _ = fn(self.params_tree, self.state, jnp.asarray(x),
+                    None if features_mask is None else jnp.asarray(features_mask),
+                    self._next_rng() if train else jax.random.PRNGKey(0))
+        return np.asarray(out)
+
+    def feed_forward(self, x, train: bool = False, features_mask=None) -> List[np.ndarray]:
+        """All layer activations (reference: `feedForward()` `:655-760`).
+        Note: for output layers the listed activation is the pre-activation."""
+        fn = self._get_jit("feedforward", train=train)
+        acts, _ = fn(self.params_tree, self.state, jnp.asarray(x),
+                     None if features_mask is None else jnp.asarray(features_mask),
+                     self._next_rng() if train else jax.random.PRNGKey(0))
+        return [np.asarray(a) for a in acts]
+
+    def predict(self, x) -> np.ndarray:
+        return np.argmax(self.output(x), axis=-1)
+
+    def score(self, data: Union[DataSet, tuple], labels=None) -> float:
+        """Loss on a dataset without updating (reference: `score(DataSet)`)."""
+        ds = _as_dataset(data, labels)
+        fn = self._get_jit("score")
+        return float(fn(
+            self.params_tree, self.state,
+            jnp.asarray(ds.features), jnp.asarray(ds.labels),
+            None if ds.features_mask is None else jnp.asarray(ds.features_mask),
+            None if ds.labels_mask is None else jnp.asarray(ds.labels_mask),
+        ))
+
+    # ----------------------------------------------------------------- rnn
+
+    def rnn_time_step(self, x) -> np.ndarray:
+        """Stateful single/multi-step inference (reference: `rnnTimeStep:2230`).
+        Accepts [b, f] (one step) or [b, t, f]; hidden state persists across calls."""
+        x = np.asarray(x)
+        squeeze = x.ndim == 2
+        if squeeze:
+            x = x[:, None, :]
+        fn = self._get_jit("output", train=False, keep_rnn_state=True)
+        state = dict(self.state)
+        for lk, s in self._rnn_state.items():
+            merged = dict(state.get(lk, {}))
+            merged.update(s)
+            state[lk] = merged
+        out, new_state = fn(self.params_tree, state, jnp.asarray(x), None,
+                            jax.random.PRNGKey(0))
+        declared = self._declared_state()
+        self._rnn_state = {
+            lk: {k: v for k, v in s.items() if k not in dict(declared).get(lk, ())}
+            for lk, s in new_state.items()
+        }
+        self._rnn_state = {lk: s for lk, s in self._rnn_state.items() if s}
+        out = np.asarray(out)
+        return out[:, 0] if squeeze and out.ndim == 3 else out
+
+    def rnn_clear_previous_state(self):
+        self._rnn_state = {}
+
+    # ------------------------------------------------------------ eval misc
+
+    def evaluate(self, iterator, top_n: int = 1):
+        """Classification evaluation (reference: `evaluate(DataSetIterator)`
+        `:2406-2506`)."""
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+
+        ev = Evaluation(top_n=top_n)
+        if hasattr(iterator, "reset"):
+            try:
+                iterator.reset()
+            except Exception:
+                pass
+        if isinstance(iterator, DataSet):
+            iterator = [iterator]
+        for ds in iterator:
+            out = self.output(ds.features, features_mask=ds.features_mask)
+            ev.eval(ds.labels, out, mask=ds.labels_mask)
+        return ev
+
+    # ------------------------------------------------------------- params io
+
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+        return self
+
+    def num_params(self) -> int:
+        return int(sum(params_mod.num_params(l) for l in self.layers))
+
+    def _param_orders(self):
+        return {
+            lk: list(layer.param_shapes())
+            for lk, layer in zip(self.layer_keys, self.layers)
+        }
+
+    def params(self) -> np.ndarray:
+        """Flattened 1-D param view (reference: `Model.params()`)."""
+        return params_mod.flatten_params(self.params_tree, self.layer_keys, self._param_orders())
+
+    def set_params(self, flat: np.ndarray):
+        self.params_tree = params_mod.unflatten_params(
+            np.asarray(flat), self.params_tree, self.layer_keys, self._param_orders()
+        )
+
+    def updater_state_flat(self) -> np.ndarray:
+        leaves = jax.tree_util.tree_leaves(self.opt_state)
+        if not leaves:
+            return np.zeros((0,), np.float32)
+        return np.concatenate([np.asarray(l).reshape(-1) for l in leaves])
+
+    def set_updater_state_flat(self, flat: np.ndarray):
+        leaves, treedef = jax.tree_util.tree_flatten(self.opt_state)
+        out, pos = [], 0
+        for l in leaves:
+            n = int(np.prod(l.shape))
+            out.append(jnp.asarray(np.asarray(flat[pos:pos + n]).reshape(l.shape), l.dtype))
+            pos += n
+        self.opt_state = jax.tree_util.tree_unflatten(treedef, out)
+
+    def clone(self) -> "MultiLayerNetwork":
+        net = MultiLayerNetwork(copy.deepcopy(self.conf))
+        if self._initialized:
+            net.init(params=jax.tree_util.tree_map(lambda a: a, self.params_tree))
+            net.state = jax.tree_util.tree_map(lambda a: a, self.state)
+        return net
+
+    def summary(self) -> str:
+        lines = ["=" * 70]
+        lines.append(f"{'Layer':<28}{'Type':<24}{'Params':>10}")
+        lines.append("-" * 70)
+        for lk, layer in zip(self.layer_keys, self.layers):
+            lines.append(f"{lk:<28}{type(layer).__name__:<24}{params_mod.num_params(layer):>10}")
+        lines.append("-" * 70)
+        lines.append(f"Total params: {self.num_params()}")
+        lines.append("=" * 70)
+        return "\n".join(lines)

@@ -1,0 +1,131 @@
+"""Parameter initialization and flat-view mapping.
+
+Equivalent of the reference's `nn/params/*ParamInitializer` family plus the
+flat param view machinery of `MultiLayerNetwork.init():384-473`: params live in
+a pytree `{layer_key: {param_name: array}}`; `flatten`/`unflatten` provide the
+reference's contiguous 1-D view (deterministic order: layer order, then the
+layer's declared `param_shapes()` order) for checkpoint compat and
+parameter-averaging-style interop.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.enums import WeightInit
+from deeplearning4j_tpu.nn.conf.layers import (
+    BatchNormalization,
+    ConvolutionLayer,
+    GravesBidirectionalLSTM,
+    GravesLSTM,
+    LSTM,
+    Layer,
+    VariationalAutoencoder,
+    is_bias_param,
+)
+from deeplearning4j_tpu.nn.weights import init_weights
+
+
+def _fans(conf: Layer, name: str, shape: Tuple[int, ...]) -> Tuple[float, float]:
+    """Fan-in/out per param, following the reference's initializer conventions."""
+    if isinstance(conf, ConvolutionLayer) and name == "W":
+        kh, kw, cin, cout = shape
+        return (cin * kh * kw, cout * kh * kw)
+    if len(shape) >= 2:
+        return (shape[0], shape[1])
+    return (shape[0], shape[0])
+
+
+def init_layer_params(conf: Layer, rng: jax.Array, dtype=jnp.float32) -> Dict[str, jnp.ndarray]:
+    """Initialize one layer's params from its config (weight-init scheme, bias
+    init, LSTM forget-gate bias, BN gamma/beta constants)."""
+    shapes = conf.param_shapes()
+    if not shapes:
+        return {}
+    params: Dict[str, jnp.ndarray] = {}
+    keys = jax.random.split(rng, len(shapes))
+    bias_init = float(getattr(conf, "bias_init", 0.0) or 0.0)
+
+    for key, (name, shape) in zip(keys, shapes.items()):
+        if isinstance(conf, BatchNormalization):
+            if name == "gamma":
+                params[name] = jnp.full(shape, conf.gamma, dtype)
+            else:
+                params[name] = jnp.full(shape, conf.beta, dtype)
+            continue
+        is_bias = is_bias_param(name) and name != "beta"
+        is_peephole = name.startswith("pW")
+        if is_bias:
+            arr = jnp.full(shape, bias_init, dtype)
+            if isinstance(conf, (GravesLSTM, LSTM, GravesBidirectionalLSTM)) and name.startswith("b"):
+                # Forget-gate bias init (reference: LSTMParamInitializer; gate
+                # order i,f,o,g -> forget block is [n_out, 2*n_out)).
+                n_out = conf.n_out
+                arr = arr.at[n_out : 2 * n_out].set(conf.forget_gate_bias_init)
+            params[name] = arr
+        elif is_peephole:
+            params[name] = jnp.zeros(shape, dtype)
+        else:
+            fan_in, fan_out = _fans(conf, name, shape)
+            if isinstance(conf, (GravesLSTM, LSTM, GravesBidirectionalLSTM)):
+                # Reference inits LSTM weight blocks with fan sizes nIn/nOut
+                # (not the 4x packed dims).
+                fan_in = conf.n_in if name.startswith("W") else conf.n_out
+                fan_out = conf.n_out
+            if isinstance(conf, VariationalAutoencoder):
+                fan_in, fan_out = shape[0], shape[1]
+            params[name] = init_weights(
+                key, shape, fan_in, fan_out,
+                scheme=WeightInit.of(conf.weight_init) or WeightInit.XAVIER,
+                distribution=conf.dist, dtype=dtype,
+            )
+    return params
+
+
+def init_layer_state(conf: Layer, dtype=jnp.float32) -> Dict[str, jnp.ndarray]:
+    state = {}
+    for name, shape in conf.state_shapes().items():
+        if isinstance(conf, BatchNormalization) and name == "var":
+            state[name] = jnp.ones(shape, dtype)
+        else:
+            state[name] = jnp.zeros(shape, dtype)
+    return state
+
+
+def num_params(conf: Layer) -> int:
+    return int(sum(np.prod(s) for s in conf.param_shapes().values()))
+
+
+def flatten_params(params: Dict[str, Dict[str, jnp.ndarray]], layer_keys: List[str],
+                   param_orders: Dict[str, List[str]]) -> np.ndarray:
+    """Flatten to the reference-style contiguous 1-D view (c-order per param)."""
+    chunks = []
+    for lk in layer_keys:
+        for pn in param_orders[lk]:
+            chunks.append(np.asarray(params[lk][pn]).reshape(-1))
+    if not chunks:
+        return np.zeros((0,), np.float32)
+    return np.concatenate(chunks)
+
+
+def unflatten_params(flat: np.ndarray, template: Dict[str, Dict[str, jnp.ndarray]],
+                     layer_keys: List[str], param_orders: Dict[str, List[str]]):
+    """Inverse of `flatten_params`, shaped like `template`."""
+    out: Dict[str, Dict[str, jnp.ndarray]] = {}
+    pos = 0
+    for lk in layer_keys:
+        out[lk] = {}
+        for pn in param_orders[lk]:
+            ref = template[lk][pn]
+            n = int(np.prod(ref.shape))
+            out[lk][pn] = jnp.asarray(
+                np.asarray(flat[pos : pos + n]).reshape(ref.shape), ref.dtype
+            )
+            pos += n
+    if pos != flat.size:
+        raise ValueError(f"Flat param length {flat.size} != expected {pos}")
+    return out

@@ -1,0 +1,81 @@
+"""Weight initialization schemes.
+
+TPU-native equivalent of the reference's `nn/weights/WeightInit.java` +
+`nn/weights/WeightInitUtil.java`: given a scheme, fan-in/fan-out, and a JAX PRNG
+key, produce an initial weight array. Fan values follow the reference's
+convention (dense: fanIn = nIn, fanOut = nOut; conv: fanIn = inDepth*kH*kW,
+fanOut = outDepth*kH*kW).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.distributions import Distribution
+from deeplearning4j_tpu.nn.conf.enums import WeightInit
+
+
+def init_weights(
+    rng: jax.Array,
+    shape: tuple,
+    fan_in: float,
+    fan_out: float,
+    scheme: WeightInit = WeightInit.XAVIER,
+    distribution: Optional[Distribution] = None,
+    dtype=jnp.float32,
+) -> jnp.ndarray:
+    scheme = WeightInit.of(scheme) or WeightInit.XAVIER
+    if scheme == WeightInit.ZERO:
+        return jnp.zeros(shape, dtype)
+    if scheme == WeightInit.ONES:
+        return jnp.ones(shape, dtype)
+    if scheme == WeightInit.IDENTITY:
+        if len(shape) != 2 or shape[0] != shape[1]:
+            raise ValueError("WeightInit.IDENTITY requires a square 2-D shape")
+        return jnp.eye(shape[0], dtype=dtype)
+    if scheme == WeightInit.DISTRIBUTION:
+        if distribution is None:
+            raise ValueError("WeightInit.DISTRIBUTION requires a distribution")
+        return distribution.sample(rng, shape, dtype)
+    if scheme == WeightInit.UNIFORM:
+        a = 1.0 / math.sqrt(max(fan_in, 1.0))
+        return jax.random.uniform(rng, shape, dtype, minval=-a, maxval=a)
+    if scheme == WeightInit.XAVIER:
+        return jax.random.normal(rng, shape, dtype) * math.sqrt(2.0 / (fan_in + fan_out))
+    if scheme == WeightInit.XAVIER_UNIFORM:
+        a = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(rng, shape, dtype, minval=-a, maxval=a)
+    if scheme == WeightInit.XAVIER_FAN_IN:
+        return jax.random.normal(rng, shape, dtype) / math.sqrt(fan_in)
+    if scheme == WeightInit.XAVIER_LEGACY:
+        # Reference legacy variant: randn / sqrt(shape[0] + shape[1])
+        denom = math.sqrt(sum(shape[:2]) if len(shape) >= 2 else shape[0])
+        return jax.random.normal(rng, shape, dtype) / denom
+    if scheme == WeightInit.RELU:
+        return jax.random.normal(rng, shape, dtype) * math.sqrt(2.0 / fan_in)
+    if scheme == WeightInit.RELU_UNIFORM:
+        a = math.sqrt(6.0 / fan_in)
+        return jax.random.uniform(rng, shape, dtype, minval=-a, maxval=a)
+    if scheme == WeightInit.SIGMOID_UNIFORM:
+        a = 4.0 * math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(rng, shape, dtype, minval=-a, maxval=a)
+    if scheme == WeightInit.LECUN_NORMAL:
+        return jax.random.normal(rng, shape, dtype) * math.sqrt(1.0 / fan_in)
+    if scheme == WeightInit.LECUN_UNIFORM:
+        a = math.sqrt(3.0 / fan_in)
+        return jax.random.uniform(rng, shape, dtype, minval=-a, maxval=a)
+    if scheme == WeightInit.NORMALIZED:
+        # Reference legacy: (U[0,1) - 0.5) / shape[0]
+        return (jax.random.uniform(rng, shape, dtype) - 0.5) / shape[0]
+    if scheme == WeightInit.SIZE:
+        a = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(rng, shape, dtype, minval=-a, maxval=a)
+    if scheme == WeightInit.VI:
+        # Reference legacy variance-normalized init: zero-centered uniform [-a, a]
+        a = math.sqrt(6.0 / (sum(shape[:2]) if len(shape) >= 2 else shape[0] + 1))
+        return jax.random.uniform(rng, shape, dtype, minval=-a, maxval=a)
+    raise ValueError(f"Unknown weight init scheme: {scheme!r}")

@@ -1,0 +1,48 @@
+"""Gradient normalization and clipping.
+
+Equivalent of the reference's `GradientNormalization` modes applied in
+`nn/updater/LayerUpdater.java:181-221` before the updater. Operates on a
+per-layer params pytree: "per layer" reduces over every leaf in the layer's
+subtree; "per param type" treats each leaf independently.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.enums import GradientNormalization
+
+_EPS = 1e-8
+
+
+def _layer_l2(tree) -> jnp.ndarray:
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(l.astype(jnp.float32) ** 2) for l in leaves) + 0.0)
+
+
+def normalize_layer_gradients(grads, mode, threshold: float = 1.0):
+    """Apply one layer's gradient normalization. `grads` is that layer's subtree."""
+    mode = GradientNormalization.of(mode) or GradientNormalization.NONE
+    if mode == GradientNormalization.NONE:
+        return grads
+    if mode == GradientNormalization.RENORMALIZE_L2_PER_LAYER:
+        norm = _layer_l2(grads)
+        return jax.tree_util.tree_map(lambda g: g / (norm + _EPS), grads)
+    if mode == GradientNormalization.RENORMALIZE_L2_PER_PARAM_TYPE:
+        return jax.tree_util.tree_map(
+            lambda g: g / (jnp.linalg.norm(g.reshape(-1)) + _EPS), grads
+        )
+    if mode == GradientNormalization.CLIP_ELEMENT_WISE_ABSOLUTE_VALUE:
+        return jax.tree_util.tree_map(lambda g: jnp.clip(g, -threshold, threshold), grads)
+    if mode == GradientNormalization.CLIP_L2_PER_LAYER:
+        norm = _layer_l2(grads)
+        scale = jnp.where(norm > threshold, threshold / (norm + _EPS), 1.0)
+        return jax.tree_util.tree_map(lambda g: g * scale, grads)
+    if mode == GradientNormalization.CLIP_L2_PER_PARAM_TYPE:
+        def clip_leaf(g):
+            norm = jnp.linalg.norm(g.reshape(-1))
+            return g * jnp.where(norm > threshold, threshold / (norm + _EPS), 1.0)
+
+        return jax.tree_util.tree_map(clip_leaf, grads)
+    raise ValueError(f"Unknown gradient normalization: {mode!r}")

@@ -1,0 +1,59 @@
+"""Learning-rate decay schedules.
+
+Equivalent of the reference's LR policies (`nn/updater/LayerUpdater.java:134-158`,
+`LearningRatePolicy` enum). A schedule is a pure fn(iteration) -> lr multiplier
+applied inside the jitted step, so `iteration` may be a traced scalar.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Mapping, Optional, Union
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.enums import LearningRatePolicy
+
+
+def make_schedule(
+    base_lr: float,
+    policy: Union[str, LearningRatePolicy, None] = None,
+    decay_rate: float = 0.0,
+    power: float = 0.0,
+    steps: float = 1.0,
+    max_iterations: int = 1,
+    schedule_map: Optional[Mapping[int, float]] = None,
+) -> Callable:
+    """Return fn(iteration) -> learning rate (jit-safe)."""
+    p = LearningRatePolicy.of(policy) or LearningRatePolicy.NONE
+
+    if p == LearningRatePolicy.NONE:
+        return lambda it: jnp.asarray(base_lr, jnp.float32)
+    if p == LearningRatePolicy.EXPONENTIAL:
+        return lambda it: base_lr * jnp.power(decay_rate, it.astype(jnp.float32) if hasattr(it, "astype") else float(it))
+    if p == LearningRatePolicy.INVERSE:
+        return lambda it: base_lr / jnp.power(1.0 + decay_rate * it, power)
+    if p == LearningRatePolicy.POLY:
+        return lambda it: base_lr * jnp.power(1.0 - jnp.minimum(it / max_iterations, 1.0), power)
+    if p == LearningRatePolicy.SIGMOID:
+        return lambda it: base_lr / (1.0 + jnp.exp(-decay_rate * (it - steps)))
+    if p == LearningRatePolicy.STEP:
+        return lambda it: base_lr * jnp.power(decay_rate, jnp.floor(it / steps))
+    if p == LearningRatePolicy.TORCH_STEP:
+        return lambda it: base_lr * jnp.power(decay_rate, jnp.floor(it / steps))
+    if p == LearningRatePolicy.SCHEDULE:
+        if not schedule_map:
+            return lambda it: jnp.asarray(base_lr, jnp.float32)
+        # Piecewise-constant: lr = value of the largest key <= iteration.
+        ks = sorted(int(k) for k in schedule_map)
+        boundaries = jnp.asarray(ks, jnp.float32)
+        values = jnp.asarray([base_lr] + [float(schedule_map[k]) for k in ks], jnp.float32)
+
+        def fn(it):
+            idx = jnp.sum(boundaries <= it).astype(jnp.int32)
+            return values[idx]
+
+        return fn
+    if p == LearningRatePolicy.SCORE:
+        # Score-based decay is driven host-side (needs the score); jit side is constant.
+        return lambda it: jnp.asarray(base_lr, jnp.float32)
+    raise ValueError(f"Unknown LR policy: {policy!r}")

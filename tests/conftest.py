@@ -1,0 +1,34 @@
+"""Test configuration.
+
+Tests run on an 8-device virtual CPU mesh (the reference runs its suite
+against a pluggable nd4j backend via Maven profiles, SURVEY.md §4; the TPU
+analog is XLA's host-platform device-count simulation) with x64 enabled so
+gradient checks run in double precision.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(12345)
+
+
+def make_classification_data(rng, n=64, n_features=4, n_classes=3, dtype="float64"):
+    X = rng.randn(n, n_features).astype(dtype)
+    W = rng.randn(n_features, n_classes)
+    y_idx = np.argmax(X @ W + 0.1 * rng.randn(n, n_classes), axis=1)
+    Y = np.eye(n_classes)[y_idx].astype(dtype)
+    return X, Y

@@ -1,0 +1,228 @@
+"""MultiLayerNetwork end-to-end tests (reference analog:
+`deeplearning4j-core/src/test/.../nn/multilayer/MultiLayerTest.java`,
+`BackPropMLPTest.java`)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    ActivationLayer,
+    BatchNormalization,
+    DenseLayer,
+    DropoutLayer,
+    EmbeddingLayer,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.conf.neural_net import MultiLayerConfiguration
+
+from conftest import make_classification_data
+
+
+def mlp_conf(n_in=4, n_out=3, updater="sgd", lr=0.5, **kw):
+    return (NeuralNetConfiguration.builder()
+            .seed(42).learning_rate(lr).updater(updater)
+            .weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=n_out, activation="softmax", loss_function="mcxent"))
+            .set_input_type(InputType.feed_forward(n_in))
+            .build())
+
+
+class TestBuilder:
+    def test_n_in_inference(self):
+        conf = mlp_conf()
+        assert conf.layers[0].n_in == 4
+        assert conf.layers[1].n_in == 16
+
+    def test_global_defaults_merged(self):
+        conf = mlp_conf(updater="adam", lr=0.01)
+        assert conf.layers[0].updater == "adam"
+        assert conf.layers[0].learning_rate == 0.01
+        # per-layer override wins
+        conf2 = (NeuralNetConfiguration.builder().learning_rate(0.5)
+                 .list()
+                 .layer(DenseLayer(n_in=4, n_out=2, learning_rate=0.125))
+                 .layer(OutputLayer(n_out=2))
+                 .build())
+        assert conf2.layers[0].learning_rate == 0.125
+        assert conf2.layers[1].learning_rate == 0.5
+
+    def test_json_roundtrip(self):
+        conf = mlp_conf(updater="adam")
+        conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+        assert conf2.to_json() == conf.to_json()
+        assert conf2.layers[0].n_in == 4
+        assert type(conf2.layers[0]).__name__ == "DenseLayer"
+
+    def test_layer_indexing_styles(self):
+        c1 = (NeuralNetConfiguration.builder().list()
+              .layer(0, DenseLayer(n_in=4, n_out=8))
+              .layer(1, OutputLayer(n_out=3))
+              .build())
+        assert len(c1.layers) == 2
+
+
+class TestTraining:
+    def test_mlp_learns_linearly_separable(self, rng):
+        X, Y = make_classification_data(rng)
+        net = MultiLayerNetwork(mlp_conf(updater="adam", lr=0.05)).init()
+        ds = DataSet(X, Y)
+        s0 = net.score(ds)
+        for _ in range(150):
+            net.fit(ds)
+        assert net.score(ds) < s0 * 0.5
+        assert net.evaluate(ds).accuracy() > 0.9
+
+    def test_score_decreases_all_updaters(self, rng):
+        X, Y = make_classification_data(rng)
+        ds = DataSet(X, Y)
+        for upd in ["sgd", "adam", "nesterovs", "rmsprop", "adagrad"]:
+            net = MultiLayerNetwork(mlp_conf(updater=upd, lr=0.01)).init()
+            s0 = net.score(ds)
+            for _ in range(30):
+                net.fit(ds)
+            assert net.score(ds) < s0, upd
+
+    def test_fit_xy_and_dataset_equivalent(self, rng):
+        X, Y = make_classification_data(rng)
+        n1 = MultiLayerNetwork(mlp_conf()).init()
+        n2 = MultiLayerNetwork(mlp_conf()).init()
+        n1.fit(X, Y)
+        n2.fit(DataSet(X, Y))
+        np.testing.assert_allclose(n1.params(), n2.params(), rtol=1e-6)
+
+    def test_output_shape_and_softmax(self, rng):
+        X, Y = make_classification_data(rng)
+        net = MultiLayerNetwork(mlp_conf()).init()
+        out = net.output(X)
+        assert out.shape == (64, 3)
+        np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)
+
+    def test_predict(self, rng):
+        X, Y = make_classification_data(rng)
+        net = MultiLayerNetwork(mlp_conf()).init()
+        assert net.predict(X).shape == (64,)
+
+    def test_feed_forward_collects_all(self, rng):
+        X, Y = make_classification_data(rng)
+        net = MultiLayerNetwork(mlp_conf()).init()
+        acts = net.feed_forward(X)
+        assert len(acts) == 2
+        assert acts[0].shape == (64, 16)
+        assert acts[1].shape == (64, 3)
+
+    def test_iterations_hyperparam(self, rng):
+        X, Y = make_classification_data(rng)
+        conf = (NeuralNetConfiguration.builder().seed(1).learning_rate(0.1)
+                .iterations(5).list()
+                .layer(DenseLayer(n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_out=3, activation="softmax"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(DataSet(X, Y))
+        assert net.iteration == 5
+
+
+class TestParamsView:
+    def test_flat_roundtrip(self, rng):
+        net = MultiLayerNetwork(mlp_conf()).init()
+        flat = net.params()
+        assert flat.shape == (net.num_params(),)
+        flat2 = flat * 2
+        net.set_params(flat2)
+        np.testing.assert_allclose(net.params(), flat2, rtol=1e-6)
+
+    def test_num_params(self):
+        net = MultiLayerNetwork(mlp_conf()).init()
+        assert net.num_params() == 4 * 16 + 16 + 16 * 3 + 3
+
+    def test_updater_state_roundtrip(self, rng):
+        X, Y = make_classification_data(rng)
+        net = MultiLayerNetwork(mlp_conf(updater="adam")).init()
+        net.fit(X, Y)
+        st = net.updater_state_flat()
+        assert st.size > 0
+        net.set_updater_state_flat(st * 0.5)
+        np.testing.assert_allclose(net.updater_state_flat(), st * 0.5, rtol=1e-6)
+
+
+class TestRegularization:
+    def test_l2_shrinks_weights(self, rng):
+        X, Y = make_classification_data(rng)
+        ds = DataSet(X, Y)
+        nets = {}
+        for l2 in [0.0, 0.5]:
+            conf = (NeuralNetConfiguration.builder().seed(7).learning_rate(0.1)
+                    .l2(l2).list()
+                    .layer(DenseLayer(n_out=16, activation="tanh"))
+                    .layer(OutputLayer(n_out=3, activation="softmax"))
+                    .set_input_type(InputType.feed_forward(4)).build())
+            net = MultiLayerNetwork(conf).init()
+            for _ in range(100):
+                net.fit(ds)
+            nets[l2] = np.linalg.norm(net.params())
+        assert nets[0.5] < nets[0.0]
+
+    def test_l1_l2_in_score(self, rng):
+        X, Y = make_classification_data(rng)
+        c0 = mlp_conf()
+        net0 = MultiLayerNetwork(c0).init()
+        s_plain = net0.score(DataSet(X, Y))
+        conf = (NeuralNetConfiguration.builder().seed(42).learning_rate(0.5)
+                .weight_init("xavier").l2(1.0).list()
+                .layer(DenseLayer(n_out=16, activation="relu"))
+                .layer(OutputLayer(n_out=3, activation="softmax", loss_function="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net1 = MultiLayerNetwork(conf).init(params=net0.params_tree)
+        assert net1.score(DataSet(X, Y)) > s_plain
+
+    def test_dropout_train_only(self, rng):
+        X, Y = make_classification_data(rng)
+        conf = (NeuralNetConfiguration.builder().seed(3).drop_out(0.5).list()
+                .layer(DenseLayer(n_out=32, activation="relu"))
+                .layer(OutputLayer(n_out=3, activation="softmax"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        o1 = net.output(X)
+        o2 = net.output(X)
+        np.testing.assert_array_equal(o1, o2)  # inference is deterministic
+
+
+class TestBatchNorm:
+    def test_bn_running_stats_update(self, rng):
+        X, Y = make_classification_data(rng)
+        conf = (NeuralNetConfiguration.builder().seed(5).learning_rate(0.05)
+                .activation("identity").list()
+                .layer(DenseLayer(n_out=8, activation="relu"))
+                .layer(BatchNormalization())
+                .layer(OutputLayer(n_out=3, activation="softmax"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        m0 = np.asarray(net.state["layer_1"]["mean"]).copy()
+        net.fit(DataSet(X, Y))
+        m1 = np.asarray(net.state["layer_1"]["mean"])
+        assert not np.allclose(m0, m1)
+        for _ in range(50):
+            net.fit(DataSet(X, Y))
+        assert net.evaluate(DataSet(X, Y)).accuracy() > 0.8
+
+
+class TestEmbedding:
+    def test_embedding_lookup(self, rng):
+        idx = rng.randint(0, 10, size=(32,))
+        Y = np.eye(3)[idx % 3].astype("float64")
+        conf = (NeuralNetConfiguration.builder().seed(9).learning_rate(0.5)
+                .list()
+                .layer(EmbeddingLayer(n_in=10, n_out=8, activation="identity"))
+                .layer(OutputLayer(n_out=3, activation="softmax"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        out = net.output(idx.astype("int32"))
+        assert out.shape == (32, 3)
+        for _ in range(100):
+            net.fit(idx.astype("int32"), Y)
+        assert net.evaluate(DataSet(idx.astype("int32"), Y)).accuracy() > 0.9
