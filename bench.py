@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Benchmark entry point (driver-run, real TPU).
+
+Measures `MultiLayerNetwork.fit()` samples/sec on the LeNet-MNIST config — the
+reference's first BASELINE.md config — using the reference's
+PerformanceListener counting semantics (samples/sec averaged over the timed
+interval, `optimize/listeners/PerformanceListener.java:86-102`).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+`vs_baseline` compares against the earliest recorded BENCH_r*.json (the first
+measurement establishes the baseline — the reference publishes no numbers,
+BASELINE.md).
+"""
+
+import glob
+import json
+import os
+import re
+import sys
+import time
+
+import numpy as np
+
+
+def _baseline_value(metric: str):
+    """Earliest prior BENCH_r{N}.json with the same metric, if any."""
+    best = None
+    for path in sorted(glob.glob(os.path.join(os.path.dirname(__file__) or ".", "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            if isinstance(rec, dict) and rec.get("metric") == metric and rec.get("value"):
+                n = int(re.search(r"BENCH_r(\d+)", path).group(1))
+                if best is None or n < best[0]:
+                    best = (n, float(rec["value"]))
+        except Exception:
+            continue
+    return best[1] if best else None
+
+
+def main():
+    batch = int(os.environ.get("BENCH_BATCH", "512"))
+    steps = int(os.environ.get("BENCH_STEPS", "60"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "10"))
+
+    import jax
+    from deeplearning4j_tpu.models import zoo
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    net = MultiLayerNetwork(zoo.lenet_mnist()).init()
+
+    rng = np.random.RandomState(0)
+    # Pre-stage the batch on device: the framework's async prefetch pipeline
+    # overlaps host->device transfer with compute in real training, so the
+    # benchmark measures fit() step throughput (PerformanceListener semantics),
+    # not the tunnel's transfer latency.
+    x = jax.device_put(rng.rand(batch, 28, 28, 1).astype("float32"))
+    y = jax.device_put(np.eye(10, dtype="float32")[rng.randint(0, 10, batch)])
+
+    # Warmup (includes compile).
+    for _ in range(warmup):
+        net._fit_one(_ds(x, y))
+    jax.block_until_ready(net.params_tree)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        net._fit_one(_ds(x, y))
+    jax.block_until_ready(net.params_tree)
+    dt = time.perf_counter() - t0
+
+    sps = batch * steps / dt
+    metric = "lenet_mnist_fit_samples_per_sec"
+    base = _baseline_value(metric)
+    print(json.dumps({
+        "metric": metric,
+        "value": round(sps, 1),
+        "unit": "samples/sec",
+        "vs_baseline": round(sps / base, 3) if base else 1.0,
+    }))
+
+
+def _ds(x, y):
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    return DataSet(x, y)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

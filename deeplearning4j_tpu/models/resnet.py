@@ -1,0 +1,91 @@
+"""ResNet-50 as a ComputationGraph (the BASELINE.md flagship config).
+
+The reference trains ResNet-50 as a ComputationGraph exercising the
+conv/batchnorm cuDNN helper path; here every conv/BN lowers to XLA
+(`deeplearning4j-cuda/.../CudnnConvolutionHelper.java` has no equivalent —
+SURVEY.md §7). Built via the public GraphBuilder DSL with bottleneck residual
+blocks (ElementWiseVertex add = the reference's residual merge).
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.conf.enums import Updater
+from deeplearning4j_tpu.nn.conf.graph import ElementWiseVertex
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    ActivationLayer,
+    BatchNormalization,
+    ConvolutionLayer,
+    GlobalPoolingLayer,
+    OutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.conf.neural_net import (
+    ComputationGraphConfiguration,
+    NeuralNetConfiguration,
+)
+
+
+def _conv_bn(b, name, inp, n_out, kernel, stride, activation="relu", mode="same"):
+    b.add_layer(
+        f"{name}_conv",
+        ConvolutionLayer(kernel_size=kernel, stride=stride, n_out=n_out,
+                         convolution_mode=mode, activation="identity", has_bias=False),
+        inp,
+    )
+    b.add_layer(
+        f"{name}_bn",
+        BatchNormalization(activation=activation),
+        f"{name}_conv",
+    )
+    return f"{name}_bn"
+
+
+def _bottleneck(b, name, inp, filters, stride, project: bool):
+    """Bottleneck residual block: 1x1 -> 3x3 -> 1x1 (+ projection shortcut)."""
+    f1, f2, f3 = filters, filters, filters * 4
+    x = _conv_bn(b, f"{name}_a", inp, f1, (1, 1), stride)
+    x = _conv_bn(b, f"{name}_b", x, f2, (3, 3), (1, 1))
+    x = _conv_bn(b, f"{name}_c", x, f3, (1, 1), (1, 1), activation="identity")
+    if project:
+        shortcut = _conv_bn(b, f"{name}_proj", inp, f3, (1, 1), stride,
+                            activation="identity")
+    else:
+        shortcut = inp
+    b.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), x, shortcut)
+    b.add_layer(f"{name}_relu", ActivationLayer(activation="relu"), f"{name}_add")
+    return f"{name}_relu"
+
+
+def resnet50(
+    n_classes: int = 1000, image: int = 224, channels: int = 3,
+    seed: int = 123, lr: float = 0.1, dtype: str = "bfloat16",
+) -> ComputationGraphConfiguration:
+    b = (
+        NeuralNetConfiguration.builder()
+        .seed(seed).learning_rate(lr).updater(Updater.NESTEROVS).momentum(0.9)
+        .weight_init("relu").l2(1e-4).dtype(dtype)
+        .graph_builder()
+        .add_inputs("input")
+    )
+    x = _conv_bn(b, "stem", "input", 64, (7, 7), (2, 2))
+    b.add_layer("stem_pool",
+                SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                                 stride=(2, 2), convolution_mode="same"),
+                x)
+    x = "stem_pool"
+    stages = [(64, 3, 1), (128, 4, 2), (256, 6, 2), (512, 3, 2)]
+    for si, (filters, blocks, first_stride) in enumerate(stages):
+        for bi in range(blocks):
+            stride = (first_stride, first_stride) if bi == 0 else (1, 1)
+            x = _bottleneck(b, f"s{si}_b{bi}", x, filters, stride, project=(bi == 0))
+    b.add_layer("avgpool", GlobalPoolingLayer(pooling_type="avg"), x)
+    b.add_layer("fc",
+                OutputLayer(n_out=n_classes, activation="softmax",
+                            loss_function="mcxent", weight_init="xavier"),
+                "avgpool")
+    return (
+        b.set_outputs("fc")
+        .set_input_types(InputType.convolutional(image, image, channels))
+        .build()
+    )

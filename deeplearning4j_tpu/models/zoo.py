@@ -1,0 +1,108 @@
+"""Model zoo: the reference-designated benchmark configs (BASELINE.md).
+
+- LeNet-MNIST (reference: dl4j-examples LenetMnistExample — MultiLayerNetwork)
+- MLP-MNIST (the minimal end-to-end slice)
+- GravesLSTM char-RNN (reference: GravesLSTMCharModellingExample)
+- VGG-16 (reference: Keras-import VGG16 zoo, `keras/trainedmodels/TrainedModels.java:16-19`)
+
+All built through the public config DSL, so they double as integration tests
+of the builder.
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.conf.enums import Updater
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    ConvolutionLayer,
+    DenseLayer,
+    GravesLSTM,
+    OutputLayer,
+    RnnOutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.conf.neural_net import (
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+)
+
+
+def mlp_mnist(seed: int = 123, lr: float = 0.006) -> MultiLayerConfiguration:
+    """Two-layer MLP on flat 28x28 inputs."""
+    return (
+        NeuralNetConfiguration.builder()
+        .seed(seed).learning_rate(lr).updater(Updater.NESTEROVS).momentum(0.9)
+        .weight_init("xavier").l2(1e-4)
+        .list()
+        .layer(DenseLayer(n_out=1000, activation="relu"))
+        .layer(OutputLayer(n_out=10, activation="softmax", loss_function="negativeloglikelihood"))
+        .set_input_type(InputType.feed_forward(784))
+        .build()
+    )
+
+
+def lenet_mnist(seed: int = 123, lr: float = 0.01, dtype: str = "float32") -> MultiLayerConfiguration:
+    """LeNet: conv5x5x20 -> maxpool -> conv5x5x50 -> maxpool -> dense500 -> softmax10."""
+    return (
+        NeuralNetConfiguration.builder()
+        .seed(seed).learning_rate(lr).updater(Updater.NESTEROVS).momentum(0.9)
+        .weight_init("xavier").l2(5e-4).activation("identity").dtype(dtype)
+        .list()
+        .layer(ConvolutionLayer(kernel_size=(5, 5), stride=(1, 1), n_out=20, activation="identity"))
+        .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2), stride=(2, 2)))
+        .layer(ConvolutionLayer(kernel_size=(5, 5), stride=(1, 1), n_out=50, activation="identity"))
+        .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2), stride=(2, 2)))
+        .layer(DenseLayer(n_out=500, activation="relu"))
+        .layer(OutputLayer(n_out=10, activation="softmax", loss_function="negativeloglikelihood"))
+        .set_input_type(InputType.convolutional(28, 28, 1))
+        .build()
+    )
+
+
+def char_rnn(
+    vocab_size: int = 77, hidden: int = 200, layers: int = 2,
+    tbptt_length: int = 50, seed: int = 12345, dtype: str = "float32",
+) -> MultiLayerConfiguration:
+    """GravesLSTM character model (reference example: 2x200 LSTM + RnnOutput)."""
+    builder = (
+        NeuralNetConfiguration.builder()
+        .seed(seed).learning_rate(0.1).updater(Updater.RMSPROP).rms_decay(0.95)
+        .weight_init("xavier").l2(0.001).dtype(dtype)
+        .list()
+    )
+    for _ in range(layers):
+        builder.layer(GravesLSTM(n_out=hidden, activation="tanh"))
+    builder.layer(RnnOutputLayer(n_out=vocab_size, activation="softmax", loss_function="mcxent"))
+    return (
+        builder
+        .backprop_type("truncatedbptt")
+        .t_bptt_forward_length(tbptt_length)
+        .t_bptt_backward_length(tbptt_length)
+        .set_input_type(InputType.recurrent(vocab_size))
+        .build()
+    )
+
+
+def vgg16(n_classes: int = 1000, seed: int = 123, dtype: str = "bfloat16") -> MultiLayerConfiguration:
+    """VGG-16 (configuration matches the Keras VGG16 the reference imports)."""
+    def conv(n):
+        return ConvolutionLayer(kernel_size=(3, 3), stride=(1, 1),
+                                convolution_mode="same", n_out=n, activation="relu")
+
+    def pool():
+        return SubsamplingLayer(pooling_type="max", kernel_size=(2, 2), stride=(2, 2))
+
+    b = (
+        NeuralNetConfiguration.builder()
+        .seed(seed).learning_rate(0.01).updater(Updater.NESTEROVS).momentum(0.9)
+        .weight_init("relu").dtype(dtype)
+        .list()
+    )
+    for block, (n, reps) in enumerate([(64, 2), (128, 2), (256, 3), (512, 3), (512, 3)]):
+        for _ in range(reps):
+            b.layer(conv(n))
+        b.layer(pool())
+    b.layer(DenseLayer(n_out=4096, activation="relu"))
+    b.layer(DenseLayer(n_out=4096, activation="relu"))
+    b.layer(OutputLayer(n_out=n_classes, activation="softmax", loss_function="mcxent"))
+    return b.set_input_type(InputType.convolutional(224, 224, 3)).build()

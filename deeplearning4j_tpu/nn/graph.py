@@ -56,7 +56,7 @@ class ComputationGraph:
         self.opt_state: Optional[Dict[str, Any]] = None
         self.iteration = 0
         self.epoch = 0
-        self.score_value = float("nan")
+        self._score = float("nan")
         self.listeners: List[Any] = []
         self._initialized = False
         self._compute_dtype = {
@@ -67,6 +67,19 @@ class ComputationGraph:
         )
         self._jit_cache: Dict[Any, Any] = {}
         self._rnn_state: Dict[str, Any] = {}
+
+
+    @property
+    def score_value(self) -> float:
+        """Loss of the most recent iteration. Reading this syncs with the
+        device (the train loop itself never blocks — important over
+        high-latency device transports)."""
+        v = self._score
+        return float(v) if v is not None else float("nan")
+
+    @score_value.setter
+    def score_value(self, v):
+        self._score = v
 
     # ------------------------------------------------------------------ init
 
@@ -402,7 +415,7 @@ class ComputationGraph:
             [jnp.asarray(l) for l in mds.labels],
             fmasks, lmasks, step, self._next_rng(),
         )
-        self.score_value = float(loss)
+        self._score = loss  # device scalar; sync deferred to score_value
         if count_iteration:
             self.iteration += 1
             for listener in self.listeners:

@@ -59,7 +59,7 @@ class MultiLayerNetwork:
         self.opt_state: Optional[Dict[str, Any]] = None
         self.iteration = 0
         self.epoch = 0
-        self.score_value = float("nan")
+        self._score = float("nan")
         self.listeners: List[Any] = []
         self._rnn_state: Dict[str, Dict[str, jnp.ndarray]] = {}
         self._initialized = False
@@ -70,6 +70,19 @@ class MultiLayerNetwork:
             jnp.float64 if conf.global_conf.dtype == "float64" else jnp.float32
         )
         self._jit_cache: Dict[Any, Any] = {}
+
+
+    @property
+    def score_value(self) -> float:
+        """Loss of the most recent iteration. Reading this syncs with the
+        device (the train loop itself never blocks — important over
+        high-latency device transports)."""
+        v = self._score
+        return float(v) if v is not None else float("nan")
+
+    @score_value.setter
+    def score_value(self, v):
+        self._score = v
 
     # ------------------------------------------------------------------ init
 
@@ -346,7 +359,7 @@ class MultiLayerNetwork:
             None if ds.labels_mask is None else jnp.asarray(ds.labels_mask),
             step, self._next_rng(),
         )
-        self.score_value = float(loss)
+        self._score = loss  # device scalar; sync deferred to score_value
         self.iteration += 1
         for listener in self.listeners:
             listener.iteration_done(self, self.iteration)
@@ -382,7 +395,7 @@ class MultiLayerNetwork:
                 None if chunk.labels_mask is None else jnp.asarray(chunk.labels_mask),
                 step, self._next_rng(),
             )
-            self.score_value = float(loss)
+            self._score = loss  # device scalar; sync deferred to score_value
         # Reset rnn carries after the sequence; keep persistent (BN) state.
         self.state = {
             lk: {k: v for k, v in s.items() if k in dict(self._declared_state()).get(lk, ())}

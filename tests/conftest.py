@@ -8,17 +8,26 @@ gradient checks run in double precision.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax
 
+# The image's sitecustomize pre-imports jax pinned to the tunneled TPU
+# (JAX_PLATFORMS=axon); config.update is the override that sticks.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 import numpy as np
 import pytest
+
+if len(jax.devices()) < 8:
+    pytest.exit(
+        f"Tests need >=8 virtual CPU devices (got {len(jax.devices())}). "
+        "Unset any conflicting --xla_force_host_platform_device_count in XLA_FLAGS.",
+        returncode=3,
+    )
 
 
 @pytest.fixture
