@@ -1,0 +1,293 @@
+"""Gradient checks: central finite differences vs analytic (autodiff) gradients.
+
+Reference analog: `deeplearning4j-core/src/test/.../gradientcheck/*` —
+GradientCheckTests, CNNGradientCheckTest, BNGradientCheckTest,
+LRNGradientCheckTests, GlobalPoolingGradientCheckTests, VaeGradientCheckTests,
+GradientCheckTestsComputationGraph, GradientCheckTestsMasking,
+LossFunctionGradientCheck. All in float64.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (
+    ComputationGraph,
+    MultiLayerNetwork,
+    NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.gradientcheck import check_gradients
+from deeplearning4j_tpu.nn.conf.graph import ElementWiseVertex, MergeVertex
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    AutoEncoder,
+    BatchNormalization,
+    ConvolutionLayer,
+    DenseLayer,
+    EmbeddingLayer,
+    GlobalPoolingLayer,
+    GravesBidirectionalLSTM,
+    GravesLSTM,
+    LocalResponseNormalization,
+    OutputLayer,
+    RnnOutputLayer,
+    SimpleRnn,
+    SubsamplingLayer,
+)
+
+EPS = 1e-6
+TOL = 1e-5
+
+
+from conftest import make_classification_data
+
+
+def base_builder():
+    return (NeuralNetConfiguration.builder()
+            .seed(12345).learning_rate(0.1).updater("sgd")
+            .weight_init("xavier").dtype("float64"))
+
+
+def class_data(rng, n=6, nf=4, nc=3):
+    return make_classification_data(rng, n=n, n_features=nf, n_classes=nc)
+
+
+class TestMLPGradients:
+    @pytest.mark.parametrize("act", ["sigmoid", "tanh", "relu", "elu", "softplus",
+                                     "rationaltanh", "hardsigmoid", "cube"])
+    def test_dense_activations(self, rng, act):
+        X, Y = class_data(rng)
+        conf = (base_builder().list()
+                .layer(DenseLayer(n_out=5, activation=act))
+                .layer(OutputLayer(n_out=3, activation="softmax", loss_function="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        assert check_gradients(net, DataSet(X, Y), epsilon=EPS, max_rel_error=TOL)
+
+    @pytest.mark.parametrize("loss,act", [
+        ("mcxent", "softmax"), ("mse", "identity"), ("mse", "tanh"),
+        ("xent", "sigmoid"), ("l1", "identity"), ("negativeloglikelihood", "softmax"),
+        ("kl_divergence", "sigmoid"), ("poisson", "softplus"), ("hinge", "identity"),
+        ("squared_hinge", "identity"), ("cosine_proximity", "identity"),
+    ])
+    def test_loss_functions(self, rng, loss, act):
+        X, Y = class_data(rng)
+        if loss == "kl_divergence":
+            Y = np.abs(rng.rand(6, 3)) + 0.1
+            Y = Y / Y.sum(-1, keepdims=True)
+        if loss in ("hinge", "squared_hinge"):
+            Y = 2.0 * Y - 1.0
+        conf = (base_builder().list()
+                .layer(DenseLayer(n_out=5, activation="tanh"))
+                .layer(OutputLayer(n_out=3, activation=act, loss_function=loss))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        assert check_gradients(net, DataSet(X, Y), epsilon=EPS, max_rel_error=TOL), (loss, act)
+
+    def test_l1_l2(self, rng):
+        X, Y = class_data(rng)
+        conf = (base_builder().l1(0.01).l2(0.02).list()
+                .layer(DenseLayer(n_out=5, activation="tanh"))
+                .layer(OutputLayer(n_out=3, activation="softmax"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        assert check_gradients(net, DataSet(X, Y), epsilon=EPS, max_rel_error=TOL)
+
+    def test_embedding(self, rng):
+        idx = rng.randint(0, 7, (6,)).astype("int32")
+        Y = np.eye(3)[rng.randint(0, 3, 6)]
+        conf = (base_builder().list()
+                .layer(EmbeddingLayer(n_in=7, n_out=5, activation="tanh"))
+                .layer(OutputLayer(n_out=3, activation="softmax"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        assert check_gradients(net, DataSet(idx, Y), epsilon=EPS, max_rel_error=TOL)
+
+    def test_autoencoder_supervised(self, rng):
+        X, Y = class_data(rng)
+        conf = (base_builder().list()
+                .layer(AutoEncoder(n_out=5, activation="sigmoid"))
+                .layer(OutputLayer(n_out=3, activation="softmax"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        assert check_gradients(net, DataSet(X, Y), epsilon=EPS, max_rel_error=TOL)
+
+
+class TestCNNGradients:
+    def test_conv_subsampling(self, rng):
+        X = rng.randn(4, 8, 8, 2)
+        Y = np.eye(3)[rng.randint(0, 3, 4)]
+        conf = (base_builder().list()
+                .layer(ConvolutionLayer(kernel_size=(3, 3), stride=(1, 1), n_out=3,
+                                        activation="tanh"))
+                .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2), stride=(2, 2)))
+                .layer(OutputLayer(n_out=3, activation="softmax"))
+                .set_input_type(InputType.convolutional(8, 8, 2)).build())
+        net = MultiLayerNetwork(conf).init()
+        assert check_gradients(net, DataSet(X, Y), epsilon=EPS, max_rel_error=TOL)
+
+    @pytest.mark.parametrize("pool", ["avg", "pnorm"])
+    def test_pooling_types(self, rng, pool):
+        X = rng.randn(3, 6, 6, 2)
+        Y = np.eye(2)[rng.randint(0, 2, 3)]
+        conf = (base_builder().list()
+                .layer(ConvolutionLayer(kernel_size=(2, 2), n_out=2, activation="tanh"))
+                .layer(SubsamplingLayer(pooling_type=pool, kernel_size=(2, 2), stride=(2, 2)))
+                .layer(OutputLayer(n_out=2, activation="softmax"))
+                .set_input_type(InputType.convolutional(6, 6, 2)).build())
+        net = MultiLayerNetwork(conf).init()
+        assert check_gradients(net, DataSet(X, Y), epsilon=EPS, max_rel_error=TOL)
+
+    def test_conv_same_mode(self, rng):
+        X = rng.randn(3, 5, 5, 1)
+        Y = np.eye(2)[rng.randint(0, 2, 3)]
+        conf = (base_builder().list()
+                .layer(ConvolutionLayer(kernel_size=(3, 3), n_out=2,
+                                        convolution_mode="same", activation="tanh"))
+                .layer(OutputLayer(n_out=2, activation="softmax"))
+                .set_input_type(InputType.convolutional(5, 5, 1)).build())
+        net = MultiLayerNetwork(conf).init()
+        assert check_gradients(net, DataSet(X, Y), epsilon=EPS, max_rel_error=TOL)
+
+    def test_batchnorm(self, rng):
+        X = rng.randn(8, 4)
+        Y = np.eye(3)[rng.randint(0, 3, 8)]
+        conf = (base_builder().activation("identity").list()
+                .layer(DenseLayer(n_out=5, activation="tanh"))
+                .layer(BatchNormalization())
+                .layer(OutputLayer(n_out=3, activation="softmax"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        # BN gradcheck uses the inference path (fixed stats), per reference
+        # BNGradientCheckTest semantics (batch-stat jacobian differs).
+        assert check_gradients(net, DataSet(X, Y), epsilon=EPS, max_rel_error=TOL)
+
+    def test_lrn(self, rng):
+        X = rng.randn(3, 5, 5, 6)
+        Y = np.eye(2)[rng.randint(0, 2, 3)]
+        conf = (base_builder().list()
+                .layer(ConvolutionLayer(kernel_size=(2, 2), n_out=6, activation="tanh"))
+                .layer(LocalResponseNormalization())
+                .layer(OutputLayer(n_out=2, activation="softmax"))
+                .set_input_type(InputType.convolutional(5, 5, 6)).build())
+        net = MultiLayerNetwork(conf).init()
+        assert check_gradients(net, DataSet(X, Y), epsilon=EPS, max_rel_error=TOL)
+
+
+class TestRNNGradients:
+    def test_graves_lstm(self, rng):
+        X = rng.randn(3, 5, 4)
+        Y = np.eye(3)[rng.randint(0, 3, (3, 5))]
+        conf = (base_builder().list()
+                .layer(GravesLSTM(n_out=4, activation="tanh"))
+                .layer(RnnOutputLayer(n_out=3, activation="softmax", loss_function="mcxent"))
+                .set_input_type(InputType.recurrent(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        assert check_gradients(net, DataSet(X, Y), epsilon=EPS, max_rel_error=TOL)
+
+    def test_bidirectional_lstm(self, rng):
+        X = rng.randn(2, 4, 3)
+        Y = np.eye(2)[rng.randint(0, 2, (2, 4))]
+        conf = (base_builder().list()
+                .layer(GravesBidirectionalLSTM(n_out=3, activation="tanh"))
+                .layer(RnnOutputLayer(n_out=2, activation="softmax"))
+                .set_input_type(InputType.recurrent(3)).build())
+        net = MultiLayerNetwork(conf).init()
+        assert check_gradients(net, DataSet(X, Y), epsilon=EPS, max_rel_error=TOL)
+
+    def test_simple_rnn(self, rng):
+        X = rng.randn(3, 4, 3)
+        Y = np.eye(2)[rng.randint(0, 2, (3, 4))]
+        conf = (base_builder().list()
+                .layer(SimpleRnn(n_out=4, activation="tanh"))
+                .layer(RnnOutputLayer(n_out=2, activation="softmax"))
+                .set_input_type(InputType.recurrent(3)).build())
+        net = MultiLayerNetwork(conf).init()
+        assert check_gradients(net, DataSet(X, Y), epsilon=EPS, max_rel_error=TOL)
+
+    def test_lstm_with_masking(self, rng):
+        X = rng.randn(3, 5, 4)
+        Y = np.eye(3)[rng.randint(0, 3, (3, 5))]
+        mask = np.array([
+            [1, 1, 1, 1, 1],
+            [1, 1, 1, 0, 0],
+            [1, 1, 0, 0, 0],
+        ], dtype="float64")
+        conf = (base_builder().list()
+                .layer(GravesLSTM(n_out=4, activation="tanh"))
+                .layer(RnnOutputLayer(n_out=3, activation="softmax"))
+                .set_input_type(InputType.recurrent(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        ds = DataSet(X, Y, features_mask=mask, labels_mask=mask)
+        assert check_gradients(net, ds, epsilon=EPS, max_rel_error=TOL)
+
+    def test_global_pooling_over_time(self, rng):
+        X = rng.randn(3, 5, 4)
+        Y = np.eye(2)[rng.randint(0, 2, 3)]
+        mask = np.array([[1, 1, 1, 1, 1], [1, 1, 1, 0, 0], [1, 1, 0, 0, 0]],
+                        dtype="float64")
+        for pool in ["max", "avg", "sum", "pnorm"]:
+            conf = (base_builder().list()
+                    .layer(GravesLSTM(n_out=3, activation="tanh"))
+                    .layer(GlobalPoolingLayer(pooling_type=pool))
+                    .layer(OutputLayer(n_out=2, activation="softmax"))
+                    .set_input_type(InputType.recurrent(4)).build())
+            net = MultiLayerNetwork(conf).init()
+            ds = DataSet(X, Y, features_mask=mask)
+            assert check_gradients(net, ds, epsilon=EPS, max_rel_error=TOL), pool
+
+
+class TestGraphGradients:
+    def test_merge_vertex(self, rng):
+        X, Y = class_data(rng)
+        conf = (base_builder().graph_builder()
+                .add_inputs("in")
+                .add_layer("d1", DenseLayer(n_in=4, n_out=5, activation="tanh"), "in")
+                .add_layer("d2", DenseLayer(n_in=4, n_out=4, activation="sigmoid"), "in")
+                .add_vertex("merge", MergeVertex(), "d1", "d2")
+                .add_layer("out", OutputLayer(n_in=9, n_out=3, activation="softmax"), "merge")
+                .set_outputs("out").build())
+        net = ComputationGraph(conf).init()
+        assert check_gradients(net, DataSet(X, Y), epsilon=EPS, max_rel_error=TOL)
+
+    def test_elementwise_add_residual(self, rng):
+        X, Y = class_data(rng)
+        conf = (base_builder().graph_builder()
+                .add_inputs("in")
+                .add_layer("d1", DenseLayer(n_in=4, n_out=4, activation="tanh"), "in")
+                .add_vertex("add", ElementWiseVertex(op="add"), "d1", "in")
+                .add_layer("out", OutputLayer(n_in=4, n_out=3, activation="softmax"), "add")
+                .set_outputs("out").build())
+        net = ComputationGraph(conf).init()
+        assert check_gradients(net, DataSet(X, Y), epsilon=EPS, max_rel_error=TOL)
+
+    def test_multi_output(self, rng):
+        X = rng.randn(5, 4)
+        Y1 = np.eye(3)[rng.randint(0, 3, 5)]
+        Y2 = rng.randn(5, 2)
+        conf = (base_builder().graph_builder()
+                .add_inputs("in")
+                .add_layer("shared", DenseLayer(n_in=4, n_out=6, activation="tanh"), "in")
+                .add_layer("out1", OutputLayer(n_in=6, n_out=3, activation="softmax",
+                                               loss_function="mcxent"), "shared")
+                .add_layer("out2", OutputLayer(n_in=6, n_out=2, activation="identity",
+                                               loss_function="mse"), "shared")
+                .set_outputs("out1", "out2").build())
+        net = ComputationGraph(conf).init()
+        mds = MultiDataSet(features=[X], labels=[Y1, Y2])
+        assert check_gradients(net, mds, epsilon=EPS, max_rel_error=TOL)
+
+    def test_multi_input(self, rng):
+        X1 = rng.randn(5, 3)
+        X2 = rng.randn(5, 2)
+        Y = np.eye(2)[rng.randint(0, 2, 5)]
+        conf = (base_builder().graph_builder()
+                .add_inputs("a", "b")
+                .add_layer("da", DenseLayer(n_in=3, n_out=4, activation="tanh"), "a")
+                .add_layer("db", DenseLayer(n_in=2, n_out=4, activation="tanh"), "b")
+                .add_vertex("m", MergeVertex(), "da", "db")
+                .add_layer("out", OutputLayer(n_in=8, n_out=2, activation="softmax"), "m")
+                .set_outputs("out").build())
+        net = ComputationGraph(conf).init()
+        mds = MultiDataSet(features=[X1, X2], labels=[Y])
+        assert check_gradients(net, mds, epsilon=EPS, max_rel_error=TOL)
