@@ -1,0 +1,238 @@
+"""Dataset iterators.
+
+Equivalent of the reference's `datasets/iterator/` infrastructure
+(`AsyncDataSetIterator` background prefetch, `MultipleEpochsIterator`,
+`SamplingDataSetIterator`, `IteratorDataSetIterator`, `ListDataSetIterator`,
+`ExistingDataSetIterator`; SURVEY.md §2).
+
+TPU-specific: `AsyncDataSetIterator` prefetches batches all the way to the
+DEVICE (jax.device_put in a background thread), not just to host memory —
+over a high-latency device transport this hides the transfer behind compute,
+which is the role the reference's prefetch thread plays for disk I/O.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+
+class DataSetIterator:
+    """Iterator protocol (reference: ND4J `DataSetIterator`)."""
+
+    def __iter__(self) -> Iterator[DataSet]:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        pass
+
+    def batch_size(self) -> Optional[int]:
+        return None
+
+    def total_examples(self) -> Optional[int]:
+        return None
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Iterate a DataSet (or list of them) in minibatches (reference:
+    `ListDataSetIterator.java`)."""
+
+    def __init__(self, data, batch_size: int = 32, shuffle: bool = False,
+                 seed: Optional[int] = None):
+        if isinstance(data, DataSet):
+            self._batches = data.batch_by(batch_size)
+            self._source = data
+        else:
+            self._batches = list(data)
+            self._source = None
+        self._batch_size = batch_size
+        self._shuffle = shuffle
+        self._rng = np.random.RandomState(seed)
+
+    def __iter__(self):
+        if self._shuffle and self._source is not None:
+            idx = self._rng.permutation(self._source.num_examples())
+            shuffled = DataSet(
+                self._source.features[idx],
+                None if self._source.labels is None else self._source.labels[idx],
+                None if self._source.features_mask is None else self._source.features_mask[idx],
+                None if self._source.labels_mask is None else self._source.labels_mask[idx],
+            )
+            return iter(shuffled.batch_by(self._batch_size))
+        if self._shuffle:
+            # List-of-DataSets source: shuffle the batch ORDER each epoch
+            # (cross-batch example shuffling needs a single-DataSet source).
+            order = self._rng.permutation(len(self._batches))
+            return iter([self._batches[i] for i in order])
+        return iter(self._batches)
+
+    def batch_size(self):
+        return self._batch_size
+
+    def total_examples(self):
+        return sum(b.num_examples() for b in self._batches)
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch to device (reference:
+    `AsyncDataSetIterator.java` — the host-side I/O boundary of the fit()
+    call stack, SURVEY.md §3.1)."""
+
+    def __init__(self, base: Iterable, queue_size: int = 4, device_prefetch: bool = True):
+        self.base = base
+        self.queue_size = max(1, int(queue_size))
+        self.device_prefetch = device_prefetch
+
+    def _put(self, ds: DataSet) -> DataSet:
+        if not self.device_prefetch:
+            return ds
+        import jax
+
+        return DataSet(
+            jax.device_put(np.asarray(ds.features)),
+            None if ds.labels is None else jax.device_put(np.asarray(ds.labels)),
+            None if ds.features_mask is None else jax.device_put(np.asarray(ds.features_mask)),
+            None if ds.labels_mask is None else jax.device_put(np.asarray(ds.labels_mask)),
+        )
+
+    def __iter__(self):
+        q: "queue.Queue" = queue.Queue(maxsize=self.queue_size)
+        _END = object()
+        stop = threading.Event()
+        errors: List[BaseException] = []
+
+        def offer(item) -> bool:
+            # Bounded put that gives up when the consumer abandoned iteration,
+            # so the worker never blocks forever holding device buffers.
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def worker():
+            try:
+                for ds in self.base:
+                    if not offer(self._put(ds)):
+                        return
+            except BaseException as e:  # surfaced on the consumer side
+                errors.append(e)
+            finally:
+                offer(_END)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _END:
+                    break
+                yield item
+        finally:
+            # Consumer done or bailed early (break/exception/GeneratorExit):
+            # release the worker and drop any prefetched device buffers.
+            stop.set()
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+            t.join(timeout=5)
+        if errors:
+            raise errors[0]
+
+    def reset(self):
+        if hasattr(self.base, "reset"):
+            self.base.reset()
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """Replay a base iterator N times (reference: `MultipleEpochsIterator.java`)."""
+
+    def __init__(self, num_epochs: int, base: Iterable):
+        self.num_epochs = int(num_epochs)
+        self.base = base
+
+    def __iter__(self):
+        for _ in range(self.num_epochs):
+            if hasattr(self.base, "reset"):
+                self.base.reset()
+            yield from self.base
+
+    def reset(self):
+        if hasattr(self.base, "reset"):
+            self.base.reset()
+
+
+class SamplingDataSetIterator(DataSetIterator):
+    """Sample batches with replacement (reference: `SamplingDataSetIterator.java`)."""
+
+    def __init__(self, data: DataSet, batch_size: int, total_batches: int,
+                 seed: Optional[int] = None):
+        self.data = data
+        self._batch_size = batch_size
+        self.total_batches = total_batches
+        self._rng = np.random.RandomState(seed)
+
+    def __iter__(self):
+        n = self.data.num_examples()
+        for _ in range(self.total_batches):
+            idx = self._rng.randint(0, n, self._batch_size)
+            yield DataSet(
+                self.data.features[idx],
+                None if self.data.labels is None else self.data.labels[idx],
+                None if self.data.features_mask is None else self.data.features_mask[idx],
+                None if self.data.labels_mask is None else self.data.labels_mask[idx],
+            )
+
+    def batch_size(self):
+        return self._batch_size
+
+
+class ExistingDataSetIterator(DataSetIterator):
+    """Wrap any python iterable of DataSets (reference: `ExistingDataSetIterator.java`)."""
+
+    def __init__(self, iterable: Iterable):
+        self._items = list(iterable)
+
+    def __iter__(self):
+        return iter(self._items)
+
+    def total_examples(self):
+        return sum(d.num_examples() for d in self._items)
+
+
+class IteratorDataSetIterator(DataSetIterator):
+    """Re-batch a stream of DataSets to a fixed batch size (reference:
+    `IteratorDataSetIterator.java`)."""
+
+    def __init__(self, base: Iterable, batch_size: int):
+        self.base = base
+        self._batch_size = batch_size
+
+    def __iter__(self):
+        buf: List[DataSet] = []
+        count = 0
+        for ds in self.base:
+            buf.append(ds)
+            count += ds.num_examples()
+            while count >= self._batch_size:
+                merged = DataSet.merge(buf)
+                out, rest = merged.split_test_and_train(self._batch_size)
+                yield out
+                buf = [rest] if rest.num_examples() else []
+                count = rest.num_examples()
+        if buf:
+            merged = DataSet.merge(buf)
+            if merged.num_examples():
+                yield merged
+
+    def batch_size(self):
+        return self._batch_size

@@ -1,0 +1,26 @@
+"""Early stopping.
+
+Equivalent of the reference's `earlystopping/` package: configuration,
+epoch/iteration termination conditions (`earlystopping/termination/`), score
+calculators, model savers (`earlystopping/saver/`), and the trainer loop
+(`trainer/BaseEarlyStoppingTrainer.java:76-100`).
+"""
+
+from deeplearning4j_tpu.earlystopping.config import (  # noqa: F401
+    EarlyStoppingConfiguration,
+    EarlyStoppingResult,
+)
+from deeplearning4j_tpu.earlystopping.trainer import EarlyStoppingTrainer  # noqa: F401
+from deeplearning4j_tpu.earlystopping.termination import (  # noqa: F401
+    BestScoreEpochTerminationCondition,
+    InvalidScoreIterationTerminationCondition,
+    MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    MaxTimeIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+)
+from deeplearning4j_tpu.earlystopping.saver import (  # noqa: F401
+    InMemoryModelSaver,
+    LocalFileModelSaver,
+)
+from deeplearning4j_tpu.earlystopping.scorecalc import DataSetLossCalculator  # noqa: F401

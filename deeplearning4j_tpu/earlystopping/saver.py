@@ -1,0 +1,58 @@
+"""Model savers for early stopping (reference: `earlystopping/saver/` —
+InMemoryModelSaver, LocalFileModelSaver / LocalFileGraphSaver)."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+class InMemoryModelSaver:
+    def __init__(self):
+        self._best = None
+        self._latest = None
+
+    def save_best_model(self, net, score: float) -> None:
+        self._best = (net.clone() if hasattr(net, "clone") else net, score)
+
+    def save_latest_model(self, net, score: float) -> None:
+        self._latest = (net.clone() if hasattr(net, "clone") else net, score)
+
+    def get_best_model(self):
+        return self._best[0] if self._best else None
+
+    def get_latest_model(self):
+        return self._latest[0] if self._latest else None
+
+
+class LocalFileModelSaver:
+    """Persist best/latest checkpoints via ModelSerializer zips."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.directory, name)
+
+    def save_best_model(self, net, score: float) -> None:
+        from deeplearning4j_tpu.util.model_serializer import save_model
+
+        save_model(net, self._path("bestModel.zip"))
+
+    def save_latest_model(self, net, score: float) -> None:
+        from deeplearning4j_tpu.util.model_serializer import save_model
+
+        save_model(net, self._path("latestModel.zip"))
+
+    def get_best_model(self):
+        from deeplearning4j_tpu.util.model_serializer import load_model
+
+        path = self._path("bestModel.zip")
+        return load_model(path) if os.path.exists(path) else None
+
+    def get_latest_model(self):
+        from deeplearning4j_tpu.util.model_serializer import load_model
+
+        path = self._path("latestModel.zip")
+        return load_model(path) if os.path.exists(path) else None

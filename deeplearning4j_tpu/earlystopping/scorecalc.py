@@ -1,0 +1,29 @@
+"""Score calculators (reference: `earlystopping/scorecalc/DataSetLossCalculator`)."""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+
+class DataSetLossCalculator:
+    """Average loss over an iterator/DataSet, optionally averaged per batch."""
+
+    def __init__(self, iterator, average: bool = True):
+        self.iterator = iterator
+        self.average = average
+
+    def calculate_score(self, net) -> float:
+        it = self.iterator
+        if hasattr(it, "reset"):
+            it.reset()
+        if isinstance(it, DataSet):
+            return net.score(it)
+        total, batches, examples = 0.0, 0, 0
+        for ds in it:
+            n = ds.num_examples()
+            total += net.score(ds) * n
+            batches += 1
+            examples += n
+        if examples == 0:
+            return float("nan")
+        return total / examples if self.average else total

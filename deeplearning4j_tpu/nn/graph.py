@@ -511,6 +511,18 @@ class ComputationGraph:
             pos += n
         self.opt_state = jax.tree_util.tree_unflatten(treedef, out)
 
+    def clone(self) -> "ComputationGraph":
+        """Deep copy with COPIED device buffers (the train step donates the
+        source's buffers; aliased arrays would be deleted under the clone)."""
+        net = ComputationGraph(copy.deepcopy(self.conf))
+        if self._initialized:
+            net.init(params=jax.tree_util.tree_map(jnp.copy, self.params_tree))
+            net.state = jax.tree_util.tree_map(jnp.copy, self.state)
+            net.opt_state = jax.tree_util.tree_map(jnp.copy, self.opt_state)
+            net.iteration = self.iteration
+            net.epoch = self.epoch
+        return net
+
     def summary(self) -> str:
         lines = ["=" * 78]
         lines.append(f"{'Vertex':<28}{'Type':<28}{'Params':>10}")

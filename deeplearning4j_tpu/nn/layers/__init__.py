@@ -51,6 +51,8 @@ OUTPUT_LAYER_TYPES = {
 # Layerwise-pretrainable layers (reference: pretrain() RBM/AE/VAE path).
 PRETRAIN_LOSSES = {
     "VariationalAutoencoder": variational.vae_pretrain_loss,
+    "AutoEncoder": feedforward.autoencoder_pretrain_loss,
+    "RBM": feedforward.rbm_pretrain_loss,
 }
 
 

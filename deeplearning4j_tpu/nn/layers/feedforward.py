@@ -79,6 +79,60 @@ def autoencoder_reconstruct(conf, params, x, rng=None, corrupt=False):
     return z
 
 
+def autoencoder_pretrain_loss(conf, params, x, rng):
+    """Denoising-AE reconstruction loss (reference: `AutoEncoder.computeGradientAndScore`
+    via the configured reconstruction loss, default cross-entropy)."""
+    from deeplearning4j_tpu.nn import losses as losses_mod
+
+    z = autoencoder_reconstruct(conf, params, x, rng=rng, corrupt=True)
+    # z is already post-activation; pass identity so score uses it directly.
+    return losses_mod.score(conf.loss_function, x, z, "identity")
+
+
+def _rbm_free_energy(conf, params, v):
+    """Free energy F(v) = -v.vb - sum softplus(vW + b) (binary hidden units)."""
+    wx_b = v @ params["W"] + params["b"]
+    vbias = v @ params["vb"]
+    return -vbias - jnp.sum(jax.nn.softplus(wx_b), axis=-1)
+
+
+def rbm_pretrain_loss(conf, params, x, rng):
+    """CD-k contrastive divergence as a differentiable surrogate (reference:
+    `nn/layers/feedforward/rbm/RBM.java:101` contrastiveDivergence).
+
+    Gibbs-sample v_k with k steps (stop-gradient), then
+    loss = mean(F(v)) - mean(F(v_k)): autodiff of this is exactly the CD-k
+    gradient — the functional TPU formulation of the reference's sampled
+    positive/negative phase updates.
+    """
+    v = x
+
+    def sample_h(v, key):
+        p = jax.nn.sigmoid(v @ params["W"] + params["b"])
+        if conf.hidden_unit == "binary":
+            return jax.random.bernoulli(key, p).astype(v.dtype), p
+        return p, p
+
+    def sample_v(h, key):
+        pre = h @ params["W"].T + params["vb"]
+        if conf.visible_unit == "gaussian":
+            return pre + jax.random.normal(key, pre.shape, pre.dtype), pre
+        p = jax.nn.sigmoid(pre)
+        if conf.visible_unit == "binary":
+            return jax.random.bernoulli(key, p).astype(v.dtype), p
+        return p, p
+
+    vk = v
+    for step in range(max(1, conf.k)):
+        kh = jax.random.fold_in(rng, 2 * step)
+        kv = jax.random.fold_in(rng, 2 * step + 1)
+        h, _ = sample_h(vk, kh)
+        vk, _ = sample_v(h, kv)
+    vk = jax.lax.stop_gradient(vk)
+    return jnp.mean(_rbm_free_energy(conf, params, v)) - jnp.mean(
+        _rbm_free_energy(conf, params, vk))
+
+
 def rbm_apply(conf, params, state, x, *, rng=None, train=False, mask=None):
     """Supervised forward = propUp (reference: `nn/layers/feedforward/rbm/RBM.java`)."""
     pre = x @ params["W"] + params["b"]

@@ -334,6 +334,20 @@ class MultiLayerNetwork:
                 pass
 
         g = self.conf.global_conf
+        if self.conf.pretrain:
+            if not hasattr(iterator, "reset") and not isinstance(iterator, (list, tuple)):
+                # One-shot iterable: materialize so both the pretrain pass and
+                # the backprop pass see the data.
+                iterator = list(iterator)
+            self.pretrain(iterator)
+            if hasattr(iterator, "reset"):
+                try:
+                    iterator.reset()
+                except Exception:
+                    pass
+        if not self.conf.backprop:
+            self.epoch += 1
+            return self
         tbptt = BackpropType.of(self.conf.backprop_type) == BackpropType.TRUNCATED_BPTT
         for ds in iterator:
             for _ in range(max(1, g.iterations)):
@@ -343,6 +357,76 @@ class MultiLayerNetwork:
                     self._fit_one(ds)
         self.epoch += 1
         return self
+
+    # ------------------------------------------------------------- pretrain
+
+    def pretrain(self, iterator, epochs: int = 1):
+        """Layerwise unsupervised pretraining of AE/RBM/VAE layers (reference:
+        `MultiLayerNetwork.pretrain()` `:164` — feed data forward to each
+        pretrainable layer, optimize that layer's unsupervised loss)."""
+        from deeplearning4j_tpu.nn.layers import PRETRAIN_LOSSES
+
+        if not self._initialized:
+            self.init()
+        if isinstance(iterator, DataSet):
+            iterator = [iterator]
+        elif not hasattr(iterator, "reset") and not isinstance(iterator, (list, tuple)):
+            iterator = list(iterator)  # one-shot iterable: every layer/epoch needs it
+        for i, layer in enumerate(self.layers):
+            if not layer.is_pretrainable():
+                continue
+            loss_impl = PRETRAIN_LOSSES.get(type(layer).__name__)
+            if loss_impl is None:
+                continue
+            for _ in range(max(1, epochs)):
+                if hasattr(iterator, "reset"):
+                    try:
+                        iterator.reset()
+                    except Exception:
+                        pass
+                for ds in iterator:
+                    self._pretrain_step(i, layer, loss_impl,
+                                        jnp.asarray(ds.features))
+        return self
+
+    def _pretrain_step(self, layer_idx: int, layer, loss_impl, x):
+        lk = self.layer_keys[layer_idx]
+        key = ("pretrain", layer_idx)
+        if key not in self._jit_cache:
+            prep = self.conf.input_preprocessors.get(layer_idx)
+
+            def step_fn(lparams, opt_state, full_params, state, x, step, rng):
+                def loss_fn(lp):
+                    # Forward through the frozen stack below this layer.
+                    h, _, _, _ = self._forward_fn(
+                        {**full_params, lk: lp}, state, x, None, False, None,
+                        upto=layer_idx,
+                    )
+                    if prep is not None:
+                        h, _ = prep(h, None)
+                    return loss_impl(layer, lp, h, rng)
+
+                loss, grads = jax.value_and_grad(loss_fn)(lparams)
+                lr = self._schedules[layer_idx](step)
+                st, deltas = self._updaters[layer_idx].update(opt_state, grads, lr, step)
+                new_lp = {k: lparams[k] - deltas[k] for k in lparams}
+                return new_lp, st, loss
+
+            # No donation: the layer's param buffers also appear inside
+            # full_params (arg 2), so they cannot be safely donated.
+            self._jit_cache[key] = jax.jit(step_fn)
+        step_fn = self._jit_cache[key]
+        new_lp, new_opt, loss = step_fn(
+            self.params_tree[lk], self.opt_state[lk], self.params_tree,
+            self.state, x, jnp.asarray(self.iteration, jnp.float32),
+            self._next_rng(),
+        )
+        self.params_tree = {**self.params_tree, lk: new_lp}
+        self.opt_state = {**self.opt_state, lk: new_opt}
+        self._score = loss
+        self.iteration += 1
+        for listener in self.listeners:
+            listener.iteration_done(self, self.iteration)
 
     def _next_rng(self):
         self._train_rng, sub = jax.random.split(self._train_rng)
@@ -537,10 +621,16 @@ class MultiLayerNetwork:
         self.opt_state = jax.tree_util.tree_unflatten(treedef, out)
 
     def clone(self) -> "MultiLayerNetwork":
+        """Deep copy. Device buffers are COPIED (jnp.copy), not aliased: the
+        source net's train step donates its buffers, which would delete a
+        shared array out from under the clone."""
         net = MultiLayerNetwork(copy.deepcopy(self.conf))
         if self._initialized:
-            net.init(params=jax.tree_util.tree_map(lambda a: a, self.params_tree))
-            net.state = jax.tree_util.tree_map(lambda a: a, self.state)
+            net.init(params=jax.tree_util.tree_map(jnp.copy, self.params_tree))
+            net.state = jax.tree_util.tree_map(jnp.copy, self.state)
+            net.opt_state = jax.tree_util.tree_map(jnp.copy, self.opt_state)
+            net.iteration = self.iteration
+            net.epoch = self.epoch
         return net
 
     def summary(self) -> str:

@@ -1,0 +1,118 @@
+"""Training listeners.
+
+Equivalent of the reference's `optimize/api/IterationListener`/`TrainingListener`
+SPI and `optimize/listeners/` impls (ScoreIterationListener, PerformanceListener,
+CollectScoresIterationListener, ComposableIterationListener). The listener hook
+is the single observability point (SURVEY.md §5); networks call
+`iteration_done(model, iteration)` after each fit step and the epoch hooks from
+`fit()`.
+
+Note: reading `model.score_value` forces a device sync — listeners that log
+every iteration should use a `frequency` > 1 on high-latency transports.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, List, Optional
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+class IterationListener:
+    """Base listener (reference: `optimize/api/IterationListener.java`)."""
+
+    def iteration_done(self, model, iteration: int) -> None:  # pragma: no cover
+        pass
+
+    # TrainingListener extras (reference: `optimize/api/TrainingListener.java`)
+    def on_epoch_start(self, model) -> None:
+        pass
+
+    def on_epoch_end(self, model) -> None:
+        pass
+
+
+class ScoreIterationListener(IterationListener):
+    """Log the score every N iterations (reference: `ScoreIterationListener.java`)."""
+
+    def __init__(self, print_iterations: int = 10, out: Optional[Callable[[str], None]] = None):
+        self.print_iterations = max(1, int(print_iterations))
+        self.out = out or (lambda s: logger.info(s))
+
+    def iteration_done(self, model, iteration: int) -> None:
+        if iteration % self.print_iterations == 0:
+            self.out(f"Score at iteration {iteration} is {model.score_value}")
+
+
+class PerformanceListener(IterationListener):
+    """Samples/sec + batches/sec over the report interval (reference:
+    `PerformanceListener.java:86-102` — the BASELINE.md metric semantics)."""
+
+    def __init__(self, frequency: int = 1, report_score: bool = False,
+                 out: Optional[Callable[[str], None]] = None):
+        self.frequency = max(1, int(frequency))
+        self.report_score = report_score
+        self.out = out or (lambda s: logger.info(s))
+        self._last_time = None
+        self._last_iter = 0
+        self._samples_since = 0
+        self.last_samples_per_sec = float("nan")
+        self.last_batches_per_sec = float("nan")
+
+    def record_batch(self, num_samples: int) -> None:
+        self._samples_since += int(num_samples)
+
+    def iteration_done(self, model, iteration: int) -> None:
+        now = time.perf_counter()
+        if self._last_time is None:
+            self._last_time = now
+            self._last_iter = iteration
+            return
+        if iteration - self._last_iter < self.frequency:
+            return
+        dt = now - self._last_time
+        batches = iteration - self._last_iter
+        self.last_batches_per_sec = batches / dt if dt > 0 else float("nan")
+        if self._samples_since:
+            self.last_samples_per_sec = self._samples_since / dt if dt > 0 else float("nan")
+        msg = (f"iteration {iteration}: {self.last_batches_per_sec:.2f} batches/sec"
+               + (f", {self.last_samples_per_sec:.2f} samples/sec" if self._samples_since else ""))
+        if self.report_score:
+            msg += f", score {model.score_value:.6f}"
+        self.out(msg)
+        self._last_time = now
+        self._last_iter = iteration
+        self._samples_since = 0
+
+
+class CollectScoresIterationListener(IterationListener):
+    """Collect (iteration, score) pairs (reference: `CollectScoresIterationListener.java`)."""
+
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, int(frequency))
+        self.scores: List[tuple] = []
+
+    def iteration_done(self, model, iteration: int) -> None:
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, model.score_value))
+
+
+class ComposableIterationListener(IterationListener):
+    """Fan-out to several listeners (reference: `ComposableIterationListener.java`)."""
+
+    def __init__(self, *listeners: IterationListener):
+        self.listeners = list(listeners)
+
+    def iteration_done(self, model, iteration: int) -> None:
+        for l in self.listeners:
+            l.iteration_done(model, iteration)
+
+    def on_epoch_start(self, model) -> None:
+        for l in self.listeners:
+            l.on_epoch_start(model)
+
+    def on_epoch_end(self, model) -> None:
+        for l in self.listeners:
+            l.on_epoch_end(model)

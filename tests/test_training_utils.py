@@ -1,0 +1,324 @@
+"""Tests for listeners, early stopping, serialization, iterators, pretraining.
+
+Reference analogs: `deeplearning4j-core/src/test/.../earlystopping/`,
+`util/ModelSerializerTest`, `datasets/iterator/`, RBM/AE pretrain tests.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.datasets.builtin import (
+    IrisDataSetIterator,
+    MnistDataSetIterator,
+    load_iris,
+    load_mnist,
+)
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import (
+    AsyncDataSetIterator,
+    ExistingDataSetIterator,
+    IteratorDataSetIterator,
+    ListDataSetIterator,
+    MultipleEpochsIterator,
+    SamplingDataSetIterator,
+)
+from deeplearning4j_tpu.earlystopping import (
+    DataSetLossCalculator,
+    EarlyStoppingConfiguration,
+    EarlyStoppingTrainer,
+    InMemoryModelSaver,
+    LocalFileModelSaver,
+    MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    AutoEncoder,
+    DenseLayer,
+    OutputLayer,
+    RBM,
+    VariationalAutoencoder,
+)
+from deeplearning4j_tpu.optimize.listeners import (
+    CollectScoresIterationListener,
+    PerformanceListener,
+    ScoreIterationListener,
+)
+from deeplearning4j_tpu.util.model_serializer import load_model, save_model
+
+from conftest import make_classification_data
+
+
+def small_net(updater="adam", lr=0.05, seed=42):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).learning_rate(lr).updater(updater).weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss_function="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+class TestIterators:
+    def test_list_iterator_batches(self, rng):
+        X, Y = make_classification_data(rng, n=100)
+        it = ListDataSetIterator(DataSet(X, Y), batch_size=32)
+        sizes = [b.num_examples() for b in it]
+        assert sizes == [32, 32, 32, 4]
+        assert it.total_examples() == 100
+
+    def test_list_iterator_shuffle_deterministic(self, rng):
+        X, Y = make_classification_data(rng, n=20)
+        it1 = ListDataSetIterator(DataSet(X, Y), batch_size=10, shuffle=True, seed=1)
+        it2 = ListDataSetIterator(DataSet(X, Y), batch_size=10, shuffle=True, seed=1)
+        np.testing.assert_array_equal(next(iter(it1)).features, next(iter(it2)).features)
+
+    def test_async_iterator_same_data(self, rng):
+        X, Y = make_classification_data(rng, n=64)
+        base = ListDataSetIterator(DataSet(X, Y), batch_size=16)
+        sync = [np.asarray(b.features) for b in base]
+        got = [np.asarray(b.features) for b in AsyncDataSetIterator(base, device_prefetch=True)]
+        assert len(got) == len(sync)
+        for a, b in zip(sync, got):
+            np.testing.assert_allclose(a, b)
+
+    def test_async_iterator_propagates_errors(self):
+        def bad():
+            yield DataSet(np.zeros((2, 2)))
+            raise RuntimeError("boom")
+
+        with pytest.raises(RuntimeError, match="boom"):
+            list(AsyncDataSetIterator(bad(), device_prefetch=False))
+
+    def test_multiple_epochs(self, rng):
+        X, Y = make_classification_data(rng, n=8)
+        base = ListDataSetIterator(DataSet(X, Y), batch_size=8)
+        assert len(list(MultipleEpochsIterator(3, base))) == 3
+
+    def test_sampling_iterator(self, rng):
+        X, Y = make_classification_data(rng, n=50)
+        it = SamplingDataSetIterator(DataSet(X, Y), batch_size=16, total_batches=5, seed=0)
+        batches = list(it)
+        assert len(batches) == 5
+        assert all(b.num_examples() == 16 for b in batches)
+
+    def test_rebatching_iterator(self, rng):
+        X, Y = make_classification_data(rng, n=30)
+        stream = [DataSet(X[i:i + 7], Y[i:i + 7]) for i in range(0, 30, 7)]
+        out = list(IteratorDataSetIterator(ExistingDataSetIterator(stream), batch_size=10))
+        assert [b.num_examples() for b in out] == [10, 10, 10]
+
+    def test_training_via_async(self, rng):
+        X, Y = make_classification_data(rng)
+        net = small_net()
+        base = ListDataSetIterator(DataSet(X, Y), batch_size=16)
+        for _ in range(30):
+            net.fit(AsyncDataSetIterator(base))
+        assert net.evaluate(DataSet(X, Y)).accuracy() > 0.85
+
+
+class TestBuiltinDatasets:
+    def test_mnist_shapes(self):
+        ds = load_mnist(num_examples=256)
+        assert ds.features.shape == (256, 28, 28, 1)
+        assert ds.labels.shape == (256, 10)
+        assert 0.0 <= ds.features.min() and ds.features.max() <= 1.0
+
+    def test_mnist_iterator_flat(self):
+        it = MnistDataSetIterator(batch_size=64, num_examples=128, flat=True)
+        b = next(iter(it))
+        assert b.features.shape == (64, 784)
+
+    def test_iris(self):
+        ds = load_iris()
+        assert ds.features.shape == (150, 4)
+        assert ds.labels.sum() == 150
+
+    def test_iris_learnable(self):
+        net = small_net(lr=0.1)
+        it = IrisDataSetIterator(batch_size=50)
+        for _ in range(60):
+            net.fit(it)
+        ev = net.evaluate(IrisDataSetIterator())
+        assert ev.accuracy() > 0.9
+
+
+class TestListeners:
+    def test_score_listener_fires(self, rng):
+        X, Y = make_classification_data(rng)
+        lines = []
+        net = small_net().set_listeners(ScoreIterationListener(1, out=lines.append))
+        net.fit(DataSet(X, Y))
+        assert len(lines) == 1 and "Score at iteration" in lines[0]
+
+    def test_collect_scores(self, rng):
+        X, Y = make_classification_data(rng)
+        col = CollectScoresIterationListener()
+        net = small_net().set_listeners(col)
+        for _ in range(5):
+            net.fit(DataSet(X, Y))
+        assert len(col.scores) == 5
+        assert col.scores[-1][1] < col.scores[0][1]
+
+    def test_performance_listener(self, rng):
+        X, Y = make_classification_data(rng)
+        msgs = []
+        perf = PerformanceListener(frequency=2, out=msgs.append)
+        net = small_net().set_listeners(perf)
+        for _ in range(6):
+            perf.record_batch(X.shape[0])
+            net.fit(DataSet(X, Y))
+        assert msgs and "batches/sec" in msgs[0]
+        assert perf.last_samples_per_sec > 0
+
+
+class TestModelSerializer:
+    def test_roundtrip_multilayer(self, rng, tmp_path):
+        X, Y = make_classification_data(rng)
+        net = small_net()
+        for _ in range(5):
+            net.fit(DataSet(X, Y))
+        path = tmp_path / "model.zip"
+        save_model(net, path)
+        net2 = load_model(path)
+        np.testing.assert_allclose(net.params(), net2.params(), rtol=1e-7)
+        np.testing.assert_allclose(net.updater_state_flat(), net2.updater_state_flat(), rtol=1e-7)
+        np.testing.assert_allclose(net.output(X), net2.output(X), rtol=1e-5)
+        assert net2.iteration == net.iteration
+        # Continued training from a restore matches exactly: same rng seed path.
+        assert abs(net2.score(DataSet(X, Y)) - net.score(DataSet(X, Y))) < 1e-8
+
+    def test_roundtrip_graph(self, rng, tmp_path):
+        from deeplearning4j_tpu import ComputationGraph
+        X, Y = make_classification_data(rng)
+        conf = (NeuralNetConfiguration.builder().seed(1).learning_rate(0.05)
+                .updater("adam").graph_builder()
+                .add_inputs("in")
+                .add_layer("d", DenseLayer(n_in=4, n_out=8, activation="tanh"), "in")
+                .add_layer("o", OutputLayer(n_in=8, n_out=3, activation="softmax"), "d")
+                .set_outputs("o").build())
+        net = ComputationGraph(conf).init()
+        net.fit(X, Y)
+        path = tmp_path / "graph.zip"
+        save_model(net, path)
+        net2 = load_model(path)
+        np.testing.assert_allclose(net.params(), net2.params(), rtol=1e-7)
+        np.testing.assert_allclose(net.output(X)[0], net2.output(X)[0], rtol=1e-5)
+
+
+class TestEarlyStopping:
+    def test_max_epochs(self, rng):
+        X, Y = make_classification_data(rng)
+        net = small_net()
+        it = ListDataSetIterator(DataSet(X, Y), batch_size=32)
+        cfg = (EarlyStoppingConfiguration.builder()
+               .score_calculator(DataSetLossCalculator(DataSet(X, Y)))
+               .model_saver(InMemoryModelSaver())
+               .epoch_termination_conditions(MaxEpochsTerminationCondition(5))
+               .build())
+        result = EarlyStoppingTrainer(cfg, net, it).fit()
+        assert result.total_epochs == 5
+        assert result.termination_reason == "EpochTerminationCondition"
+        assert result.best_model is not None
+        assert result.best_model_score <= result.score_vs_epoch[0]
+
+    def test_score_improvement_patience(self, rng):
+        X, Y = make_classification_data(rng)
+        net = small_net(lr=0.0)  # lr 0: no improvement ever
+        it = ListDataSetIterator(DataSet(X, Y), batch_size=32)
+        cfg = (EarlyStoppingConfiguration.builder()
+               .score_calculator(DataSetLossCalculator(DataSet(X, Y)))
+               .epoch_termination_conditions(
+                   ScoreImprovementEpochTerminationCondition(2),
+                   MaxEpochsTerminationCondition(50))
+               .build())
+        result = EarlyStoppingTrainer(cfg, net, it).fit()
+        assert result.total_epochs <= 6
+        assert result.termination_details == "ScoreImprovementEpochTerminationCondition"
+
+    def test_max_score_guard(self, rng):
+        X, Y = make_classification_data(rng)
+        net = small_net(lr=1e4)  # diverges
+        it = ListDataSetIterator(DataSet(X, Y), batch_size=32)
+        cfg = (EarlyStoppingConfiguration.builder()
+               .iteration_termination_conditions(MaxScoreIterationTerminationCondition(50.0))
+               .epoch_termination_conditions(MaxEpochsTerminationCondition(20))
+               .build())
+        result = EarlyStoppingTrainer(cfg, net, it).fit()
+        assert result.total_epochs < 20
+
+    def test_local_file_saver(self, rng, tmp_path):
+        X, Y = make_classification_data(rng)
+        net = small_net()
+        it = ListDataSetIterator(DataSet(X, Y), batch_size=32)
+        cfg = (EarlyStoppingConfiguration.builder()
+               .score_calculator(DataSetLossCalculator(DataSet(X, Y)))
+               .model_saver(LocalFileModelSaver(str(tmp_path)))
+               .epoch_termination_conditions(MaxEpochsTerminationCondition(3))
+               .build())
+        result = EarlyStoppingTrainer(cfg, net, it).fit()
+        assert os.path.exists(tmp_path / "bestModel.zip")
+        assert result.best_model is not None
+
+
+class TestPretrain:
+    def test_autoencoder_pretrain_reduces_reconstruction(self, rng):
+        X = (rng.rand(64, 12) > 0.5).astype("float64")
+        conf = (NeuralNetConfiguration.builder().seed(3).learning_rate(0.1)
+                .updater("adam")
+                .list()
+                .layer(AutoEncoder(n_out=8, activation="sigmoid", corruption_level=0.2))
+                .layer(OutputLayer(n_out=2, activation="softmax"))
+                .set_input_type(InputType.feed_forward(12))
+                .pretrain(True).backprop(False)
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        from deeplearning4j_tpu.nn.layers.feedforward import autoencoder_pretrain_loss
+        import jax
+        l0 = float(autoencoder_pretrain_loss(net.layers[0], net.params_tree["layer_0"],
+                                             X, jax.random.PRNGKey(0)))
+        net.pretrain(DataSet(X), epochs=40)
+        l1 = float(autoencoder_pretrain_loss(net.layers[0], net.params_tree["layer_0"],
+                                             X, jax.random.PRNGKey(0)))
+        assert l1 < l0 * 0.9
+
+    def test_rbm_pretrain_runs_and_improves_free_energy_gap(self, rng):
+        X = (rng.rand(64, 10) > 0.5).astype("float64")
+        conf = (NeuralNetConfiguration.builder().seed(4).learning_rate(0.05)
+                .updater("sgd")
+                .list()
+                .layer(RBM(n_out=6, visible_unit="binary", hidden_unit="binary", k=1))
+                .layer(OutputLayer(n_out=2, activation="softmax"))
+                .set_input_type(InputType.feed_forward(10))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        w0 = np.asarray(net.params_tree["layer_0"]["W"]).copy()
+        net.pretrain(DataSet(X), epochs=10)
+        w1 = np.asarray(net.params_tree["layer_0"]["W"])
+        assert not np.allclose(w0, w1)
+        assert np.isfinite(net.score_value)
+
+    def test_vae_pretrain_elbo_improves(self, rng):
+        X = rng.rand(64, 8).astype("float64")
+        conf = (NeuralNetConfiguration.builder().seed(5).learning_rate(0.01)
+                .updater("adam")
+                .list()
+                .layer(VariationalAutoencoder(
+                    n_out=4, encoder_layer_sizes=(16,), decoder_layer_sizes=(16,),
+                    activation="tanh", reconstruction_distribution="gaussian"))
+                .layer(OutputLayer(n_out=2, activation="softmax"))
+                .set_input_type(InputType.feed_forward(8))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        from deeplearning4j_tpu.nn.layers.variational import vae_pretrain_loss
+        import jax
+        l0 = float(vae_pretrain_loss(net.layers[0], net.params_tree["layer_0"],
+                                     X, jax.random.PRNGKey(0)))
+        net.pretrain(DataSet(X), epochs=60)
+        l1 = float(vae_pretrain_loss(net.layers[0], net.params_tree["layer_0"],
+                                     X, jax.random.PRNGKey(0)))
+        assert l1 < l0
