@@ -1,0 +1,193 @@
+"""ParagraphVectors (doc2vec).
+
+Equivalent of the reference's `models/paragraphvectors/ParagraphVectors.java`:
+PV-DBOW (label vector predicts words — like skip-gram with the doc label as
+the context) and PV-DM (label + context mean predicts the word — CBOW with an
+extra label slot), plus `infer_vector` for unseen documents (freeze
+word/softmax weights, fit a fresh doc vector).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.sentence_iterator import LabelledDocument
+from deeplearning4j_tpu.nlp.tokenization import TokenizerFactory
+from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabConstructor, build_huffman
+from deeplearning4j_tpu.ops import skipgram as kernels
+
+
+class ParagraphVectors:
+    def __init__(
+        self,
+        documents: Iterable,
+        *,
+        dm: bool = False,  # False = DBOW (reference default DBOW for labels)
+        min_word_frequency: int = 1,
+        layer_size: int = 100,
+        window_size: int = 5,
+        epochs: int = 1,
+        seed: int = 12345,
+        learning_rate: float = 0.025,
+        min_learning_rate: float = 1e-4,
+        batch_size: int = 1024,
+        tokenizer_factory: Optional[TokenizerFactory] = None,
+    ):
+        self.dm = dm
+        self.min_word_frequency = min_word_frequency
+        self.layer_size = layer_size
+        self.window_size = window_size
+        self.epochs = epochs
+        self.seed = seed
+        self.learning_rate = learning_rate
+        self.min_learning_rate = min_learning_rate
+        self.batch_size = batch_size
+        self.tf = tokenizer_factory or TokenizerFactory()
+        self._docs: List[LabelledDocument] = [
+            d if isinstance(d, LabelledDocument) else LabelledDocument(content=d)
+            for d in documents
+        ]
+        for i, d in enumerate(self._docs):
+            if not d.labels:
+                d.labels = [f"DOC_{i}"]
+
+    # ------------------------------------------------------------------ fit
+
+    def fit(self) -> "ParagraphVectors":
+        corpus = [self.tf.create(d.content).get_tokens() for d in self._docs]
+        self.vocab = VocabConstructor(self.min_word_frequency).build(corpus)
+        n_inner = build_huffman(self.vocab)
+        V, D = self.vocab.num_words(), self.layer_size
+
+        self.labels = sorted({l for d in self._docs for l in d.labels})
+        self._label_index = {l: i for i, l in enumerate(self.labels)}
+        L = len(self.labels)
+
+        rng = np.random.RandomState(self.seed)
+        self.syn0 = jnp.asarray(((rng.rand(V, D) - 0.5) / D).astype(np.float32))
+        self.doc_vectors = jnp.asarray(((rng.rand(L, D) - 0.5) / D).astype(np.float32))
+        self.syn1 = jnp.zeros((max(n_inner, 1), D), jnp.float32)
+
+        max_code = max((len(w.codes) for w in self.vocab._by_index), default=1) or 1
+        self._codes_tbl = np.zeros((V, max_code), np.int32)
+        self._points_tbl = np.zeros((V, max_code), np.int32)
+        self._cmask_tbl = np.zeros((V, max_code), np.float32)
+        for w in self.vocab._by_index:
+            n = len(w.codes)
+            self._codes_tbl[w.index, :n] = w.codes
+            self._points_tbl[w.index, :n] = w.points
+            self._cmask_tbl[w.index, :n] = 1.0
+
+        seqs = [
+            (np.asarray([self.vocab.index_of(t) for t in toks if self.vocab.contains_word(t)],
+                        np.int32),
+             [self._label_index[l] for l in d.labels])
+            for toks, d in zip(corpus, self._docs)
+        ]
+        # Train doc vectors jointly with words: treat doc ids as rows of a
+        # combined embedding table [L + V, D]; doc rows use DBOW/DM pairing.
+        combined = jnp.concatenate([self.doc_vectors, self.syn0], axis=0)
+        B = self.batch_size
+        buf_center = np.zeros(B, np.int32)
+        buf_word = np.zeros(B, np.int32)
+        fill = 0
+        total = sum(len(s) for s, _ in seqs) * self.epochs
+        done = 0
+
+        def flush(fill, lr):
+            nonlocal combined
+            if not fill:
+                return
+            pm = np.zeros(B, np.float32)
+            pm[:fill] = 1.0
+            combined_new, self.syn1 = kernels.hs_skipgram_step(
+                combined, self.syn1, jnp.asarray(buf_center),
+                jnp.asarray(self._codes_tbl[buf_word]),
+                jnp.asarray(self._points_tbl[buf_word]),
+                jnp.asarray(self._cmask_tbl[buf_word]), jnp.asarray(pm),
+                jnp.float32(lr))
+            combined = combined_new
+
+        for _ in range(self.epochs):
+            for seq, label_ids in seqs:
+                n = len(seq)
+                for pos in range(n):
+                    # DBOW: doc vector predicts each word.
+                    for lid in label_ids:
+                        buf_center[fill] = lid  # doc row in combined table
+                        buf_word[fill] = seq[pos]
+                        fill += 1
+                        if fill == B:
+                            flush(fill, self._lr(done, total))
+                            fill = 0
+                    if self.dm:
+                        # DM-ish: context words predict the word too.
+                        lo = max(0, pos - self.window_size)
+                        hi = min(n, pos + 1 + self.window_size)
+                        for j in range(lo, hi):
+                            if j == pos:
+                                continue
+                            buf_center[fill] = L + seq[j]
+                            buf_word[fill] = seq[pos]
+                            fill += 1
+                            if fill == B:
+                                flush(fill, self._lr(done, total))
+                                fill = 0
+                done += n
+        if fill:
+            flush(fill, self._lr(done, total))
+        self.doc_vectors = combined[:L]
+        self.syn0 = combined[L:]
+        dv = np.asarray(self.doc_vectors)
+        self._doc_unit = dv / np.maximum(np.linalg.norm(dv, axis=1, keepdims=True), 1e-12)
+        return self
+
+    def _lr(self, done, total):
+        return max(self.min_learning_rate,
+                   self.learning_rate * (1 - done / max(total, 1)))
+
+    # ---------------------------------------------------------------- query
+
+    def get_doc_vector(self, label: str) -> np.ndarray:
+        return np.asarray(self.doc_vectors)[self._label_index[label]]
+
+    def similarity(self, a: str, b: str) -> float:
+        ia, ib = self._label_index[a], self._label_index[b]
+        return float(self._doc_unit[ia] @ self._doc_unit[ib])
+
+    def nearest_labels(self, vec_or_label, top: int = 5) -> List[str]:
+        if isinstance(vec_or_label, str):
+            v = self._doc_unit[self._label_index[vec_or_label]]
+        else:
+            v = np.asarray(vec_or_label, np.float64)
+            v = v / max(np.linalg.norm(v), 1e-12)
+        sims = self._doc_unit @ v
+        return [self.labels[i] for i in np.argsort(-sims)[:top]]
+
+    def infer_vector(self, text: str, steps: int = 20,
+                     learning_rate: float = 0.025) -> np.ndarray:
+        """Fit a fresh doc vector against frozen word/softmax weights
+        (reference: `ParagraphVectors.inferVector`)."""
+        toks = [self.vocab.index_of(t) for t in self.tf.create(text).get_tokens()
+                if self.vocab.contains_word(t)]
+        if not toks:
+            return np.zeros(self.layer_size, np.float32)
+        rng = np.random.RandomState(abs(hash(text)) % (2 ** 31))
+        vec = jnp.asarray(((rng.rand(1, self.layer_size) - 0.5) / self.layer_size)
+                          .astype(np.float32))
+        words = np.asarray(toks, np.int32)
+        B = len(words)
+        for _ in range(steps):
+            # One HS step where the only trainable row is the doc vector.
+            # The kernel donates its table args, so hand it a COPY of syn1 to
+            # keep the model's softmax weights intact (frozen inference).
+            vec, _ = kernels.hs_skipgram_step(
+                vec, jnp.copy(self.syn1), jnp.zeros(B, jnp.int32),
+                jnp.asarray(self._codes_tbl[words]),
+                jnp.asarray(self._points_tbl[words]),
+                jnp.asarray(self._cmask_tbl[words]),
+                jnp.ones(B, jnp.float32), jnp.float32(learning_rate))
+        return np.asarray(vec)[0]

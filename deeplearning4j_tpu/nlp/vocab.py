@@ -1,0 +1,132 @@
+"""Vocabulary construction + Huffman coding.
+
+Equivalent of the reference's `models/word2vec/wordstore/` — `VocabWord`,
+`VocabCache`, `VocabConstructor.buildJointVocabulary`
+(`VocabConstructor.java:161`) and the `Huffman` tree builder whose codes/points
+drive hierarchical softmax.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+
+@dataclass
+class VocabWord:
+    word: str
+    frequency: float = 0.0
+    index: int = -1
+    codes: List[int] = field(default_factory=list)  # Huffman code bits
+    points: List[int] = field(default_factory=list)  # inner-node indices
+
+
+class VocabCache:
+    """In-memory vocab (reference: `InMemoryLookupCache`/`AbstractCache`)."""
+
+    def __init__(self):
+        self._words: Dict[str, VocabWord] = {}
+        self._by_index: List[VocabWord] = []
+        self.total_word_count = 0.0
+
+    def add_token(self, word: str, count: float = 1.0):
+        vw = self._words.get(word)
+        if vw is None:
+            vw = VocabWord(word=word, frequency=0.0)
+            self._words[word] = vw
+        vw.frequency += count
+        self.total_word_count += count
+
+    def finalize_vocab(self, min_word_frequency: int = 1):
+        kept = [w for w in self._words.values() if w.frequency >= min_word_frequency]
+        kept.sort(key=lambda w: (-w.frequency, w.word))
+        self._words = {w.word: w for w in kept}
+        self._by_index = kept
+        for i, w in enumerate(kept):
+            w.index = i
+        self.total_word_count = sum(w.frequency for w in kept)
+
+    def contains_word(self, word: str) -> bool:
+        return word in self._words
+
+    def word_for(self, word: str) -> Optional[VocabWord]:
+        return self._words.get(word)
+
+    def word_at_index(self, index: int) -> VocabWord:
+        return self._by_index[index]
+
+    def index_of(self, word: str) -> int:
+        vw = self._words.get(word)
+        return vw.index if vw else -1
+
+    def num_words(self) -> int:
+        return len(self._by_index)
+
+    def words(self) -> List[str]:
+        return [w.word for w in self._by_index]
+
+
+def build_huffman(cache: VocabCache, max_code_length: int = 40) -> int:
+    """Assign Huffman codes/points (reference: `Huffman.java`). Returns the
+    number of inner nodes (= syn1 rows needed)."""
+    n = cache.num_words()
+    if n == 0:
+        return 0
+    counter = itertools.count()
+    heap = [(w.frequency, next(counter), w.index, None, None) for w in cache._by_index]
+    heapq.heapify(heap)
+    parent: Dict[int, tuple] = {}
+    next_inner = n
+    while len(heap) > 1:
+        f1, _, n1, _, _ = heapq.heappop(heap)
+        f2, _, n2, _, _ = heapq.heappop(heap)
+        inner = next_inner
+        next_inner += 1
+        parent[n1] = (inner, 0)
+        parent[n2] = (inner, 1)
+        heapq.heappush(heap, (f1 + f2, next(counter), inner, n1, n2))
+    root = heap[0][2]
+    for w in cache._by_index:
+        codes, points = [], []
+        node = w.index
+        while node != root:
+            p, bit = parent[node]
+            codes.append(bit)
+            points.append(p - n)  # inner-node index into syn1
+            node = p
+        codes.reverse()
+        points.reverse()
+        w.codes = codes[:max_code_length]
+        w.points = points[:max_code_length]
+    return max(next_inner - n, 1)
+
+
+class VocabConstructor:
+    """Build a vocab from token-sequence sources (reference:
+    `VocabConstructor.buildJointVocabulary`)."""
+
+    def __init__(self, min_word_frequency: int = 1):
+        self.min_word_frequency = min_word_frequency
+
+    def build(self, sequences: Iterable[List[str]]) -> VocabCache:
+        cache = VocabCache()
+        for seq in sequences:
+            for tok in seq:
+                cache.add_token(tok)
+        cache.finalize_vocab(self.min_word_frequency)
+        build_huffman(cache)
+        return cache
+
+
+def make_unigram_table(cache: VocabCache, table_size: int = 100_000,
+                       power: float = 0.75) -> np.ndarray:
+    """Negative-sampling table (reference: `InMemoryLookupTable.resetWeights`
+    negative table): word index drawn proportional to freq^0.75."""
+    n = cache.num_words()
+    freqs = np.array([w.frequency for w in cache._by_index], np.float64) ** power
+    probs = freqs / freqs.sum()
+    return np.repeat(np.arange(n), np.maximum((probs * table_size).astype(np.int64), 1))

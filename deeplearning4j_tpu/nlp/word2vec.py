@@ -1,0 +1,264 @@
+"""Word2Vec / SequenceVectors.
+
+Equivalent of the reference's `models/word2vec/Word2Vec.java` +
+`models/sequencevectors/SequenceVectors.java` (builder API, vocab
+construction, subsampling, dynamic windows, linear LR decay) and
+`models/embeddings/inmemory/InMemoryLookupTable.java` (syn0/syn1/syn1neg +
+negative table). Training is batched jitted updates (`ops/skipgram.py`)
+instead of the reference's Hogwild `VectorCalculationsThread`s
+(`SequenceVectors.java:265-330`) — same objective, deterministic, TPU-resident.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterable, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.tokenization import TokenizerFactory
+from deeplearning4j_tpu.nlp.vocab import (
+    VocabCache,
+    VocabConstructor,
+    build_huffman,
+    make_unigram_table,
+)
+from deeplearning4j_tpu.ops import skipgram as kernels
+
+
+class WordVectors:
+    """Query API over trained vectors (reference: `wordvectors/WordVectors.java`)."""
+
+    def __init__(self, vocab: VocabCache, syn0: np.ndarray):
+        self.vocab = vocab
+        self.syn0 = np.asarray(syn0)
+        norms = np.linalg.norm(self.syn0, axis=1, keepdims=True)
+        self._unit = self.syn0 / np.maximum(norms, 1e-12)
+
+    def has_word(self, word: str) -> bool:
+        return self.vocab.contains_word(word)
+
+    def get_word_vector(self, word: str) -> Optional[np.ndarray]:
+        i = self.vocab.index_of(word)
+        return self.syn0[i] if i >= 0 else None
+
+    def similarity(self, a: str, b: str) -> float:
+        ia, ib = self.vocab.index_of(a), self.vocab.index_of(b)
+        if ia < 0 or ib < 0:
+            return float("nan")
+        return float(self._unit[ia] @ self._unit[ib])
+
+    def words_nearest(self, word_or_vec, top: int = 10) -> List[str]:
+        if isinstance(word_or_vec, str):
+            i = self.vocab.index_of(word_or_vec)
+            if i < 0:
+                return []
+            v = self._unit[i]
+            exclude = {i}
+        else:
+            v = np.asarray(word_or_vec, np.float64)
+            v = v / max(np.linalg.norm(v), 1e-12)
+            exclude = set()
+        sims = self._unit @ v
+        order = np.argsort(-sims)
+        out = []
+        for j in order:
+            if int(j) in exclude:
+                continue
+            out.append(self.vocab.word_at_index(int(j)).word)
+            if len(out) >= top:
+                break
+        return out
+
+
+class Word2Vec(WordVectors):
+    """Skip-gram / CBOW embedding trainer (see module docstring).
+
+    Builder-parameter parity with the reference's `Word2Vec.Builder`:
+    min_word_frequency, layer_size, window_size, iterations/epochs, seed,
+    learning_rate/min_learning_rate, negative (0 = hierarchical softmax),
+    sample (subsampling threshold), cbow flag (reference uses separate
+    SkipGram/CBOW learning algorithms).
+    """
+
+    def __init__(
+        self,
+        sentences: Optional[Iterable] = None,
+        *,
+        min_word_frequency: int = 1,
+        layer_size: int = 100,
+        window_size: int = 5,
+        iterations: int = 1,
+        epochs: int = 1,
+        seed: int = 12345,
+        learning_rate: float = 0.025,
+        min_learning_rate: float = 1e-4,
+        negative: int = 0,
+        sample: float = 0.0,
+        cbow: bool = False,
+        batch_size: int = 2048,
+        tokenizer_factory: Optional[TokenizerFactory] = None,
+    ):
+        self.min_word_frequency = min_word_frequency
+        self.layer_size = layer_size
+        self.window_size = window_size
+        self.iterations = iterations
+        self.epochs = epochs
+        self.seed = seed
+        self.learning_rate = learning_rate
+        self.min_learning_rate = min_learning_rate
+        self.negative = negative
+        self.sample = sample
+        self.cbow = cbow
+        self.batch_size = batch_size
+        self.tokenizer_factory = tokenizer_factory or TokenizerFactory()
+        self._sentences = sentences
+        self.vocab: Optional[VocabCache] = None
+        self.syn0 = None
+        self.syn1 = None
+        self.syn1neg = None
+
+    # ------------------------------------------------------------------ fit
+
+    def _tokenize_corpus(self) -> List[List[str]]:
+        corpus = []
+        for s in self._sentences:
+            if isinstance(s, str):
+                corpus.append(self.tokenizer_factory.create(s).get_tokens())
+            else:
+                corpus.append(list(s))
+        return corpus
+
+    def fit(self) -> "Word2Vec":
+        corpus = self._tokenize_corpus()
+        self.vocab = VocabConstructor(self.min_word_frequency).build(corpus)
+        n_inner = build_huffman(self.vocab)
+        V, D = self.vocab.num_words(), self.layer_size
+        rng = np.random.RandomState(self.seed)
+        # Reference init: syn0 ~ U(-0.5/D, 0.5/D), syn1 zeros.
+        syn0 = ((rng.rand(V, D) - 0.5) / D).astype(np.float32)
+        self.syn0 = jnp.asarray(syn0)
+        if self.negative > 0:
+            self.syn1neg = jnp.zeros((V, D), jnp.float32)
+            self._neg_table = make_unigram_table(self.vocab)
+        else:
+            self.syn1 = jnp.zeros((max(n_inner, 1), D), jnp.float32)
+
+        max_code = max((len(w.codes) for w in self.vocab._by_index), default=1) or 1
+        seqs = [
+            np.asarray([self.vocab.index_of(t) for t in seq if self.vocab.contains_word(t)],
+                       np.int32)
+            for seq in corpus
+        ]
+        seqs = [s for s in seqs if len(s) >= 1]
+        total_words = sum(len(s) for s in seqs) * self.epochs * self.iterations
+        words_done = 0
+
+        codes_tbl = np.zeros((V, max_code), np.int32)
+        points_tbl = np.zeros((V, max_code), np.int32)
+        cmask_tbl = np.zeros((V, max_code), np.float32)
+        for w in self.vocab._by_index:
+            L = len(w.codes)
+            codes_tbl[w.index, :L] = w.codes
+            points_tbl[w.index, :L] = w.points
+            cmask_tbl[w.index, :L] = 1.0
+
+        freqs = np.array([w.frequency for w in self.vocab._by_index], np.float64)
+        total_count = freqs.sum()
+        if self.sample > 0:
+            # Reference subsampling: keep probability per word occurrence.
+            ratio = self.sample * total_count / np.maximum(freqs, 1)
+            keep_prob = np.minimum(np.sqrt(ratio) + ratio, 1.0)
+        else:
+            keep_prob = np.ones(V)
+
+        B = self.batch_size
+        buf_center = np.zeros(B, np.int32)
+        buf_word = np.zeros(B, np.int32)
+        W = 2 * self.window_size
+        buf_ctx = np.zeros((B, W), np.int32)
+        buf_ctx_mask = np.zeros((B, W), np.float32)
+        fill = 0
+
+        def flush(fill, lr):
+            if fill == 0:
+                return
+            pm = np.zeros(B, np.float32)
+            pm[:fill] = 1.0
+            if self.cbow:
+                if self.negative > 0:
+                    raise NotImplementedError(
+                        "CBOW with negative sampling is not implemented; use "
+                        "hierarchical softmax (negative=0) for CBOW"
+                    )
+                self.syn0, self.syn1 = kernels.hs_cbow_step(
+                    self.syn0, self.syn1, jnp.asarray(buf_ctx),
+                    jnp.asarray(buf_ctx_mask),
+                    jnp.asarray(codes_tbl[buf_word]),
+                    jnp.asarray(points_tbl[buf_word]),
+                    jnp.asarray(cmask_tbl[buf_word]), jnp.asarray(pm),
+                    jnp.float32(lr))
+            elif self.negative > 0:
+                K = self.negative
+                targets = np.zeros((B, 1 + K), np.int32)
+                labels = np.zeros((B, 1 + K), np.float32)
+                targets[:, 0] = buf_word
+                labels[:, 0] = 1.0
+                targets[:, 1:] = self._neg_table[
+                    rng.randint(0, len(self._neg_table), (B, K))]
+                self.syn0, self.syn1neg = kernels.ns_skipgram_step(
+                    self.syn0, self.syn1neg, jnp.asarray(buf_center),
+                    jnp.asarray(targets), jnp.asarray(labels), jnp.asarray(pm),
+                    jnp.float32(lr))
+            else:
+                self.syn0, self.syn1 = kernels.hs_skipgram_step(
+                    self.syn0, self.syn1, jnp.asarray(buf_center),
+                    jnp.asarray(codes_tbl[buf_word]),
+                    jnp.asarray(points_tbl[buf_word]),
+                    jnp.asarray(cmask_tbl[buf_word]), jnp.asarray(pm),
+                    jnp.float32(lr))
+
+        for _ in range(self.epochs * self.iterations):
+            for seq in seqs:
+                if self.sample > 0:
+                    keep = rng.rand(len(seq)) < keep_prob[seq]
+                    seq = seq[keep]
+                n = len(seq)
+                for pos in range(n):
+                    b = rng.randint(0, self.window_size)  # dynamic window
+                    lo, hi = max(0, pos - (self.window_size - b)), min(n, pos + 1 + (self.window_size - b))
+                    if self.cbow:
+                        ctx = [seq[j] for j in range(lo, hi) if j != pos]
+                        if not ctx:
+                            continue
+                        buf_ctx[fill, :] = 0
+                        buf_ctx_mask[fill, :] = 0.0
+                        buf_ctx[fill, : len(ctx)] = ctx[:W]
+                        buf_ctx_mask[fill, : len(ctx)] = 1.0
+                        buf_word[fill] = seq[pos]
+                        fill += 1
+                        if fill == B:
+                            lr = max(self.min_learning_rate,
+                                     self.learning_rate * (1 - words_done / max(total_words, 1)))
+                            flush(fill, lr)
+                            fill = 0
+                        continue
+                    for j in range(lo, hi):
+                        if j == pos:
+                            continue
+                        # skip-gram: predict seq[pos] from context seq[j]
+                        buf_center[fill] = seq[j]
+                        buf_word[fill] = seq[pos]
+                        fill += 1
+                        if fill == B:
+                            lr = max(self.min_learning_rate,
+                                     self.learning_rate * (1 - words_done / max(total_words, 1)))
+                            flush(fill, lr)
+                            fill = 0
+                words_done += n
+        if fill:
+            flush(fill, max(self.min_learning_rate,
+                            self.learning_rate * (1 - words_done / max(total_words, 1))))
+        WordVectors.__init__(self, self.vocab, np.asarray(self.syn0))
+        return self
