@@ -58,6 +58,12 @@ class Evaluation:
                 keep = np.ones(labels.shape[0] * labels.shape[1], bool)
             labels = labels.reshape(-1, labels.shape[-1])[keep]
             predictions = predictions.reshape(-1, predictions.shape[-1])[keep]
+        elif mask is not None:
+            # Per-example mask on 2-D labels (e.g. padded batches): drop
+            # masked rows instead of silently counting them.
+            keep = np.asarray(mask).reshape(-1) > 0
+            labels = labels[keep]
+            predictions = predictions[keep]
         self._ensure(labels.shape[-1])
         actual = np.argmax(labels, axis=-1)
         pred = np.argmax(predictions, axis=-1)

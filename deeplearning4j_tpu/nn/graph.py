@@ -223,9 +223,10 @@ class ComputationGraph:
                                         fmasks, lmasks, step, rng, carry_rnn=False)
             return jax.jit(step_fn, donate_argnums=(0, 2))
         if kind == "train_step_tbptt":
-            def step_fn2(params, state, opt_state, inputs, labels, fmasks, lmasks, step, rng):
+            def step_fn2(params, state, opt_state, inputs, labels, fmasks, lmasks, step, rng, ebs):
                 return self._train_step(params, state, opt_state, inputs, labels,
-                                        fmasks, lmasks, step, rng, carry_rnn=True)
+                                        fmasks, lmasks, step, rng, carry_rnn=True,
+                                        ebs=ebs)
             return jax.jit(step_fn2, donate_argnums=(0, 2))
         raise ValueError(kind)
 
@@ -249,7 +250,8 @@ class ComputationGraph:
                     total = total + l1 * jnp.sum(jnp.abs(w))
         return total
 
-    def _loss_from_outputs(self, params, outs, labels, lmasks, aux, omasks):
+    def _loss_from_outputs(self, params, outs, labels, lmasks, aux, omasks,
+                           ebs=None):
         total = 0.0
         extra_state: Dict[str, Any] = {}
         for i, name in enumerate(self.conf.network_outputs):
@@ -262,32 +264,45 @@ class ComputationGraph:
             lmask = lmasks[i] if lmasks is not None else None
             if lmask is None and omasks and omasks[i] is not None and preout.ndim == 3:
                 lmask = omasks[i]
+            # `ebs` overrides the divisors for tBPTT chunks (full-sequence
+            # minibatch count, see MultiLayerNetwork._loss_from_preout).
+            eb = ebs[i] if ebs is not None else losses_mod.effective_batch_size(y, lmask)
+            if i == 0:
+                eb0 = eb
             total = total + losses_mod.score(
-                layer.loss_function, y, preout, layer.activation, lmask
-            )
+                layer.loss_function, y, preout, layer.activation, lmask,
+                average=False,
+            ) / eb
             if type(layer).__name__ == "CenterLossOutputLayer":
                 feats = aux[f"center_loss_input:{name}"].astype(self._loss_dtype)
                 centers = aux[f"centers:{name}"]
                 cls = jnp.argmax(y, axis=-1)
                 c = centers[cls]
-                total = total + 0.5 * layer.lambda_ * jnp.mean(
-                    jnp.sum((feats - c) ** 2, axis=-1))
-                diff = c - feats
+                # Row weights: labels mask excludes data-parallel padding rows
+                # from the center-loss term and the center updates.
+                w = jnp.ones(y.shape[0], self._loss_dtype) if lmask is None else (
+                    lmask.reshape(y.shape[0], -1)[:, 0].astype(self._loss_dtype))
+                total = total + 0.5 * layer.lambda_ * jnp.sum(
+                    w * jnp.sum((feats - c) ** 2, axis=-1)) / eb
+                diff = (c - feats) * w[:, None]
                 num = jax.ops.segment_sum(diff, cls, num_segments=layer.n_out)
-                cnt = jax.ops.segment_sum(jnp.ones_like(cls, jnp.float32), cls,
+                cnt = jax.ops.segment_sum(w.astype(jnp.float32), cls,
                                           num_segments=layer.n_out)
                 extra_state[name] = {"centers": centers - layer.alpha * num / (1.0 + cnt)[:, None]}
-        return total + self._l1_l2_penalty(params), extra_state
+        # Penalty divided by minibatch size, matching the reference objective
+        # (BaseOutputLayer.java:100-101, LayerUpdater.postApply:104-108).
+        return total + self._l1_l2_penalty(params) / eb0, extra_state
 
     # ----------------------------------------------------------- train step
 
     def _train_step(self, params, state, opt_state, inputs, labels, fmasks, lmasks,
-                    step, rng, carry_rnn=False):
+                    step, rng, carry_rnn=False, ebs=None):
         def loss_fn(p):
             outs, new_state, aux, omasks = self._forward_fn(
                 p, state, inputs, rng, True, fmasks, keep_rnn_state=carry_rnn
             )
-            loss, extra = self._loss_from_outputs(p, outs, labels, lmasks, aux, omasks)
+            loss, extra = self._loss_from_outputs(p, outs, labels, lmasks, aux,
+                                                  omasks, ebs)
             for n, s in extra.items():
                 new_state.setdefault(n, {}).update(s)
             return loss, new_state
@@ -338,20 +353,24 @@ class ComputationGraph:
                 iterator.reset()
             except Exception:
                 pass
-        g = self.conf.global_conf
-        tbptt = BackpropType.of(self.conf.backprop_type) == BackpropType.TRUNCATED_BPTT
         for item in iterator:
-            mds = _as_mds(item)
-            for _ in range(max(1, g.iterations)):
-                if tbptt and any(
-                    f.ndim == 3 and f.shape[1] > self.conf.tbptt_fwd_length
-                    for f in mds.features
-                ):
-                    self._fit_tbptt(mds)
-                else:
-                    self._fit_one(mds)
+            self._fit_dispatch(_as_mds(item))
         self.epoch += 1
         return self
+
+    def _fit_dispatch(self, mds: MultiDataSet):
+        """tBPTT/plain dispatch + iterations loop for one staged batch —
+        shared by `fit()` and `ParallelWrapper`."""
+        g = self.conf.global_conf
+        tbptt = BackpropType.of(self.conf.backprop_type) == BackpropType.TRUNCATED_BPTT
+        for _ in range(max(1, g.iterations)):
+            if tbptt and any(
+                f.ndim == 3 and f.shape[1] > self.conf.tbptt_fwd_length
+                for f in mds.features
+            ):
+                self._fit_tbptt(mds)
+            else:
+                self._fit_one(mds)
 
     def _fit_tbptt(self, mds: MultiDataSet):
         """Truncated BPTT over a DAG (reference: `ComputationGraph` tBPTT path):
@@ -359,16 +378,35 @@ class ComputationGraph:
         fwd = self.conf.tbptt_fwd_length
         t = max(f.shape[1] for f in mds.features if f.ndim == 3)
         saved_state = self.state
+        # Per-output divisors from the FULL-sequence masks (a row masked out
+        # of one chunk still counts — reference divide-by-minibatch).
+        full_lmasks = mds.labels_masks
+        ebs = tuple(
+            jnp.asarray(
+                losses_mod.effective_batch_size(
+                    l, full_lmasks[i] if full_lmasks is not None else None
+                ),
+                jnp.float32,
+            )
+            for i, l in enumerate(mds.labels)
+        )
         for lab in mds.labels:
             if lab.ndim != 3:
                 raise ValueError(
                     "Truncated BPTT requires 3-D per-timestep labels [b, t, c]"
                 )
 
-        def time_slice(a, sl):
+        def time_slice(a, sl, is_mask=False):
+            # Only 3-D [b, t, f] arrays (and, explicitly, 2-D [b, t] masks)
+            # are sequences; a static 2-D input whose feature dim happens to
+            # equal t must pass through untouched.
             if a is None:
                 return None
-            return a[:, sl] if a.ndim >= 2 and a.shape[1] == t else a
+            if a.ndim == 3 and a.shape[1] == t:
+                return a[:, sl]
+            if is_mask and a.ndim == 2 and a.shape[1] == t:
+                return a[:, sl]
+            return a
 
         n_chunks = math.ceil(t / fwd)
         for ci in range(n_chunks):
@@ -377,11 +415,11 @@ class ComputationGraph:
                 features=[time_slice(f, sl) for f in mds.features],
                 labels=[time_slice(l, sl) for l in mds.labels],
                 features_masks=None if mds.features_masks is None
-                else [time_slice(m, sl) for m in mds.features_masks],
+                else [time_slice(m, sl, is_mask=True) for m in mds.features_masks],
                 labels_masks=None if mds.labels_masks is None
-                else [time_slice(m, sl) for m in mds.labels_masks],
+                else [time_slice(m, sl, is_mask=True) for m in mds.labels_masks],
             )
-            self._fit_one(chunk, tbptt=True, count_iteration=False)
+            self._fit_one(chunk, tbptt=True, count_iteration=False, ebs=ebs)
         # Drop rnn carries, keep declared (BN) state.
         declared = {n: set(v.layer.state_shapes()) for n, v in self.layer_vertices.items()}
         self.state = {
@@ -400,7 +438,7 @@ class ComputationGraph:
         return sub
 
     def _fit_one(self, mds: MultiDataSet, tbptt: bool = False,
-                 count_iteration: bool = True):
+                 count_iteration: bool = True, ebs=None):
         step_fn = self._get_jit("train_step_tbptt" if tbptt else "train_step")
         step = jnp.asarray(self.iteration, jnp.float32)
         fmasks = None
@@ -409,12 +447,15 @@ class ComputationGraph:
         lmasks = None
         if mds.labels_masks is not None and any(m is not None for m in mds.labels_masks):
             lmasks = [None if m is None else jnp.asarray(m) for m in mds.labels_masks]
-        self.params_tree, self.state, self.opt_state, loss = step_fn(
+        args = [
             self.params_tree, self.state, self.opt_state,
             [jnp.asarray(f) for f in mds.features],
             [jnp.asarray(l) for l in mds.labels],
             fmasks, lmasks, step, self._next_rng(),
-        )
+        ]
+        if tbptt:
+            args.append(ebs)
+        self.params_tree, self.state, self.opt_state, loss = step_fn(*args)
         self._score = loss  # device scalar; sync deferred to score_value
         if count_iteration:
             self.iteration += 1

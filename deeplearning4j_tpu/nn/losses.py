@@ -114,6 +114,23 @@ def compute_per_example(
     return per
 
 
+def effective_batch_size(labels: jnp.ndarray, mask: Optional[jnp.ndarray] = None):
+    """Rows of the batch that participate in the loss.
+
+    Equals the minibatch size (the reference's divisor,
+    `BaseOutputLayer.computeScore`) whenever every example has at least one
+    unmasked entry — the only source of entirely-masked rows is this
+    framework's data-parallel batch padding (`parallel/wrapper.py`), which
+    must not dilute the score or the gradients of the real examples.
+    """
+    if mask is None:
+        return float(labels.shape[0])
+    m = mask != 0
+    if m.ndim > 1:
+        m = jnp.any(m, axis=tuple(range(1, m.ndim)))
+    return jnp.maximum(jnp.sum(m.astype(jnp.float32)), 1.0)
+
+
 def score(
     loss: Union[str, LossFunction],
     labels: jnp.ndarray,
@@ -124,19 +141,17 @@ def score(
 ) -> jnp.ndarray:
     """Scalar score: per-example losses reduced over the batch (and time).
 
-    Reference semantics (`BaseOutputLayer.computeScore`): sum of per-example
-    losses divided by minibatch size when `average`. With a time mask, the
-    divisor is the number of *unmasked* (batch, time) entries, matching the
-    reference's masked score normalization.
+    Reference semantics (`BaseOutputLayer.computeScore`,
+    `/root/reference/deeplearning4j-nn/.../layers/BaseOutputLayer.java:98-101`):
+    the per-entry losses (every timestep of a sequence, masked entries zeroed)
+    are SUMMED and divided by the minibatch size only — never by time length
+    or by the unmasked count. RNN losses therefore scale with sequence length,
+    exactly as in the reference. (Rows whose mask is entirely zero — produced
+    only by data-parallel batch padding — are excluded from the divisor, see
+    `effective_batch_size`.)
     """
     per = compute_per_example(loss, labels, preout, activation, mask)
     total = jnp.sum(per)
     if not average:
         return total
-    if mask is not None:
-        denom = jnp.maximum(jnp.sum(mask), 1.0)
-    elif per.ndim >= 2:
-        denom = float(per.shape[0] * per.shape[1])
-    else:
-        denom = float(per.shape[0])
-    return total / denom
+    return total / effective_batch_size(labels, mask)

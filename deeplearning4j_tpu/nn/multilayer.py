@@ -205,9 +205,9 @@ class MultiLayerNetwork:
                                         lmask, step, rng, carry_rnn=False)
             return jax.jit(step_plain, donate_argnums=(0, 2))
         if kind == "train_step_tbptt":
-            def step_tbptt(params, state, opt_state, x, y, fmask, lmask, step, rng):
+            def step_tbptt(params, state, opt_state, x, y, fmask, lmask, step, rng, eb):
                 return self._train_step(params, state, opt_state, x, y, fmask,
-                                        lmask, step, rng, carry_rnn=True)
+                                        lmask, step, rng, carry_rnn=True, eb=eb)
             return jax.jit(step_tbptt, donate_argnums=(0, 2))
         if kind == "feedforward":
             def ff_fn(params, state, x, fmask, rng):
@@ -239,7 +239,7 @@ class MultiLayerNetwork:
                     total = total + l1 * jnp.sum(jnp.abs(w))
         return total
 
-    def _loss_from_preout(self, params, preout, y, lmask, aux):
+    def _loss_from_preout(self, params, preout, y, lmask, aux, eb=None):
         layer = self.layers[-1]
         name = type(layer).__name__
         if name not in OUTPUT_LAYER_TYPES:
@@ -247,36 +247,51 @@ class MultiLayerNetwork:
                 f"Last layer ({name}) is not an output layer; cannot compute loss"
             )
         preout = preout.astype(self._loss_dtype)
+        # `eb` overrides the divisor for tBPTT chunks: a row fully masked
+        # within ONE chunk of a variable-length batch still counts toward the
+        # reference's divide-by-minibatch (computed from the full-sequence
+        # mask in `_fit_tbptt`), while data-parallel padding rows never do.
+        if eb is None:
+            eb = losses_mod.effective_batch_size(y, lmask)
         data_loss = losses_mod.score(
-            layer.loss_function, y, preout, layer.activation, lmask
-        )
+            layer.loss_function, y, preout, layer.activation, lmask,
+            average=False,
+        ) / eb
         extra_state = {}
         if isinstance(layer, CenterLossOutputLayer):
             feats = aux["center_loss_input"].astype(self._loss_dtype)
             centers = aux["centers"]
             cls = jnp.argmax(y, axis=-1)
             c = centers[cls]
-            data_loss = data_loss + 0.5 * layer.lambda_ * jnp.mean(
-                jnp.sum((feats - c) ** 2, axis=-1)
-            )
+            # Row weights: the labels mask excludes data-parallel padding rows
+            # from both the center-loss term and the center updates.
+            w = jnp.ones(y.shape[0], self._loss_dtype) if lmask is None else (
+                lmask.reshape(y.shape[0], -1)[:, 0].astype(self._loss_dtype))
+            data_loss = data_loss + 0.5 * layer.lambda_ * jnp.sum(
+                w * jnp.sum((feats - c) ** 2, axis=-1)
+            ) / eb
             # EMA center update (reference: CenterLossOutputLayer center updates)
-            diff = c - feats
+            diff = (c - feats) * w[:, None]
             num = jax.ops.segment_sum(diff, cls, num_segments=layer.n_out)
-            cnt = jax.ops.segment_sum(jnp.ones_like(cls, jnp.float32), cls,
+            cnt = jax.ops.segment_sum(w.astype(jnp.float32), cls,
                                       num_segments=layer.n_out)
             new_centers = centers - layer.alpha * num / (1.0 + cnt)[:, None]
             extra_state = {self.layer_keys[-1]: {"centers": new_centers}}
-        return data_loss + self._l1_l2_penalty(params), extra_state
+        # Reference: `score += fullNetworkL1 + fullNetworkL2; score /= miniBatch`
+        # (BaseOutputLayer.java:100-101) and the matching gradient
+        # `(g + l2*w)/miniBatch` (LayerUpdater.postApply:104-108) — so the
+        # penalty is divided by the batch size inside the differentiated loss.
+        return data_loss + self._l1_l2_penalty(params) / eb, extra_state
 
     # ----------------------------------------------------------- train step
 
     def _train_step(self, params, state, opt_state, x, y, fmask, lmask, step, rng,
-                    carry_rnn=False):
+                    carry_rnn=False, eb=None):
         def loss_fn(p):
             preout, new_state, _, aux = self._forward_fn(
                 p, state, x, rng, True, fmask, keep_rnn_state=carry_rnn
             )
-            loss, extra_state = self._loss_from_preout(p, preout, y, lmask, aux)
+            loss, extra_state = self._loss_from_preout(p, preout, y, lmask, aux, eb)
             for lk, s in extra_state.items():
                 new_state.setdefault(lk, {}).update(s)
             return loss, new_state
@@ -348,15 +363,22 @@ class MultiLayerNetwork:
         if not self.conf.backprop:
             self.epoch += 1
             return self
-        tbptt = BackpropType.of(self.conf.backprop_type) == BackpropType.TRUNCATED_BPTT
         for ds in iterator:
-            for _ in range(max(1, g.iterations)):
-                if tbptt and ds.features.ndim == 3 and ds.features.shape[1] > self.conf.tbptt_fwd_length:
-                    self._fit_tbptt(ds)
-                else:
-                    self._fit_one(ds)
+            self._fit_dispatch(ds)
         self.epoch += 1
         return self
+
+    def _fit_dispatch(self, ds: DataSet):
+        """tBPTT/plain dispatch + iterations loop for one staged batch —
+        shared by `fit()` and `ParallelWrapper` so sharded training honors
+        the same backprop-type config."""
+        g = self.conf.global_conf
+        tbptt = BackpropType.of(self.conf.backprop_type) == BackpropType.TRUNCATED_BPTT
+        for _ in range(max(1, g.iterations)):
+            if tbptt and ds.features.ndim == 3 and ds.features.shape[1] > self.conf.tbptt_fwd_length:
+                self._fit_tbptt(ds)
+            else:
+                self._fit_one(ds)
 
     # ------------------------------------------------------------- pretrain
 
@@ -456,6 +478,12 @@ class MultiLayerNetwork:
         t = ds.features.shape[1]
         n_chunks = math.ceil(t / fwd)
         saved_state = self.state
+        # Divisor from the FULL-sequence mask: a row masked out of one chunk
+        # (shorter sequence) still counts, reference divide-by-minibatch.
+        eb = jnp.asarray(
+            losses_mod.effective_batch_size(ds.features, ds.labels_mask),
+            jnp.float32,
+        )
         for ci in range(n_chunks):
             sl = slice(ci * fwd, min((ci + 1) * fwd, t))
             if ds.labels is None or ds.labels.ndim != 3:
@@ -477,7 +505,7 @@ class MultiLayerNetwork:
                 jnp.asarray(chunk.labels),
                 None if chunk.features_mask is None else jnp.asarray(chunk.features_mask),
                 None if chunk.labels_mask is None else jnp.asarray(chunk.labels_mask),
-                step, self._next_rng(),
+                step, self._next_rng(), eb,
             )
             self._score = loss  # device scalar; sync deferred to score_value
         # Reset rnn carries after the sequence; keep persistent (BN) state.

@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.parallel import mesh as mesh_mod
 
 
@@ -49,34 +49,45 @@ class ParallelWrapper:
         """Pad the batch dim up to a multiple of the mesh size (XLA needs the
         sharded dim divisible). Padded rows are masked out of the loss via a
         zeroed labels mask, so a ragged final batch trains identically to the
-        unpadded batch (the loss normalizes by the unmasked count)."""
-        x = np.asarray(ds.features)
-        b = x.shape[0]
+        unpadded batch (padded rows contribute zero to the summed loss, the
+        score divisor counts only real rows, and CenterLoss center updates
+        are mask-weighted). Known limitation: BatchNormalization batch
+        statistics in train mode are computed over the padded batch (the
+        duplicated last row slightly skews mean/var for a ragged batch);
+        exact for every batch divisible by the mesh."""
+        b = np.asarray(ds.features).shape[0]
         rem = b % self.n_devices
         if rem == 0:
             return ds
         pad = self.n_devices - rem
-
-        def pad_rows(a, fill_last=True):
-            if a is None:
-                return None
-            a = np.asarray(a)
-            tail = np.repeat(a[-1:], pad, axis=0) if fill_last else np.zeros(
-                (pad,) + a.shape[1:], a.dtype)
-            return np.concatenate([a, tail], axis=0)
-
-        labels = pad_rows(None if ds.labels is None else np.asarray(ds.labels))
+        labels = None if ds.labels is None else np.asarray(ds.labels)
         lmask = ds.labels_mask
         if labels is not None:
-            if lmask is None:
-                lmask_shape = (b,) if labels.ndim == 2 else (b, labels.shape[1])
-                lmask = np.ones(lmask_shape, x.dtype)
-            lmask = pad_rows(lmask, fill_last=False)  # zeros on padded rows
+            lmask = _full_labels_mask(labels, lmask)
         return DataSet(
-            pad_rows(x),
-            labels,
-            pad_rows(ds.features_mask, fill_last=False),
-            lmask,
+            _pad_rows(np.asarray(ds.features), pad),
+            _pad_rows(labels, pad),
+            _pad_rows(ds.features_mask, pad, fill_last=False),
+            _pad_rows(lmask, pad, fill_last=False),
+        )
+
+    def _pad_mds(self, mds: MultiDataSet) -> MultiDataSet:
+        """MultiDataSet variant of `_pad_dataset` for ComputationGraph."""
+        b = mds.num_examples()
+        rem = b % self.n_devices
+        if rem == 0:
+            return mds
+        pad = self.n_devices - rem
+        labels = [np.asarray(l) for l in mds.labels]
+        lmasks = list(mds.labels_masks) if mds.labels_masks is not None else [None] * len(labels)
+        lmasks = [_full_labels_mask(l, m) for l, m in zip(labels, lmasks)]
+        fmasks = mds.features_masks
+        return MultiDataSet(
+            features=[_pad_rows(np.asarray(f), pad) for f in mds.features],
+            labels=[_pad_rows(l, pad) for l in labels],
+            features_masks=None if fmasks is None
+            else [_pad_rows(m, pad, fill_last=False) for m in fmasks],
+            labels_masks=[_pad_rows(m, pad, fill_last=False) for m in lmasks],
         )
 
     def _shard(self, a):
@@ -87,22 +98,64 @@ class ParallelWrapper:
         )
 
     def fit(self, iterator):
-        """One pass over the iterator, each batch sharded across the mesh."""
+        """One pass over the iterator, each batch sharded across the mesh.
+
+        Accepts the same inputs as the wrapped engine's `fit`: DataSet /
+        iterator of DataSets for `MultiLayerNetwork`, plus MultiDataSet for
+        `ComputationGraph` (the reference ParallelWrapper supports both,
+        `ParallelWrapper.java:322` and the MDS variant `:151`)."""
         net = self.net
+        is_graph = type(net).__name__ == "ComputationGraph"
         if hasattr(iterator, "reset"):
             try:
                 iterator.reset()
             except Exception:
                 pass
-        if isinstance(iterator, DataSet):
+        if isinstance(iterator, (DataSet, MultiDataSet)):
             iterator = [iterator]
         for ds in iterator:
-            padded = self._pad_dataset(ds)
-            sharded = DataSet(
-                self._shard(np.asarray(padded.features)),
-                self._shard(None if padded.labels is None else np.asarray(padded.labels)),
-                self._shard(padded.features_mask),
-                self._shard(padded.labels_mask),
-            )
-            net._fit_one(sharded)
+            if is_graph:
+                mds = MultiDataSet.from_dataset(ds) if isinstance(ds, DataSet) else ds
+                padded = self._pad_mds(mds)
+                sharded = MultiDataSet(
+                    features=[self._shard(np.asarray(f)) for f in padded.features],
+                    labels=[self._shard(np.asarray(l)) for l in padded.labels],
+                    features_masks=None if padded.features_masks is None
+                    else [self._shard(m) for m in padded.features_masks],
+                    labels_masks=None if padded.labels_masks is None
+                    else [self._shard(m) for m in padded.labels_masks],
+                )
+            else:
+                if isinstance(ds, MultiDataSet):
+                    raise TypeError(
+                        "MultiDataSet input requires a ComputationGraph net"
+                    )
+                padded = self._pad_dataset(ds)
+                sharded = DataSet(
+                    self._shard(np.asarray(padded.features)),
+                    self._shard(None if padded.labels is None else np.asarray(padded.labels)),
+                    self._shard(padded.features_mask),
+                    self._shard(padded.labels_mask),
+                )
+            net._fit_dispatch(sharded)
         return net
+
+
+def _pad_rows(a, pad: int, fill_last: bool = True):
+    """Append `pad` rows: copies of the last row (features/labels — keeps
+    values finite and typical) or zeros (masks — padded rows masked out)."""
+    if a is None:
+        return None
+    a = np.asarray(a)
+    tail = np.repeat(a[-1:], pad, axis=0) if fill_last else np.zeros(
+        (pad,) + a.shape[1:], a.dtype)
+    return np.concatenate([a, tail], axis=0)
+
+
+def _full_labels_mask(labels: np.ndarray, lmask):
+    """An explicit all-ones labels mask matching the labels' batch/time shape
+    (so the pad can zero the appended rows)."""
+    if lmask is not None:
+        return np.asarray(lmask)
+    shape = (labels.shape[0],) if labels.ndim == 2 else labels.shape[:2]
+    return np.ones(shape, np.result_type(labels, np.float32))

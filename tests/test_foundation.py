@@ -78,7 +78,9 @@ class TestLosses:
         lab = jnp.zeros((2, 3, 4))
         mask = jnp.asarray([[1.0, 1, 0], [1, 0, 0]])
         s = losses.score("mse", lab, pre, "identity", mask=mask)
-        np.testing.assert_allclose(float(s), 1.0, rtol=1e-6)  # per-step mse of ones = 1
+        # Reference semantics (BaseOutputLayer.computeScore): sum of masked
+        # per-step losses (3 entries x 1.0) / minibatch size (2) = 1.5.
+        np.testing.assert_allclose(float(s), 1.5, rtol=1e-6)
 
     def test_all_losses_finite(self):
         pre = jnp.asarray([[0.3, -0.2, 0.8]])
