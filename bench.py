@@ -1,15 +1,26 @@
 #!/usr/bin/env python
 """Benchmark entry point (driver-run, real TPU).
 
-Measures `MultiLayerNetwork.fit()` samples/sec on the LeNet-MNIST config — the
-reference's first BASELINE.md config — using the reference's
+Measures BASELINE.md configs through the PUBLIC training path —
+`net.fit(AsyncDataSetIterator(...))`, i.e. host batches flowing through the
+prefetch pipeline into the jitted train step — using the reference's
 PerformanceListener counting semantics (samples/sec averaged over the timed
 interval, `optimize/listeners/PerformanceListener.java:86-102`).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-`vs_baseline` compares against the earliest recorded BENCH_r*.json (the first
-measurement establishes the baseline — the reference publishes no numbers,
-BASELINE.md).
+Configs (BASELINE.md):
+  1. ResNet-50 ImageNet (ComputationGraph)  — the headline samples/sec/chip
+  2. LeNet MNIST (MultiLayerNetwork)        — + legacy step-throughput metric
+  3. GravesLSTM char-RNN (tBPTT)
+plus an MFU estimate for ResNet-50 (XLA cost-analysis FLOPs / step time /
+chip peak).
+
+Prints ONE JSON line: the headline metric, with the remaining metrics nested
+under "extra". `vs_baseline` compares each metric against the earliest
+recorded BENCH_r*.json that carries it (the first measurement establishes
+the number to beat — the reference publishes none, BASELINE.md).
+
+Env knobs: BENCH_CONFIGS (comma list), BENCH_STEPS, BENCH_WARMUP,
+BENCH_BATCH_<CONFIG>, BENCH_PEAK_FLOPS.
 """
 
 import glob
@@ -21,67 +32,246 @@ import time
 
 import numpy as np
 
+_HERE = os.path.dirname(os.path.abspath(__file__))
 
-def _baseline_value(metric: str):
-    """Earliest prior BENCH_r{N}.json with the same metric, if any."""
-    best = None
-    for path in sorted(glob.glob(os.path.join(os.path.dirname(__file__) or ".", "BENCH_r*.json"))):
+
+def _iter_bench_records():
+    for path in sorted(glob.glob(os.path.join(_HERE, "BENCH_r*.json"))):
         try:
             with open(path) as f:
                 rec = json.load(f)
-            if isinstance(rec, dict) and rec.get("metric") == metric and rec.get("value"):
-                n = int(re.search(r"BENCH_r(\d+)", path).group(1))
-                if best is None or n < best[0]:
-                    best = (n, float(rec["value"]))
         except Exception:
             continue
+        n = int(re.search(r"BENCH_r(\d+)", path).group(1))
+        parsed = rec.get("parsed", rec) if isinstance(rec, dict) else None
+        if isinstance(parsed, dict):
+            yield n, parsed
+
+
+def _baseline_value(metric: str):
+    """Earliest prior BENCH_r{N}.json value for `metric` (headline or extra)."""
+    best = None
+    for n, parsed in _iter_bench_records():
+        value = None
+        if parsed.get("metric") == metric and parsed.get("value"):
+            value = float(parsed["value"])
+        else:
+            extra = parsed.get("extra") or {}
+            ent = extra.get(metric)
+            if isinstance(ent, dict) and ent.get("value"):
+                value = float(ent["value"])
+        if value is not None and (best is None or n < best[0]):
+            best = (n, value)
     return best[1] if best else None
 
 
-def main():
-    batch = int(os.environ.get("BENCH_BATCH", "512"))
-    steps = int(os.environ.get("BENCH_STEPS", "60"))
-    warmup = int(os.environ.get("BENCH_WARMUP", "10"))
+def _entry(metric, value, unit):
+    base = _baseline_value(metric)
+    return {
+        "metric": metric,
+        "value": round(value, 3 if value < 100 else 1),
+        "unit": unit,
+        "vs_baseline": round(value / base, 3) if base else 1.0,
+    }
 
+
+# ------------------------------------------------------------------ timing
+
+
+def _timed_fit(net, make_batch, batch, steps, warmup, distinct=4):
+    """Time `net.fit` over an AsyncDataSetIterator of host numpy batches."""
     import jax
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import AsyncDataSetIterator
+
+    rng = np.random.RandomState(0)
+    pool = [make_batch(rng, batch) for _ in range(distinct)]
+
+    def batches(n):
+        return [DataSet(*pool[i % distinct]) for i in range(n)]
+
+    net.fit(AsyncDataSetIterator(batches(max(warmup, 2)), queue_size=4))
+    jax.block_until_ready(net.params_tree)
+    t0 = time.perf_counter()
+    net.fit(AsyncDataSetIterator(batches(steps), queue_size=4))
+    jax.block_until_ready(net.params_tree)
+    dt = time.perf_counter() - t0
+    return batch * steps / dt, dt / steps
+
+
+def _step_flops(net, x, y):
+    """XLA cost-analysis FLOPs of the engine's actual jitted train step."""
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        fn = net._get_jit("train_step")
+        if type(net).__name__ == "ComputationGraph":
+            args = (net.params_tree, net.state, net.opt_state,
+                    [jnp.asarray(x)], [jnp.asarray(y)], None, None,
+                    jnp.asarray(0.0, jnp.float32), jax.random.PRNGKey(0))
+        else:
+            args = (net.params_tree, net.state, net.opt_state,
+                    jnp.asarray(x), jnp.asarray(y), None, None,
+                    jnp.asarray(0.0, jnp.float32), jax.random.PRNGKey(0))
+        lowered = fn.lower(*args)
+        try:
+            cost = lowered.compile().cost_analysis()
+        except Exception:
+            cost = lowered.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        flops = float(cost.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception:
+        return None
+
+
+def _chip_peak_flops():
+    """Peak bf16 FLOPs/sec for the local chip (override: BENCH_PEAK_FLOPS)."""
+    env = os.environ.get("BENCH_PEAK_FLOPS")
+    if env:
+        return float(env)
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    table = [
+        ("v5 lite", 197e12), ("v5e", 197e12),
+        ("v5p", 459e12), ("v5", 459e12),
+        ("v6", 918e12), ("trillium", 918e12),
+        ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
+    ]
+    for key, peak in table:
+        if key in kind:
+            return peak
+    return None
+
+
+# ----------------------------------------------------------------- configs
+
+
+def bench_lenet(steps, warmup):
     from deeplearning4j_tpu.models import zoo
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
+    batch = int(os.environ.get("BENCH_BATCH_LENET", "512"))
     net = MultiLayerNetwork(zoo.lenet_mnist()).init()
 
+    def mk(rng, b):
+        return (rng.rand(b, 28, 28, 1).astype("float32"),
+                np.eye(10, dtype="float32")[rng.randint(0, 10, b)])
+
+    sps, _ = _timed_fit(net, mk, batch, steps, warmup)
+    return _entry("lenet_mnist_pipeline_samples_per_sec", sps, "samples/sec")
+
+
+def bench_lenet_step(steps, warmup):
+    """Legacy r01 metric: pre-staged device batch, step throughput only."""
+    import jax
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.models import zoo
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    batch = int(os.environ.get("BENCH_BATCH_LENET", "512"))
+    net = MultiLayerNetwork(zoo.lenet_mnist()).init()
     rng = np.random.RandomState(0)
-    # Pre-stage the batch on device: the framework's async prefetch pipeline
-    # overlaps host->device transfer with compute in real training, so the
-    # benchmark measures fit() step throughput (PerformanceListener semantics),
-    # not the tunnel's transfer latency.
     x = jax.device_put(rng.rand(batch, 28, 28, 1).astype("float32"))
     y = jax.device_put(np.eye(10, dtype="float32")[rng.randint(0, 10, batch)])
-
-    # Warmup (includes compile).
     for _ in range(warmup):
-        net._fit_one(_ds(x, y))
+        net._fit_one(DataSet(x, y))
     jax.block_until_ready(net.params_tree)
-
     t0 = time.perf_counter()
     for _ in range(steps):
-        net._fit_one(_ds(x, y))
+        net._fit_one(DataSet(x, y))
     jax.block_until_ready(net.params_tree)
-    dt = time.perf_counter() - t0
-
-    sps = batch * steps / dt
-    metric = "lenet_mnist_fit_samples_per_sec"
-    base = _baseline_value(metric)
-    print(json.dumps({
-        "metric": metric,
-        "value": round(sps, 1),
-        "unit": "samples/sec",
-        "vs_baseline": round(sps / base, 3) if base else 1.0,
-    }))
+    sps = batch * steps / (time.perf_counter() - t0)
+    return _entry("lenet_mnist_fit_samples_per_sec", sps, "samples/sec")
 
 
-def _ds(x, y):
-    from deeplearning4j_tpu.datasets.dataset import DataSet
-    return DataSet(x, y)
+def bench_char_rnn(steps, warmup):
+    from deeplearning4j_tpu.models import zoo
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    batch = int(os.environ.get("BENCH_BATCH_CHAR_RNN", "32"))
+    vocab, t = 77, 100
+    net = MultiLayerNetwork(zoo.char_rnn(vocab_size=vocab)).init()
+
+    def mk(rng, b):
+        idx = rng.randint(0, vocab, (b, t))
+        x = np.eye(vocab, dtype="float32")[idx]
+        y = np.eye(vocab, dtype="float32")[np.roll(idx, -1, axis=1)]
+        return x, y
+
+    sps, _ = _timed_fit(net, mk, batch, steps, warmup)
+    return _entry("char_rnn_fit_samples_per_sec", sps, "samples/sec")
+
+
+def bench_resnet50(steps, warmup):
+    import ml_dtypes
+
+    from deeplearning4j_tpu.models.resnet import resnet50
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    batch = int(os.environ.get("BENCH_BATCH_RESNET50", "128"))
+    image = int(os.environ.get("BENCH_IMAGE_RESNET50", "224"))
+    net = ComputationGraph(
+        resnet50(n_classes=1000, image=image, dtype="bfloat16")
+    ).init()
+
+    def mk(rng, b):
+        x = rng.rand(b, image, image, 3).astype("float32")
+        return (x.astype(ml_dtypes.bfloat16),
+                np.eye(1000, dtype="float32")[rng.randint(0, 1000, b)])
+
+    sps, step_time = _timed_fit(net, mk, batch, steps, warmup, distinct=2)
+    head = _entry("resnet50_imagenet_fit_samples_per_sec_per_chip", sps,
+                  "samples/sec/chip")
+
+    extra_metrics = {}
+    rng = np.random.RandomState(0)
+    x, y = mk(rng, batch)
+    flops = _step_flops(net, x, y)
+    peak = _chip_peak_flops()
+    if flops and peak:
+        mfu = flops / step_time / peak
+        extra_metrics["resnet50_train_mfu"] = _entry(
+            "resnet50_train_mfu", mfu, "fraction_of_peak")
+    return head, extra_metrics
+
+
+def main():
+    steps = int(os.environ.get("BENCH_STEPS", "30"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "5"))
+    configs = os.environ.get(
+        "BENCH_CONFIGS", "resnet50,lenet,char_rnn,lenet_step").split(",")
+
+    head, extra = None, {}
+    if "resnet50" in configs:
+        head, extra = bench_resnet50(max(10, steps // 3), warmup)
+    if "lenet" in configs:
+        e = bench_lenet(steps, warmup)
+        extra[e["metric"]] = e
+    if "char_rnn" in configs:
+        e = bench_char_rnn(max(10, steps // 3), warmup)
+        extra[e["metric"]] = e
+    if "lenet_step" in configs:
+        e = bench_lenet_step(steps, warmup)
+        extra[e["metric"]] = e
+    if head is None:  # resnet50 excluded: promote the first extra metric
+        if not extra:
+            print(json.dumps({
+                "metric": "bench_config_error", "value": 0, "unit": "none",
+                "vs_baseline": 0,
+                "error": f"no recognized config in BENCH_CONFIGS={configs}"}))
+            return 1
+        first = next(iter(extra))
+        head = extra.pop(first)
+    out = dict(head)
+    out["extra"] = {k: {kk: vv for kk, vv in v.items() if kk != "metric"}
+                    for k, v in extra.items()}
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

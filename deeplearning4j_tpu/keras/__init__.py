@@ -1,0 +1,18 @@
+"""Keras interop: HDF5 model import + pretrained zoo.
+
+TPU-native replacement for the reference's `deeplearning4j-modelimport`
+module (`KerasModelImport.java`, `KerasModel.java`,
+`trainedmodels/TrainedModels.java`).
+"""
+
+from deeplearning4j_tpu.keras.import_model import (  # noqa: F401
+    KerasImportException,
+    KerasModelImport,
+    import_keras_model_and_weights,
+    import_keras_sequential_model_and_weights,
+)
+from deeplearning4j_tpu.keras.trained_models import (  # noqa: F401
+    TrainedModels,
+    preprocess_imagenet,
+    vgg16_config,
+)

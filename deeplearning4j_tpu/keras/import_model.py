@@ -1,0 +1,667 @@
+"""Keras HDF5 model import.
+
+TPU-native equivalent of the reference's `deeplearning4j-modelimport`
+(`KerasModel.java`, `KerasSequentialModel.java`, `KerasModelImport.java`,
+`Hdf5Archive.java` — JavaCPP HDF5 there, `h5py` here): parses the Keras
+1.x/2.x JSON topology stored in a `.h5` model file into this framework's
+config DSL and maps the stored weight tensors onto the engines' param
+pytrees.
+
+Scope (mirrors the reference's supported layer set,
+`deeplearning4j-modelimport/.../keras/layers/`):
+- Sequential -> `MultiLayerConfiguration` / `MultiLayerNetwork`
+- Model (functional, linear or merge DAGs) -> `ComputationGraph`
+- Layers: Dense, Convolution2D/Conv2D, MaxPooling2D, AveragePooling2D,
+  GlobalMax/AveragePooling2D, ZeroPadding2D (folded into the next conv),
+  Flatten (becomes a preprocessor), Dropout, Activation, Embedding, LSTM,
+  BatchNormalization, Merge/Add/Concatenate, InputLayer.
+
+Weight-layout conversions:
+- Conv kernels: Theano dim-ordering `[out, in, kh, kw]` -> HWIO
+  `[kh, kw, in, out]`; TensorFlow ordering passes through.
+- LSTM: Keras-1 twelve-array form (`W_i,U_i,b_i,W_c,U_c,b_c,...`) and
+  Keras-2 packed form (kernel/recurrent/bias, gate order i,f,c,o) both map
+  to this framework's `[n_in, 4u]` i,f,o,g packing.
+- BatchNormalization: gamma/beta params + running mean/var state.
+
+Data layout note: imported nets use this framework's feature-last layouts
+(`[b, h, w, c]` images, `[b, t, f]` sequences) regardless of the Keras
+file's `dim_ordering` — only the weights are transposed, so activations
+match the original model on equivalently-transposed inputs.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.enums import PoolingType
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    ActivationLayer,
+    BatchNormalization,
+    ConvolutionLayer,
+    DenseLayer,
+    DropoutLayer,
+    EmbeddingLayer,
+    GlobalPoolingLayer,
+    LSTM,
+    OutputLayer,
+    RnnOutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.conf.neural_net import NeuralNetConfiguration
+
+
+class KerasImportException(Exception):
+    """Unsupported/invalid Keras file (reference:
+    `InvalidKerasConfigurationException`/`UnsupportedKerasConfigurationException`)."""
+
+
+_ACTIVATIONS = {
+    "relu": "relu", "tanh": "tanh", "sigmoid": "sigmoid", "softmax": "softmax",
+    "linear": "identity", "softplus": "softplus", "softsign": "softsign",
+    "hard_sigmoid": "hardsigmoid", "elu": "elu", "selu": "selu",
+    "swish": "swish", "gelu": "gelu",
+}
+
+_LOSSES = {
+    "categorical_crossentropy": "mcxent",
+    "sparse_categorical_crossentropy": "mcxent",
+    "binary_crossentropy": "xent",
+    "mean_squared_error": "mse", "mse": "mse",
+    "mean_absolute_error": "mean_absolute_error", "mae": "mean_absolute_error",
+    "kullback_leibler_divergence": "kl_divergence",
+    "poisson": "poisson",
+    "cosine_proximity": "cosine_proximity",
+    "hinge": "hinge", "squared_hinge": "squared_hinge",
+}
+
+
+def _map_activation(name: Optional[str]) -> str:
+    if not name:
+        return "identity"
+    try:
+        return _ACTIVATIONS[name]
+    except KeyError:
+        raise KerasImportException(f"Unsupported Keras activation: {name!r}")
+
+
+def _map_loss(name: Optional[str]) -> str:
+    if not name:
+        return "mse"
+    return _LOSSES.get(name, "mse")
+
+
+def _pair(v, default=(1, 1)) -> Tuple[int, int]:
+    if v is None:
+        return default
+    if isinstance(v, (list, tuple)):
+        return (int(v[0]), int(v[1] if len(v) > 1 else v[0]))
+    return (int(v), int(v))
+
+
+class _KerasLayer:
+    """One parsed Keras layer: class name, config dict, weight group name."""
+
+    def __init__(self, spec: Dict[str, Any]):
+        self.class_name = spec.get("class_name")
+        self.config = spec.get("config", {}) or {}
+        self.name = self.config.get("name") or spec.get("name")
+        self.inbound = _inbound_names(spec)
+
+
+def _inbound_names(spec) -> List[str]:
+    nodes = spec.get("inbound_nodes") or []
+    if not nodes:
+        return []
+    first = nodes[0]
+    if isinstance(first, dict):  # Keras 3-style
+        first = first.get("args", [])
+    names = []
+    for entry in first:
+        if isinstance(entry, (list, tuple)) and entry:
+            names.append(entry[0])
+    return names
+
+
+def _input_type_from_shape(shape, dim_ordering: str) -> InputType:
+    """Keras batch_input_shape (minus batch dim) -> InputType."""
+    dims = [int(d) for d in shape if d is not None]
+    if len(dims) == 3:
+        if dim_ordering == "th":
+            c, h, w = dims
+        else:
+            h, w, c = dims
+        return InputType.convolutional(h, w, c)
+    if len(dims) == 2:
+        t, f = dims
+        return InputType.recurrent(f, t)
+    if len(dims) == 1:
+        return InputType.feed_forward(dims[0])
+    raise KerasImportException(f"Unsupported input shape {shape}")
+
+
+def _layer_dim_ordering(cfg: Dict[str, Any]) -> str:
+    v = cfg.get("dim_ordering") or cfg.get("data_format")
+    if v in ("th", "channels_first"):
+        return "th"
+    if v in ("tf", "channels_last"):
+        return "tf"
+    return "th"  # Keras 1 default
+
+
+class _Converter:
+    """Keras layer list -> framework layers, tracking weight mapping."""
+
+    def __init__(self, training_config: Optional[Dict[str, Any]] = None):
+        self.training_config = training_config or {}
+        self.layers: List[Any] = []
+        # our-layer-index -> (_KerasLayer, kind) for weight loading
+        self.weight_map: Dict[int, Tuple[_KerasLayer, str]] = {}
+        self.input_type: Optional[InputType] = None
+        self._pending_pad: Tuple[int, int] = (0, 0)
+        self.dim_ordering = "th"
+
+    # -------------------------------------------------------------- layers
+
+    def convert(self, kl: _KerasLayer) -> None:
+        cfg = kl.config
+        cname = kl.class_name
+        if self.input_type is None and cfg.get("batch_input_shape"):
+            self.dim_ordering = _layer_dim_ordering(cfg)
+            self.input_type = _input_type_from_shape(
+                cfg["batch_input_shape"][1:], self.dim_ordering)
+        handler = getattr(self, f"_on_{cname}", None)
+        if handler is None:
+            raise KerasImportException(f"Unsupported Keras layer: {cname!r}")
+        handler(kl)
+
+    def _add(self, layer, kl: Optional[_KerasLayer] = None, kind: str = ""):
+        self.layers.append(layer)
+        if kl is not None:
+            self.weight_map[len(self.layers) - 1] = (kl, kind)
+
+    def _on_InputLayer(self, kl):
+        pass  # shape captured in convert()
+
+    def _on_Dense(self, kl):
+        cfg = kl.config
+        n_out = int(cfg.get("output_dim") or cfg.get("units"))
+        act = _map_activation(cfg.get("activation"))
+        self._add(DenseLayer(n_out=n_out, activation=act), kl, "dense")
+
+    def _on_Convolution2D(self, kl):
+        cfg = kl.config
+        n_out = int(cfg.get("nb_filter") or cfg.get("filters"))
+        if cfg.get("nb_row") is not None:
+            kernel = (int(cfg["nb_row"]), int(cfg["nb_col"]))
+        else:
+            kernel = _pair(cfg.get("kernel_size"))
+        stride = _pair(cfg.get("subsample") or cfg.get("strides"))
+        border = cfg.get("border_mode") or cfg.get("padding") or "valid"
+        mode = "same" if border == "same" else "truncate"
+        pad = self._pending_pad
+        self._pending_pad = (0, 0)
+        self._add(
+            ConvolutionLayer(
+                n_out=n_out, kernel_size=kernel, stride=stride, padding=pad,
+                convolution_mode=mode,
+                activation=_map_activation(cfg.get("activation")),
+            ),
+            kl, "conv",
+        )
+
+    _on_Conv2D = _on_Convolution2D
+
+    def _on_ZeroPadding2D(self, kl):
+        p = kl.config.get("padding") or (1, 1)
+        if isinstance(p, (list, tuple)) and p and isinstance(p[0], (list, tuple)):
+            # ((top, bottom), (left, right)) — only symmetric supported
+            (t, b), (l, r) = p
+            if t != b or l != r:
+                raise KerasImportException("Asymmetric ZeroPadding2D unsupported")
+            p = (t, l)
+        ph, pw = _pair(p)
+        self._pending_pad = (self._pending_pad[0] + ph, self._pending_pad[1] + pw)
+
+    def _pool(self, kl, ptype):
+        cfg = kl.config
+        kernel = _pair(cfg.get("pool_size"), (2, 2))
+        stride = _pair(cfg.get("strides"), kernel)
+        border = cfg.get("border_mode") or cfg.get("padding") or "valid"
+        self._add(SubsamplingLayer(
+            pooling_type=ptype, kernel_size=kernel, stride=stride,
+            convolution_mode="same" if border == "same" else "truncate",
+        ))
+
+    def _on_MaxPooling2D(self, kl):
+        self._pool(kl, PoolingType.MAX)
+
+    def _on_AveragePooling2D(self, kl):
+        self._pool(kl, PoolingType.AVG)
+
+    def _on_GlobalMaxPooling2D(self, kl):
+        self._add(GlobalPoolingLayer(pooling_type=PoolingType.MAX))
+
+    def _on_GlobalAveragePooling2D(self, kl):
+        self._add(GlobalPoolingLayer(pooling_type=PoolingType.AVG))
+
+    _on_GlobalMaxPooling1D = _on_GlobalMaxPooling2D
+    _on_GlobalAveragePooling1D = _on_GlobalAveragePooling2D
+
+    def _on_Flatten(self, kl):
+        pass  # shape change handled by automatic input-type preprocessors
+
+    def _on_Dropout(self, kl):
+        p = float(kl.config.get("p", kl.config.get("rate", 0.5)))
+        # Keras p = drop fraction; framework dropout = retain probability.
+        self._add(DropoutLayer(dropout=1.0 - p))
+
+    def _on_Activation(self, kl):
+        self._add(ActivationLayer(
+            activation=_map_activation(kl.config.get("activation"))))
+
+    def _on_Embedding(self, kl):
+        cfg = kl.config
+        self._add(EmbeddingLayer(
+            n_in=int(cfg.get("input_dim")),
+            n_out=int(cfg.get("output_dim")),
+            has_bias=False,
+        ), kl, "embedding")
+        if self.input_type is None and cfg.get("input_length"):
+            self.input_type = InputType.feed_forward(int(cfg["input_length"]))
+
+    def _on_LSTM(self, kl):
+        cfg = kl.config
+        if cfg.get("return_sequences") is False:
+            raise KerasImportException(
+                "LSTM(return_sequences=False) unsupported in Sequential import"
+                " — use the functional import with a LastTimeStep vertex")
+        n_out = int(cfg.get("output_dim") or cfg.get("units"))
+        self._add(LSTM(
+            n_out=n_out,
+            activation=_map_activation(cfg.get("activation")),
+            gate_activation=_map_activation(
+                cfg.get("inner_activation") or cfg.get("recurrent_activation")),
+        ), kl, "lstm")
+
+    def _on_BatchNormalization(self, kl):
+        cfg = kl.config
+        self._add(BatchNormalization(
+            eps=float(cfg.get("epsilon", 1e-5)),
+            decay=float(cfg.get("momentum", 0.9)),
+            activation="identity",  # Keras BN has no fused activation
+        ), kl, "batchnorm")
+
+    # ------------------------------------------------------- finalization
+
+    def finalize_output_layer(self):
+        """Make the net trainable: the tail becomes an output layer carrying
+        the training-config loss (reference: `KerasModel` uses the compiled
+        loss when enforceTrainingConfig). Dense tails convert to OutputLayer
+        (identical weight layout); Activation tails convert to a param-free
+        LossLayer; any other tail (LSTM, pooling, ...) gets a LossLayer
+        appended — appending keeps `weight_map` indices valid."""
+        from deeplearning4j_tpu.nn.conf.layers import LossLayer
+
+        loss = _map_loss(self.training_config.get("loss"))
+        for i in range(len(self.layers) - 1, -1, -1):
+            layer = self.layers[i]
+            if isinstance(layer, DropoutLayer):
+                continue
+            act = getattr(layer, "activation", None) or "identity"
+            if loss == "mse" and act == "softmax":
+                loss = "mcxent"
+            if isinstance(layer, DenseLayer) and not isinstance(layer, OutputLayer):
+                self.layers[i] = OutputLayer(
+                    n_out=layer.n_out, activation=act, loss_function=loss)
+            elif isinstance(layer, ActivationLayer):
+                self.layers[i] = LossLayer(activation=act, loss_function=loss)
+            elif type(layer).__name__ not in (
+                    "OutputLayer", "RnnOutputLayer", "LossLayer"):
+                # param-free loss head keeps the Keras function unchanged
+                self.layers.append(LossLayer(activation="identity",
+                                             loss_function=loss))
+            break
+
+
+# ----------------------------------------------------------- weight loading
+
+
+def _gate_slices(u):
+    return slice(0, u), slice(u, 2 * u), slice(2 * u, 3 * u), slice(3 * u, 4 * u)
+
+
+def _lstm_from_keras(arrays: List[np.ndarray], n_in: int, u: int):
+    """Keras LSTM weights -> {W [n_in,4u], RW [u,4u], b [4u]} (i,f,o,g)."""
+    if len(arrays) == 12:
+        # Keras 1: W_i,U_i,b_i, W_c,U_c,b_c, W_f,U_f,b_f, W_o,U_o,b_o
+        Wi, Ui, bi, Wc, Uc, bc, Wf, Uf, bf, Wo, Uo, bo = arrays
+        W = np.concatenate([Wi, Wf, Wo, Wc], axis=1)
+        RW = np.concatenate([Ui, Uf, Uo, Uc], axis=1)
+        b = np.concatenate([bi, bf, bo, bc])
+    elif len(arrays) == 3:
+        # Keras 2: kernel/recurrent_kernel/bias, gate order i,f,c,o
+        k, rk, b2 = arrays
+        i, f, c, o = (k[:, s] for s in _gate_slices(u))
+        ri, rf, rc, ro = (rk[:, s] for s in _gate_slices(u))
+        bi_, bf_, bc_, bo_ = (b2[s] for s in _gate_slices(u))
+        W = np.concatenate([i, f, o, c], axis=1)
+        RW = np.concatenate([ri, rf, ro, rc], axis=1)
+        b = np.concatenate([bi_, bf_, bo_, bc_])
+    else:
+        raise KerasImportException(
+            f"Unexpected LSTM weight count: {len(arrays)}")
+    if W.shape != (n_in, 4 * u) or RW.shape != (u, 4 * u):
+        raise KerasImportException(
+            f"LSTM weight shapes {W.shape}/{RW.shape} don't match "
+            f"n_in={n_in}, units={u}")
+    return {"W": W, "RW": RW, "b": b}
+
+
+def _conv_kernel(kernel: np.ndarray, cfg: Dict[str, Any], n_in: int,
+                 n_out: int) -> np.ndarray:
+    """Keras conv kernel -> HWIO."""
+    if kernel.ndim != 4:
+        raise KerasImportException(f"Conv kernel ndim {kernel.ndim}")
+    ordering = _layer_dim_ordering(cfg)
+    if ordering == "th" and kernel.shape[0] == n_out and kernel.shape[1] == n_in:
+        return np.transpose(kernel, (2, 3, 1, 0))  # OIHW -> HWIO
+    if kernel.shape[-1] == n_out and kernel.shape[-2] == n_in:
+        return kernel  # already HWIO
+    if kernel.shape[0] == n_out and kernel.shape[1] == n_in:
+        return np.transpose(kernel, (2, 3, 1, 0))
+    raise KerasImportException(
+        f"Conv kernel shape {kernel.shape} doesn't match n_in={n_in}, "
+        f"n_out={n_out}")
+
+
+def _layer_weight_arrays(weights_root, name: str) -> List[np.ndarray]:
+    if name not in weights_root:
+        return []
+    grp = weights_root[name]
+    names = [n.decode() if isinstance(n, bytes) else str(n)
+             for n in grp.attrs.get("weight_names", [])]
+    if not names:
+        # fall back: datasets in insertion order (h5py preserves creation order
+        # only with track_order; sort as best effort)
+        def walk(g, prefix=""):
+            out = []
+            for k in g:
+                item = g[k]
+                if hasattr(item, "shape"):
+                    out.append(prefix + k)
+                else:
+                    out.extend(walk(item, prefix + k + "/"))
+            return out
+        names = walk(grp)
+    return [np.asarray(grp[n]) for n in names]
+
+
+def _apply_weights(net, weight_map, weights_root, key_for_index,
+                   conf_for_index) -> None:
+    import jax.numpy as jnp
+
+    for our_idx, (kl, kind) in weight_map.items():
+        arrays = _layer_weight_arrays(weights_root, kl.name)
+        if not arrays:
+            raise KerasImportException(
+                f"No weights found for Keras layer {kl.name!r}")
+        # Conf comes from the BUILT net (shape inference has filled n_in).
+        conf = conf_for_index(our_idx)
+        lk = key_for_index(our_idx)
+        tgt = dict(net.params_tree.get(lk, {}))
+        dtype = next(iter(tgt.values())).dtype if tgt else jnp.float32
+        if kind == "dense":
+            W, b = (arrays + [np.zeros(conf.n_out)])[:2]
+            if W.shape != (conf.n_in, conf.n_out):
+                raise KerasImportException(
+                    f"Dense weight shape {W.shape} != "
+                    f"({conf.n_in}, {conf.n_out}) for {kl.name!r}")
+            tgt["W"] = jnp.asarray(W, dtype)
+            if "b" in tgt:
+                tgt["b"] = jnp.asarray(b, dtype)
+        elif kind == "conv":
+            kernel = _conv_kernel(arrays[0], kl.config, conf.n_in, conf.n_out)
+            tgt["W"] = jnp.asarray(kernel, dtype)
+            if "b" in tgt and len(arrays) > 1:
+                tgt["b"] = jnp.asarray(arrays[1], dtype)
+        elif kind == "embedding":
+            tgt["W"] = jnp.asarray(arrays[0], dtype)
+        elif kind == "lstm":
+            mapped = _lstm_from_keras(arrays, conf.n_in, conf.n_out)
+            for k, v in mapped.items():
+                tgt[k] = jnp.asarray(v, dtype)
+        elif kind == "batchnorm":
+            gamma, beta, mean, var = arrays[:4]
+            tgt["gamma"] = jnp.asarray(gamma, dtype)
+            tgt["beta"] = jnp.asarray(beta, dtype)
+            st = dict(net.state.get(lk, {}))
+            st["mean"] = jnp.asarray(mean, dtype)
+            st["var"] = jnp.asarray(var, dtype)
+            net.state[lk] = st
+        net.params_tree[lk] = tgt
+
+
+# ------------------------------------------------------------- entry points
+
+
+def _read_model_file(path):
+    import h5py
+
+    f = h5py.File(path, "r")
+    cfg_raw = f.attrs.get("model_config")
+    if cfg_raw is None:
+        f.close()
+        raise KerasImportException(
+            f"{path}: no model_config attribute (weights-only file? The "
+            "reference requires topology+weights too, KerasModelImport.java)")
+    if isinstance(cfg_raw, bytes):
+        cfg_raw = cfg_raw.decode()
+    topo = json.loads(cfg_raw)
+    train_raw = f.attrs.get("training_config")
+    training = None
+    if train_raw is not None:
+        if isinstance(train_raw, bytes):
+            train_raw = train_raw.decode()
+        training = json.loads(train_raw)
+    weights_root = f["model_weights"] if "model_weights" in f else f
+    return f, topo, training, weights_root
+
+
+def _sequential_layer_specs(topo) -> List[Dict[str, Any]]:
+    cfg = topo.get("config")
+    if isinstance(cfg, list):  # Keras 1
+        return cfg
+    if isinstance(cfg, dict) and "layers" in cfg:  # Keras 2
+        return cfg["layers"]
+    raise KerasImportException("Unrecognized Sequential config format")
+
+
+def import_keras_sequential_model_and_weights(path, input_type: Optional[InputType] = None):
+    """Keras Sequential .h5 -> initialized `MultiLayerNetwork`.
+
+    Reference: `KerasModelImport.importKerasSequentialModelAndWeights`
+    (`deeplearning4j-modelimport/.../KerasModelImport.java`)."""
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    f, topo, training, weights_root = _read_model_file(path)
+    try:
+        if topo.get("class_name") != "Sequential":
+            raise KerasImportException(
+                f"Not a Sequential model: {topo.get('class_name')!r} "
+                "(use import_keras_model_and_weights)")
+        conv = _Converter(training)
+        for spec in _sequential_layer_specs(topo):
+            conv.convert(_KerasLayer(spec))
+        conv.finalize_output_layer()
+        itype = input_type or conv.input_type
+        if itype is None:
+            raise KerasImportException(
+                "Could not infer input shape; pass input_type=")
+        builder = (NeuralNetConfiguration.builder()
+                   .updater("sgd").learning_rate(
+                       float(_training_lr(training)))
+                   .list())
+        for layer in conv.layers:
+            builder.layer(layer)
+        mln_conf = builder.set_input_type(itype).build()
+        net = MultiLayerNetwork(mln_conf).init()
+        _apply_weights(net, conv.weight_map, weights_root,
+                       lambda i: net.layer_keys[i],
+                       lambda i: net.layers[i])
+        return net
+    finally:
+        f.close()
+
+
+def _training_lr(training) -> float:
+    try:
+        return float(training["optimizer_config"]["config"]["lr"])
+    except Exception:
+        return 0.01
+
+
+def import_keras_model_and_weights(path):
+    """Keras functional Model .h5 -> initialized `ComputationGraph`.
+
+    Supports linear chains plus Merge/Add/Concatenate join vertices
+    (reference: `KerasModel.java` graph construction)."""
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    f, topo, training, weights_root = _read_model_file(path)
+    try:
+        if topo.get("class_name") == "Sequential":
+            raise KerasImportException(
+                "Sequential model: use import_keras_sequential_model_and_weights")
+        cfg = topo["config"]
+        specs = [_KerasLayer(s) for s in cfg["layers"]]
+        input_names = [e[0] for e in cfg.get("input_layers", [])]
+        output_names = [e[0] for e in cfg.get("output_layers", [])]
+
+        gb = (NeuralNetConfiguration.builder()
+              .updater("sgd").learning_rate(float(_training_lr(training)))
+              .graph_builder())
+        input_types = []
+        graph_names: Dict[str, str] = {}  # keras name -> graph vertex name
+        # keras ZeroPadding2D name -> (ph, pw): folded into the conv that
+        # actually CONSUMES it (graph connectivity, not file order).
+        zero_pads: Dict[str, Tuple[int, int]] = {}
+        weight_jobs = []  # (graph name, keras layer, kind, our conf)
+        for kl in specs:
+            cname = kl.class_name
+            if cname == "InputLayer":
+                shape = kl.config.get("batch_input_shape")
+                ordering = _layer_dim_ordering(kl.config)
+                input_types.append(_input_type_from_shape(shape[1:], ordering))
+                gb.add_inputs(kl.name)
+                graph_names[kl.name] = kl.name
+                continue
+            # Resolve each inbound ref through any ZeroPadding chain,
+            # accumulating that branch's padding.
+            pad = (0, 0)
+            inputs = []
+            for n in kl.inbound:
+                if n in zero_pads:  # chains collapse at registration
+                    ph, pw = zero_pads[n]
+                    pad = (pad[0] + ph, pad[1] + pw)
+                inputs.append(graph_names.get(n, n))
+            if cname in ("Merge", "Concatenate", "Add"):
+                from deeplearning4j_tpu.nn.conf.graph import (
+                    ElementWiseVertex, MergeVertex)
+                if pad != (0, 0):
+                    raise KerasImportException(
+                        "ZeroPadding2D feeding a merge vertex is unsupported")
+                mode = kl.config.get("mode") or cname.lower()
+                if mode in ("concat", "concatenate"):
+                    gb.add_vertex(kl.name, MergeVertex(), *inputs)
+                elif mode in ("sum", "add"):
+                    gb.add_vertex(kl.name, ElementWiseVertex(op="add"), *inputs)
+                else:
+                    raise KerasImportException(f"Unsupported merge mode {mode!r}")
+                graph_names[kl.name] = kl.name
+                continue
+            if cname == "ZeroPadding2D":
+                sub = _Converter(training)
+                sub.input_type = InputType.feed_forward(1)
+                sub.convert(kl)
+                zero_pads[kl.name] = (
+                    sub._pending_pad[0] + pad[0], sub._pending_pad[1] + pad[1])
+                graph_names[kl.name] = inputs[0]
+                continue
+            sub = _Converter(training)
+            sub.input_type = InputType.feed_forward(1)  # suppress re-infer
+            sub.convert(kl)
+            if not sub.layers:  # Flatten — passthrough
+                graph_names[kl.name] = inputs[0]
+                continue
+            layer = sub.layers[0]
+            if pad != (0, 0):
+                if not isinstance(layer, ConvolutionLayer):
+                    raise KerasImportException(
+                        f"ZeroPadding2D must feed a conv, got {cname!r}")
+                layer.padding = (layer.padding[0] + pad[0],
+                                 layer.padding[1] + pad[1])
+            gb.add_layer(kl.name, layer, *inputs)
+            graph_names[kl.name] = kl.name
+            if 0 in sub.weight_map:
+                weight_jobs.append((kl.name, kl, sub.weight_map[0][1], layer))
+
+        # Output vertices: convert a trailing plain Dense into an OutputLayer
+        # with the compiled loss so the imported graph is trainable
+        # (reference: `KerasModel` attaches the loss to output layers).
+        loss = _map_loss((training or {}).get("loss"))
+        from deeplearning4j_tpu.nn.conf.graph import LayerVertex as _LV
+
+        from deeplearning4j_tpu.nn.conf.layers import LossLayer as _LossLayer
+
+        for name in output_names:
+            vname = graph_names[name]
+            v = gb._vertices.get(vname)
+            if not isinstance(v, _LV):
+                continue
+            act = getattr(v.layer, "activation", None) or "identity"
+            out_loss = "mcxent" if (loss == "mse" and act == "softmax") else loss
+            if isinstance(v.layer, DenseLayer) and not isinstance(v.layer, OutputLayer):
+                v.layer = OutputLayer(n_out=v.layer.n_out, activation=act,
+                                      loss_function=out_loss)
+            elif isinstance(v.layer, ActivationLayer):
+                v.layer = _LossLayer(activation=act, loss_function=out_loss)
+        gb.set_outputs(*[graph_names[n] for n in output_names])
+        gb.set_input_types(*input_types)
+        graph_conf = gb.build()
+        net = ComputationGraph(graph_conf).init()
+
+        wmap = {i: (kl, kind) for i, (_, kl, kind, _) in enumerate(weight_jobs)}
+        _apply_weights(
+            net, wmap, weights_root,
+            lambda i: weight_jobs[i][0],
+            lambda i: net.layer_vertices[weight_jobs[i][0]].layer)
+        return net
+    finally:
+        f.close()
+
+
+class KerasModelImport:
+    """Static façade matching the reference's `KerasModelImport.java`."""
+
+    import_keras_sequential_model_and_weights = staticmethod(
+        import_keras_sequential_model_and_weights)
+    import_keras_model_and_weights = staticmethod(import_keras_model_and_weights)
+
+    @staticmethod
+    def import_keras_model(path):
+        """Dispatch on the stored class_name."""
+        import h5py
+
+        with h5py.File(path, "r") as f:
+            raw = f.attrs.get("model_config")
+            if isinstance(raw, bytes):
+                raw = raw.decode()
+            cname = json.loads(raw).get("class_name") if raw else None
+        if cname == "Sequential":
+            return import_keras_sequential_model_and_weights(path)
+        return import_keras_model_and_weights(path)

@@ -1,0 +1,95 @@
+"""Pretrained model zoo (Keras-import backed).
+
+Reference: `deeplearning4j-modelimport/.../keras/trainedmodels/TrainedModels.java:16-19`
+(VGG16 / VGG16NOTOP download + import) and
+`trainedmodels/Utils/ImageNetLabels.java` preprocessing. The reference
+downloads the weights over HTTP; this environment has no egress, so
+`TrainedModels.vgg16(weights_path=...)` imports a locally-provided Keras
+VGG-16 .h5 (the exact file the reference downloads), and without a path
+returns the architecture with fresh init — same topology either way.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    ConvolutionLayer,
+    DenseLayer,
+    OutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.conf.neural_net import NeuralNetConfiguration
+
+# ImageNet channel means used by the reference's VGG16 preprocessing
+# (BGR order, `TrainedModels.java` getPreProcessor).
+VGG_MEAN_BGR = (103.939, 116.779, 123.68)
+
+_VGG16_BLOCKS = [(64, 2), (128, 2), (256, 3), (512, 3), (512, 3)]
+
+
+def vgg16_config(n_classes: int = 1000, include_top: bool = True,
+                 image: int = 224, dtype: str = "bfloat16"):
+    """The VGG-16 topology (Simonyan & Zisserman) as a MultiLayerConfiguration,
+    layer-for-layer the Keras-1 file's structure (ZeroPadding folded into
+    SAME-padded 3x3 convs, as the importer does)."""
+    builder = (NeuralNetConfiguration.builder()
+               .seed(123).updater("nesterovs").learning_rate(0.01)
+               .weight_init("xavier").dtype(dtype)
+               .list())
+    for n_filters, reps in _VGG16_BLOCKS:
+        for _ in range(reps):
+            builder.layer(ConvolutionLayer(
+                n_out=n_filters, kernel_size=(3, 3), stride=(1, 1),
+                padding=(1, 1), convolution_mode="truncate",
+                activation="relu"))
+        builder.layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+    if include_top:
+        builder.layer(DenseLayer(n_out=4096, activation="relu"))
+        builder.layer(DenseLayer(n_out=4096, activation="relu"))
+        builder.layer(OutputLayer(n_out=n_classes, activation="softmax",
+                                  loss_function="mcxent"))
+    return builder.set_input_type(
+        InputType.convolutional(image, image, 3)).build()
+
+
+def preprocess_imagenet(images: np.ndarray) -> np.ndarray:
+    """Reference VGG16 preprocessing: RGB->BGR + mean subtraction
+    (`TrainedModels.getPreProcessor`). images: [b, h, w, 3] RGB float."""
+    bgr = images[..., ::-1].astype("float32")
+    return bgr - np.asarray(VGG_MEAN_BGR, "float32")
+
+
+class TrainedModels:
+    """Facade matching the reference's `TrainedModels` enum."""
+
+    @staticmethod
+    def vgg16(weights_path: Optional[str] = None, n_classes: int = 1000,
+              dtype: str = "bfloat16"):
+        """VGG16 with ImageNet weights when a Keras .h5 is provided locally
+        (no-egress stand-in for the reference's download), else fresh init."""
+        if weights_path is not None:
+            from deeplearning4j_tpu.keras.import_model import (
+                KerasModelImport)
+            return KerasModelImport.import_keras_model(weights_path)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        return MultiLayerNetwork(
+            vgg16_config(n_classes=n_classes, dtype=dtype)).init()
+
+    VGG16 = vgg16
+
+    @staticmethod
+    def vgg16_notop(weights_path: Optional[str] = None,
+                    dtype: str = "bfloat16"):
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        if weights_path is not None:
+            from deeplearning4j_tpu.keras.import_model import (
+                KerasModelImport)
+            return KerasModelImport.import_keras_model(weights_path)
+        return MultiLayerNetwork(
+            vgg16_config(include_top=False, dtype=dtype)).init()

@@ -1,0 +1,390 @@
+"""Keras HDF5 import tests.
+
+Reference analog: `deeplearning4j-modelimport/src/test/.../KerasModelEndToEndTest.java:42-52`
+— golden-file testing with stored inputs/outputs. The reference resolves
+pre-recorded .h5 fixtures from a test-resources artifact; here the fixtures
+are written in-test with h5py in the exact Keras 1.x on-disk format
+(model_config/training_config attrs + per-layer weight groups), and the
+expected activations are computed with plain numpy.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+h5py = pytest.importorskip("h5py")
+
+from deeplearning4j_tpu.keras.import_model import (
+    KerasImportException,
+    KerasModelImport,
+    import_keras_model_and_weights,
+    import_keras_sequential_model_and_weights,
+)
+
+
+def write_keras_h5(path, model_config, weights, training_config=None):
+    """Write a Keras-1-format model file: config attrs + weight groups."""
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(model_config).encode()
+        if training_config is not None:
+            f.attrs["training_config"] = json.dumps(training_config).encode()
+        root = f.create_group("model_weights")
+        for layer_name, wlist in weights.items():
+            grp = root.create_group(layer_name)
+            grp.attrs["weight_names"] = np.array(
+                [n.encode() for n, _ in wlist])
+            for n, arr in wlist:
+                grp.create_dataset(n, data=np.asarray(arr, "float32"))
+
+
+def seq_config(layers):
+    return {"class_name": "Sequential", "config": layers}
+
+
+TRAIN_CFG = {"loss": "categorical_crossentropy",
+             "optimizer_config": {"config": {"lr": 0.01}}}
+
+
+class TestSequentialMLP:
+    def test_dense_golden_activations(self, tmp_path, rng):
+        W1 = rng.randn(4, 5).astype("float32")
+        b1 = rng.randn(5).astype("float32")
+        W2 = rng.randn(5, 3).astype("float32")
+        b2 = rng.randn(3).astype("float32")
+        cfg = seq_config([
+            {"class_name": "Dense",
+             "config": {"name": "dense_1", "output_dim": 5,
+                        "activation": "relu",
+                        "batch_input_shape": [None, 4]}},
+            {"class_name": "Dense",
+             "config": {"name": "dense_2", "output_dim": 3,
+                        "activation": "softmax"}},
+        ])
+        path = str(tmp_path / "mlp.h5")
+        write_keras_h5(path, cfg, {
+            "dense_1": [("dense_1_W", W1), ("dense_1_b", b1)],
+            "dense_2": [("dense_2_W", W2), ("dense_2_b", b2)],
+        }, TRAIN_CFG)
+
+        net = import_keras_sequential_model_and_weights(path)
+        x = rng.randn(6, 4).astype("float32")
+        got = net.output(x)
+
+        h = np.maximum(x @ W1 + b1, 0.0)
+        logits = h @ W2 + b2
+        e = np.exp(logits - logits.max(axis=1, keepdims=True))
+        expect = e / e.sum(axis=1, keepdims=True)
+        np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+
+    def test_trainable_after_import(self, tmp_path, rng):
+        """The compiled loss makes the imported net trainable (reference:
+        enforceTrainingConfig path)."""
+        W1 = rng.randn(4, 8).astype("float32")
+        cfg = seq_config([
+            {"class_name": "Dense",
+             "config": {"name": "d1", "output_dim": 8, "activation": "tanh",
+                        "batch_input_shape": [None, 4]}},
+            {"class_name": "Dropout", "config": {"name": "drop", "p": 0.5}},
+            {"class_name": "Dense",
+             "config": {"name": "d2", "output_dim": 3,
+                        "activation": "softmax"}},
+        ])
+        path = str(tmp_path / "train.h5")
+        write_keras_h5(path, cfg, {
+            "d1": [("d1_W", W1), ("d1_b", np.zeros(8))],
+            "d2": [("d2_W", rng.randn(8, 3)), ("d2_b", np.zeros(3))],
+        }, TRAIN_CFG)
+        net = import_keras_sequential_model_and_weights(path)
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        X = rng.randn(16, 4).astype("float32")
+        Y = np.eye(3)[rng.randint(0, 3, 16)].astype("float32")
+        s0 = net.score(DataSet(X, Y))
+        for _ in range(20):
+            net.fit(X, Y)
+        assert net.score(DataSet(X, Y)) < s0
+
+    def test_dense_plus_activation_tail_trainable(self, tmp_path, rng):
+        """Classic Keras pattern Dense(linear) -> Activation(softmax): the
+        Activation tail becomes a param-free LossLayer so the import is
+        trainable and the function unchanged."""
+        W = rng.randn(4, 3).astype("float32")
+        cfg = seq_config([
+            {"class_name": "Dense",
+             "config": {"name": "d", "output_dim": 3, "activation": "linear",
+                        "batch_input_shape": [None, 4]}},
+            {"class_name": "Activation",
+             "config": {"name": "a", "activation": "softmax"}},
+        ])
+        path = str(tmp_path / "act_tail.h5")
+        write_keras_h5(path, cfg, {"d": [("d_W", W), ("d_b", np.zeros(3))]},
+                       TRAIN_CFG)
+        net = import_keras_sequential_model_and_weights(path)
+        x = rng.randn(5, 4).astype("float32")
+        logits = x @ W
+        e = np.exp(logits - logits.max(axis=1, keepdims=True))
+        np.testing.assert_allclose(net.output(x),
+                                   e / e.sum(axis=1, keepdims=True),
+                                   rtol=1e-5, atol=1e-6)
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        Y = np.eye(3)[rng.randint(0, 3, 5)].astype("float32")
+        s0 = net.score(DataSet(x, Y))
+        for _ in range(10):
+            net.fit(x, Y)
+        assert net.score(DataSet(x, Y)) < s0
+
+    def test_dispatch_facade(self, tmp_path, rng):
+        cfg = seq_config([
+            {"class_name": "Dense",
+             "config": {"name": "d", "output_dim": 2, "activation": "softmax",
+                        "batch_input_shape": [None, 3]}},
+        ])
+        path = str(tmp_path / "f.h5")
+        write_keras_h5(path, cfg,
+                       {"d": [("d_W", rng.randn(3, 2)), ("d_b", np.zeros(2))]})
+        net = KerasModelImport.import_keras_model(path)
+        assert net.output(rng.randn(2, 3).astype("float32")).shape == (2, 2)
+
+
+def _conv2d_hwio(x, k, b, stride=(1, 1), pad=(0, 0)):
+    """Tiny cross-correlation reference: x [n,h,w,cin], k [kh,kw,cin,cout]."""
+    n, h, w, cin = x.shape
+    kh, kw, _, cout = k.shape
+    ph, pw = pad
+    xp = np.pad(x, ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+    oh = (h + 2 * ph - kh) // stride[0] + 1
+    ow = (w + 2 * pw - kw) // stride[1] + 1
+    out = np.zeros((n, oh, ow, cout), "float32")
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, i * stride[0]:i * stride[0] + kh,
+                       j * stride[1]:j * stride[1] + kw, :]
+            out[:, i, j, :] = np.tensordot(patch, k, axes=([1, 2, 3], [0, 1, 2]))
+    return out + b
+
+
+class TestSequentialConv:
+    def test_theano_kernel_transpose_and_padding_fold(self, tmp_path, rng):
+        """th-ordered kernels [out,in,kh,kw] transpose to HWIO; a preceding
+        ZeroPadding2D folds into the conv's padding (the VGG16 pattern)."""
+        k_th = rng.randn(2, 1, 3, 3).astype("float32")  # [out,in,kh,kw]
+        bc = rng.randn(2).astype("float32")
+        Wd = rng.randn(2 * 4 * 4, 3).astype("float32")
+        bd = rng.randn(3).astype("float32")
+        cfg = seq_config([
+            {"class_name": "ZeroPadding2D",
+             "config": {"name": "pad", "padding": [1, 1],
+                        "batch_input_shape": [None, 1, 8, 8],
+                        "dim_ordering": "th"}},
+            {"class_name": "Convolution2D",
+             "config": {"name": "conv", "nb_filter": 2, "nb_row": 3,
+                        "nb_col": 3, "subsample": [1, 1],
+                        "border_mode": "valid", "dim_ordering": "th",
+                        "activation": "relu"}},
+            {"class_name": "MaxPooling2D",
+             "config": {"name": "pool", "pool_size": [2, 2],
+                        "strides": [2, 2], "border_mode": "valid",
+                        "dim_ordering": "th"}},
+            {"class_name": "Flatten", "config": {"name": "flat"}},
+            {"class_name": "Dense",
+             "config": {"name": "out", "output_dim": 3,
+                        "activation": "softmax"}},
+        ])
+        path = str(tmp_path / "conv.h5")
+        write_keras_h5(path, cfg, {
+            "conv": [("conv_W", k_th), ("conv_b", bc)],
+            "out": [("out_W", Wd), ("out_b", bd)],
+        }, TRAIN_CFG)
+        net = import_keras_sequential_model_and_weights(path)
+
+        x = rng.randn(3, 8, 8, 1).astype("float32")  # framework layout NHWC
+        got = net.output(x)
+
+        k = np.transpose(k_th, (2, 3, 1, 0))  # HWIO
+        conv = np.maximum(_conv2d_hwio(x, k, bc, pad=(1, 1)), 0.0)  # 8x8x2
+        pooled = conv.reshape(3, 4, 2, 4, 2, 2).max(axis=(2, 4))  # 4x4x2
+        flat = pooled.reshape(3, -1)
+        logits = flat @ Wd + bd
+        e = np.exp(logits - logits.max(axis=1, keepdims=True))
+        expect = e / e.sum(axis=1, keepdims=True)
+        np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
+
+    def test_batchnorm_running_stats(self, tmp_path, rng):
+        gamma = rng.rand(4).astype("float32") + 0.5
+        beta = rng.randn(4).astype("float32")
+        mean = rng.randn(4).astype("float32")
+        var = rng.rand(4).astype("float32") + 0.5
+        cfg = seq_config([
+            {"class_name": "Dense",
+             "config": {"name": "d", "output_dim": 4, "activation": "linear",
+                        "batch_input_shape": [None, 4]}},
+            {"class_name": "BatchNormalization",
+             "config": {"name": "bn", "epsilon": 1e-5}},
+            {"class_name": "Dense",
+             "config": {"name": "o", "output_dim": 2,
+                        "activation": "softmax"}},
+        ])
+        W = np.eye(4, dtype="float32")
+        path = str(tmp_path / "bn.h5")
+        write_keras_h5(path, cfg, {
+            "d": [("d_W", W), ("d_b", np.zeros(4))],
+            "bn": [("bn_gamma", gamma), ("bn_beta", beta),
+                   ("bn_running_mean", mean), ("bn_running_std", var)],
+            "o": [("o_W", rng.randn(4, 2)), ("o_b", np.zeros(2))],
+        }, TRAIN_CFG)
+        net = import_keras_sequential_model_and_weights(path)
+        lk = net.layer_keys[1]
+        np.testing.assert_allclose(np.asarray(net.params_tree[lk]["gamma"]), gamma)
+        np.testing.assert_allclose(np.asarray(net.state[lk]["mean"]), mean)
+        np.testing.assert_allclose(np.asarray(net.state[lk]["var"]), var)
+        # eval mode uses the imported running stats
+        x = rng.randn(5, 4).astype("float32")
+        acts = net.feed_forward(x)
+        expect_bn = gamma * (x - mean) / np.sqrt(var + 1e-5) + beta
+        np.testing.assert_allclose(np.asarray(acts[1]), expect_bn,
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestSequentialLSTM:
+    def test_keras1_twelve_array_roundtrip(self, tmp_path, rng):
+        """Keras-1 W_i,U_i,b_i,W_c,... arrays land in the framework's i,f,o,g
+        packing: importing weights exported from one of our LSTM nets must
+        reproduce its params and outputs exactly."""
+        from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import LSTM, RnnOutputLayer
+
+        f_in, u, c = 3, 4, 2
+        ref = MultiLayerNetwork(
+            (NeuralNetConfiguration.builder().seed(11).weight_init("xavier")
+             .list()
+             .layer(LSTM(n_out=u, activation="tanh",
+                         gate_activation="sigmoid"))
+             .layer(RnnOutputLayer(n_out=c, activation="softmax",
+                                   loss_function="mcxent"))
+             .set_input_type(InputType.recurrent(f_in))
+             .build())
+        ).init()
+        p = ref.params_tree[ref.layer_keys[0]]
+        W = np.asarray(p["W"])  # [f_in, 4u] i,f,o,g
+        RW = np.asarray(p["RW"])
+        b = np.asarray(p["b"])
+        sl = [slice(0, u), slice(u, 2 * u), slice(2 * u, 3 * u),
+              slice(3 * u, 4 * u)]
+        i, f_, o, g = range(4)
+        karrs = [
+            ("W_i", W[:, sl[i]]), ("U_i", RW[:, sl[i]]), ("b_i", b[sl[i]]),
+            ("W_c", W[:, sl[g]]), ("U_c", RW[:, sl[g]]), ("b_c", b[sl[g]]),
+            ("W_f", W[:, sl[f_]]), ("U_f", RW[:, sl[f_]]), ("b_f", b[sl[f_]]),
+            ("W_o", W[:, sl[o]]), ("U_o", RW[:, sl[o]]), ("b_o", b[sl[o]]),
+        ]
+        op = ref.params_tree[ref.layer_keys[1]]
+        cfg = seq_config([
+            {"class_name": "LSTM",
+             "config": {"name": "lstm", "output_dim": u, "activation": "tanh",
+                        "inner_activation": "sigmoid",
+                        "return_sequences": True,
+                        "batch_input_shape": [None, 5, f_in]}},
+            {"class_name": "Dense",
+             "config": {"name": "out", "output_dim": c,
+                        "activation": "softmax"}},
+        ])
+        path = str(tmp_path / "lstm.h5")
+        write_keras_h5(path, cfg, {
+            "lstm": [(n, a) for n, a in karrs],
+            "out": [("out_W", np.asarray(op["W"])),
+                    ("out_b", np.asarray(op["b"]))],
+        }, TRAIN_CFG)
+        net = import_keras_sequential_model_and_weights(path)
+        q = net.params_tree[net.layer_keys[0]]
+        np.testing.assert_allclose(np.asarray(q["W"]), W, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(q["RW"]), RW, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(q["b"]), b, rtol=1e-6)
+
+        x = rng.randn(2, 5, f_in).astype("float32")
+        np.testing.assert_allclose(net.output(x), ref.output(x),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_return_sequences_false_rejected(self, tmp_path, rng):
+        cfg = seq_config([
+            {"class_name": "LSTM",
+             "config": {"name": "lstm", "output_dim": 3,
+                        "return_sequences": False,
+                        "batch_input_shape": [None, 5, 2]}},
+        ])
+        path = str(tmp_path / "bad.h5")
+        write_keras_h5(path, cfg, {"lstm": []})
+        with pytest.raises(KerasImportException):
+            import_keras_sequential_model_and_weights(path)
+
+
+class TestTrainedModels:
+    def test_vgg16_config_builds_and_runs(self, rng):
+        """VGG16 zoo topology (reference `TrainedModels.java:16-19`): 13 convs
+        in 5 blocks + pools; conv feature extractor runs end to end."""
+        from deeplearning4j_tpu import MultiLayerNetwork
+        from deeplearning4j_tpu.keras.trained_models import (
+            preprocess_imagenet, vgg16_config)
+
+        conf = vgg16_config(n_classes=10, include_top=True, image=224,
+                            dtype="float32")
+        convs = [l for l in conf.layers if type(l).__name__ == "ConvolutionLayer"]
+        assert len(convs) == 13
+        assert [l.n_out for l in convs] == [64, 64, 128, 128, 256, 256, 256,
+                                            512, 512, 512, 512, 512, 512]
+
+        small = vgg16_config(include_top=False, image=32, dtype="float32")
+        net = MultiLayerNetwork(small).init()
+        x = preprocess_imagenet(rng.rand(2, 32, 32, 3).astype("float32") * 255)
+        out = net.output(x)
+        assert out.shape[0] == 2 and np.isfinite(np.asarray(out)).all()
+
+
+class TestFunctionalModel:
+    def test_merge_dag(self, tmp_path, rng):
+        """Input -> two Dense branches -> concat Merge -> Dense output."""
+        Wa = rng.randn(4, 3).astype("float32")
+        Wb = rng.randn(4, 2).astype("float32")
+        Wo = rng.randn(5, 2).astype("float32")
+        cfg = {
+            "class_name": "Model",
+            "config": {
+                "layers": [
+                    {"class_name": "InputLayer", "name": "in",
+                     "config": {"name": "in",
+                                "batch_input_shape": [None, 4]},
+                     "inbound_nodes": []},
+                    {"class_name": "Dense", "name": "a",
+                     "config": {"name": "a", "output_dim": 3,
+                                "activation": "relu"},
+                     "inbound_nodes": [[["in", 0, 0]]]},
+                    {"class_name": "Dense", "name": "b",
+                     "config": {"name": "b", "output_dim": 2,
+                                "activation": "tanh"},
+                     "inbound_nodes": [[["in", 0, 0]]]},
+                    {"class_name": "Merge", "name": "m",
+                     "config": {"name": "m", "mode": "concat"},
+                     "inbound_nodes": [[["a", 0, 0], ["b", 0, 0]]]},
+                    {"class_name": "Dense", "name": "out",
+                     "config": {"name": "out", "output_dim": 2,
+                                "activation": "softmax"},
+                     "inbound_nodes": [[["m", 0, 0]]]},
+                ],
+                "input_layers": [["in", 0, 0]],
+                "output_layers": [["out", 0, 0]],
+            },
+        }
+        path = str(tmp_path / "dag.h5")
+        write_keras_h5(path, cfg, {
+            "a": [("a_W", Wa), ("a_b", np.zeros(3))],
+            "b": [("b_W", Wb), ("b_b", np.zeros(2))],
+            "out": [("out_W", Wo), ("out_b", np.zeros(2))],
+        }, TRAIN_CFG)
+        net = import_keras_model_and_weights(path)
+        x = rng.randn(6, 4).astype("float32")
+        got = net.output_single(x)
+        h = np.concatenate([np.maximum(x @ Wa, 0.0), np.tanh(x @ Wb)], axis=1)
+        logits = h @ Wo
+        e = np.exp(logits - logits.max(axis=1, keepdims=True))
+        np.testing.assert_allclose(got, e / e.sum(axis=1, keepdims=True),
+                                   rtol=1e-5, atol=1e-6)
