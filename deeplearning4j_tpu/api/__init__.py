@@ -1,0 +1,8 @@
+"""Cross-cutting service APIs (reference: `deeplearning4j-core/.../api/`)."""
+
+from deeplearning4j_tpu.api.storage import (  # noqa: F401
+    FileStatsStorage,
+    InMemoryStatsStorage,
+    StatsStorage,
+    StatsStorageRouter,
+)

@@ -1,0 +1,134 @@
+"""Training-stats storage and routing.
+
+TPU-native equivalent of the reference's `api/storage/` abstraction
+(`StatsStorage.java`, `StatsStorageRouter.java`, `Persistable`) that carries
+`StatsListener` reports to the UI/analysis layer. The reference SBE-encodes
+records and routes them to in-memory/file/remote-HTTP sinks; here records
+are plain JSON-able dicts and the sinks are in-memory and JSONL-file — the
+formats a human (or the bundled UI server) can read directly.
+
+A record is a dict with at least: `session_id`, `worker_id`, `timestamp`
+(ms), `iteration`, and either `static: true` (model metadata, once per run)
+or sampled stats fields (score, norms, timings, memory).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+
+class StatsStorageRouter:
+    """Write-side interface (reference: `StatsStorageRouter.java`)."""
+
+    def put_static_info(self, record: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def put_update(self, record: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+
+class StatsStorage(StatsStorageRouter):
+    """Read-side additions (reference: `StatsStorage.java`)."""
+
+    def list_session_ids(self) -> List[str]:
+        raise NotImplementedError
+
+    def get_static_info(self, session_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def get_updates(self, session_id: str) -> List[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def get_latest_update(self, session_id: str) -> Optional[Dict[str, Any]]:
+        updates = self.get_updates(session_id)
+        return updates[-1] if updates else None
+
+
+def _stamp(record: Dict[str, Any]) -> Dict[str, Any]:
+    record.setdefault("timestamp", int(time.time() * 1000))
+    return record
+
+
+class InMemoryStatsStorage(StatsStorage):
+    """Reference: `InMemoryStatsStorage.java`. Thread-safe append/query."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._static: Dict[str, Dict[str, Any]] = {}
+        self._updates: Dict[str, List[Dict[str, Any]]] = {}
+
+    def put_static_info(self, record):
+        with self._lock:
+            self._static[record["session_id"]] = _stamp(dict(record))
+
+    def put_update(self, record):
+        with self._lock:
+            self._updates.setdefault(record["session_id"], []).append(
+                _stamp(dict(record)))
+
+    def list_session_ids(self):
+        with self._lock:
+            return sorted(set(self._static) | set(self._updates))
+
+    def get_static_info(self, session_id):
+        with self._lock:
+            return self._static.get(session_id)
+
+    def get_updates(self, session_id):
+        with self._lock:
+            return list(self._updates.get(session_id, []))
+
+
+class FileStatsStorage(StatsStorage):
+    """JSONL file sink+source (reference: `FileStatsStorage.java` — the
+    reference uses MapDB binary; JSONL keeps records human-plottable)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        directory = os.path.dirname(os.path.abspath(path))
+        os.makedirs(directory, exist_ok=True)
+        if not os.path.exists(path):
+            with open(path, "w"):
+                pass
+
+    def _append(self, record: Dict[str, Any]) -> None:
+        with self._lock, open(self.path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+
+    def put_static_info(self, record):
+        rec = _stamp(dict(record))
+        rec["static"] = True
+        self._append(rec)
+
+    def put_update(self, record):
+        self._append(_stamp(dict(record)))
+
+    def _iter_records(self) -> Iterator[Dict[str, Any]]:
+        with self._lock, open(self.path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        yield json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+
+    def list_session_ids(self):
+        return sorted({r.get("session_id") for r in self._iter_records()
+                       if r.get("session_id")})
+
+    def get_static_info(self, session_id):
+        out = None
+        for r in self._iter_records():
+            if r.get("session_id") == session_id and r.get("static"):
+                out = r
+        return out
+
+    def get_updates(self, session_id):
+        return [r for r in self._iter_records()
+                if r.get("session_id") == session_id and not r.get("static")]

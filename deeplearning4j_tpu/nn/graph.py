@@ -58,6 +58,8 @@ class ComputationGraph:
         self.epoch = 0
         self._score = float("nan")
         self.listeners: List[Any] = []
+        self._collect_stats = False
+        self.last_training_stats: Dict[str, Any] = {}
         self._initialized = False
         self._compute_dtype = {
             "bfloat16": jnp.bfloat16, "float64": jnp.float64,
@@ -222,6 +224,12 @@ class ComputationGraph:
                 return self._train_step(params, state, opt_state, inputs, labels,
                                         fmasks, lmasks, step, rng, carry_rnn=False)
             return jax.jit(step_fn, donate_argnums=(0, 2))
+        if kind == "train_step_stats":
+            def step_fn_s(params, state, opt_state, inputs, labels, fmasks, lmasks, step, rng):
+                return self._train_step(params, state, opt_state, inputs, labels,
+                                        fmasks, lmasks, step, rng, carry_rnn=False,
+                                        collect_stats=True)
+            return jax.jit(step_fn_s, donate_argnums=(0, 2))
         if kind == "train_step_tbptt":
             def step_fn2(params, state, opt_state, inputs, labels, fmasks, lmasks, step, rng, ebs):
                 return self._train_step(params, state, opt_state, inputs, labels,
@@ -296,7 +304,7 @@ class ComputationGraph:
     # ----------------------------------------------------------- train step
 
     def _train_step(self, params, state, opt_state, inputs, labels, fmasks, lmasks,
-                    step, rng, carry_rnn=False, ebs=None):
+                    step, rng, carry_rnn=False, ebs=None, collect_stats=False):
         def loss_fn(p):
             outs, new_state, aux, omasks = self._forward_fn(
                 p, state, inputs, rng, True, fmasks, keep_rnn_state=carry_rnn
@@ -311,6 +319,7 @@ class ComputationGraph:
         g = self.conf.global_conf
         sign = 1.0 if g.minimize else -1.0
         new_params, new_opt = {}, {}
+        stats: Dict[str, Any] = {}
         for name, v in self.layer_vertices.items():
             layer = v.layer
             lgrads = grads.get(name, {})
@@ -331,11 +340,24 @@ class ComputationGraph:
                 deltas = {k: (d * factor if k == "b" else d) for k, d in deltas.items()}
             new_params[name] = {k: params[name][k] - sign * deltas[k] for k in params[name]}
             new_opt[name] = st
+            if collect_stats:
+                # In-jit per-param mean magnitudes (only scalars leave the
+                # device; reference `BaseStatsListener.java:273` semantics).
+                stats[name] = {
+                    k: {
+                        "grad_mm": jnp.mean(jnp.abs(lgrads[k])),
+                        "update_mm": jnp.mean(jnp.abs(deltas[k])),
+                        "param_mm": jnp.mean(jnp.abs(new_params[name][k])),
+                    }
+                    for k in lgrads
+                }
         merged_state = dict(state)
         for n, s in new_state.items():
             merged = dict(merged_state.get(n, {}))
             merged.update(s)
             merged_state[n] = merged
+        if collect_stats:
+            return new_params, merged_state, new_opt, loss, stats
         return new_params, merged_state, new_opt, loss
 
     # ------------------------------------------------------------------ fit
@@ -439,7 +461,11 @@ class ComputationGraph:
 
     def _fit_one(self, mds: MultiDataSet, tbptt: bool = False,
                  count_iteration: bool = True, ebs=None):
-        step_fn = self._get_jit("train_step_tbptt" if tbptt else "train_step")
+        if tbptt:
+            kind = "train_step_tbptt"
+        else:
+            kind = "train_step_stats" if self._collect_stats else "train_step"
+        step_fn = self._get_jit(kind)
         step = jnp.asarray(self.iteration, jnp.float32)
         fmasks = None
         if mds.features_masks is not None and any(m is not None for m in mds.features_masks):
@@ -455,7 +481,12 @@ class ComputationGraph:
         ]
         if tbptt:
             args.append(ebs)
-        self.params_tree, self.state, self.opt_state, loss = step_fn(*args)
+        out = step_fn(*args)
+        if len(out) == 5:
+            self.params_tree, self.state, self.opt_state, loss, stats = out
+            self.last_training_stats = stats
+        else:
+            self.params_tree, self.state, self.opt_state, loss = out
         self._score = loss  # device scalar; sync deferred to score_value
         if count_iteration:
             self.iteration += 1
@@ -516,6 +547,8 @@ class ComputationGraph:
 
     def set_listeners(self, *listeners):
         self.listeners = list(listeners)
+        self._collect_stats = any(
+            getattr(l, "requires_training_stats", False) for l in listeners)
         return self
 
     def num_params(self) -> int:

@@ -63,6 +63,8 @@ class MultiLayerNetwork:
         self.listeners: List[Any] = []
         self._rnn_state: Dict[str, Dict[str, jnp.ndarray]] = {}
         self._initialized = False
+        self._collect_stats = False
+        self.last_training_stats: Dict[str, Any] = {}
         self._compute_dtype = {
             "bfloat16": jnp.bfloat16, "float64": jnp.float64,
         }.get(conf.global_conf.dtype, jnp.float32)
@@ -204,6 +206,12 @@ class MultiLayerNetwork:
                 return self._train_step(params, state, opt_state, x, y, fmask,
                                         lmask, step, rng, carry_rnn=False)
             return jax.jit(step_plain, donate_argnums=(0, 2))
+        if kind == "train_step_stats":
+            def step_stats(params, state, opt_state, x, y, fmask, lmask, step, rng):
+                return self._train_step(params, state, opt_state, x, y, fmask,
+                                        lmask, step, rng, carry_rnn=False,
+                                        collect_stats=True)
+            return jax.jit(step_stats, donate_argnums=(0, 2))
         if kind == "train_step_tbptt":
             def step_tbptt(params, state, opt_state, x, y, fmask, lmask, step, rng, eb):
                 return self._train_step(params, state, opt_state, x, y, fmask,
@@ -286,7 +294,7 @@ class MultiLayerNetwork:
     # ----------------------------------------------------------- train step
 
     def _train_step(self, params, state, opt_state, x, y, fmask, lmask, step, rng,
-                    carry_rnn=False, eb=None):
+                    carry_rnn=False, eb=None, collect_stats=False):
         def loss_fn(p):
             preout, new_state, _, aux = self._forward_fn(
                 p, state, x, rng, True, fmask, keep_rnn_state=carry_rnn
@@ -302,6 +310,7 @@ class MultiLayerNetwork:
         sign = 1.0 if g.minimize else -1.0
         new_params: Dict[str, Any] = {}
         new_opt: Dict[str, Any] = {}
+        stats: Dict[str, Any] = {}
         for i, (lk, layer) in enumerate(zip(self.layer_keys, self.layers)):
             lgrads = grads.get(lk, {})
             if not lgrads:
@@ -323,12 +332,26 @@ class MultiLayerNetwork:
                 k: params[lk][k] - sign * deltas[k] for k in params[lk]
             }
             new_opt[lk] = st
+            if collect_stats:
+                # Per-param mean magnitudes of gradient/update/param, computed
+                # in-jit so only scalars cross the device boundary (reference
+                # StatsListener "mean magnitudes", BaseStatsListener.java:273).
+                stats[lk] = {
+                    k: {
+                        "grad_mm": jnp.mean(jnp.abs(lgrads[k])),
+                        "update_mm": jnp.mean(jnp.abs(deltas[k])),
+                        "param_mm": jnp.mean(jnp.abs(new_params[lk][k])),
+                    }
+                    for k in lgrads
+                }
         # Merge persistent-state updates (BN stats / rnn carries) over old state.
         merged_state = dict(state)
         for lk, s in new_state.items():
             merged = dict(merged_state.get(lk, {}))
             merged.update(s)
             merged_state[lk] = merged
+        if collect_stats:
+            return new_params, merged_state, new_opt, loss, stats
         return new_params, merged_state, new_opt, loss
 
     # ------------------------------------------------------------------ fit
@@ -455,9 +478,10 @@ class MultiLayerNetwork:
         return sub
 
     def _fit_one(self, ds: DataSet):
-        step_fn = self._get_jit("train_step")
+        collect = self._collect_stats
+        step_fn = self._get_jit("train_step_stats" if collect else "train_step")
         step = jnp.asarray(self.iteration, jnp.float32)
-        self.params_tree, self.state, self.opt_state, loss = step_fn(
+        out = step_fn(
             self.params_tree, self.state, self.opt_state,
             jnp.asarray(ds.features),
             jnp.asarray(ds.labels),
@@ -465,6 +489,11 @@ class MultiLayerNetwork:
             None if ds.labels_mask is None else jnp.asarray(ds.labels_mask),
             step, self._next_rng(),
         )
+        if collect:
+            self.params_tree, self.state, self.opt_state, loss, stats = out
+            self.last_training_stats = stats  # device scalars, fetched lazily
+        else:
+            self.params_tree, self.state, self.opt_state, loss = out
         self._score = loss  # device scalar; sync deferred to score_value
         self.iteration += 1
         for listener in self.listeners:
@@ -613,6 +642,10 @@ class MultiLayerNetwork:
 
     def set_listeners(self, *listeners):
         self.listeners = list(listeners)
+        # Listeners that consume gradient/update stats (StatsListener) flip
+        # the train step to the stats-collecting variant.
+        self._collect_stats = any(
+            getattr(l, "requires_training_stats", False) for l in listeners)
         return self
 
     def num_params(self) -> int:

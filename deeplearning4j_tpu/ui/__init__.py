@@ -1,0 +1,9 @@
+"""Observability: StatsListener telemetry + training UI server.
+
+TPU-native replacement for the reference's `deeplearning4j-ui-parent`
+(`BaseStatsListener.java`, `PlayUIServer.java`) — see `ui/stats.py` and
+`ui/server.py`.
+"""
+
+from deeplearning4j_tpu.ui.stats import ProfilerListener, StatsListener  # noqa: F401
+from deeplearning4j_tpu.ui.server import UIServer  # noqa: F401

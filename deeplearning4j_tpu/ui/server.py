@@ -1,0 +1,173 @@
+"""Training UI server.
+
+TPU-lite equivalent of the reference's Play-framework UI
+(`deeplearning4j-play/.../PlayUIServer.java:53,183` + the train module
+`ui/module/train/TrainModule.java:92-99`): a stdlib `http.server` app that
+attaches to a `StatsStorage` and serves
+- `/`                    — overview page (score curve, throughput, per-layer
+                           mean magnitudes, memory) rendered with inline JS
+- `/api/sessions`        — session ids
+- `/api/static?sid=`     — model static info
+- `/api/updates?sid=`    — the full update stream as JSON
+
+Usage (mirrors `UIServer.getInstance().attach(statsStorage)`):
+
+    server = UIServer(port=9000).attach(storage).start()
+    ...
+    server.stop()
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from deeplearning4j_tpu.api.storage import StatsStorage
+
+_PAGE = """<!doctype html>
+<html><head><title>deeplearning4j-tpu training UI</title>
+<style>
+ body { font-family: sans-serif; margin: 2em; background: #fafafa; }
+ h1 { font-size: 1.3em; } h2 { font-size: 1.05em; margin-top: 1.5em; }
+ .chart { border: 1px solid #ccc; background: #fff; }
+ #meta { color: #555; font-size: 0.9em; white-space: pre-line; }
+</style></head>
+<body>
+<h1>deeplearning4j-tpu training UI</h1>
+<div id="meta">loading…</div>
+<h2>Score</h2><canvas id="score" class="chart" width="860" height="240"></canvas>
+<h2>Per-layer mean magnitudes (updates)</h2>
+<canvas id="mm" class="chart" width="860" height="240"></canvas>
+<script>
+function drawSeries(canvas, series, labels) {
+  const ctx = canvas.getContext('2d');
+  ctx.clearRect(0, 0, canvas.width, canvas.height);
+  const all = series.flatMap(s => s.pts.map(p => p[1]))
+      .filter(v => isFinite(v));
+  if (!all.length) return;
+  const xs = series.flatMap(s => s.pts.map(p => p[0]));
+  const xmin = Math.min(...xs), xmax = Math.max(...xs) || 1;
+  const ymin = Math.min(...all), ymax = Math.max(...all);
+  const px = x => 40 + (canvas.width - 50) * (x - xmin) / Math.max(1, xmax - xmin);
+  const py = y => canvas.height - 20 - (canvas.height - 40) *
+      (y - ymin) / Math.max(1e-12, ymax - ymin);
+  const colors = ['#1565c0','#c62828','#2e7d32','#6a1b9a','#ef6c00','#00838f'];
+  series.forEach((s, i) => {
+    ctx.strokeStyle = colors[i % colors.length];
+    ctx.beginPath();
+    s.pts.forEach((p, j) => j ? ctx.lineTo(px(p[0]), py(p[1]))
+                              : ctx.moveTo(px(p[0]), py(p[1])));
+    ctx.stroke();
+    ctx.fillStyle = ctx.strokeStyle;
+    ctx.fillText(s.name, 45 + 150 * i, 12);
+  });
+  ctx.fillStyle = '#333';
+  ctx.fillText(ymax.toPrecision(4), 2, 14);
+  ctx.fillText(ymin.toPrecision(4), 2, canvas.height - 8);
+}
+async function refresh() {
+  const sessions = await (await fetch('api/sessions')).json();
+  if (!sessions.length) return;
+  const sid = sessions[sessions.length - 1];
+  const updates = await (await fetch('api/updates?sid=' + sid)).json();
+  const info = await (await fetch('api/static?sid=' + sid)).json();
+  const last = updates[updates.length - 1] || {};
+  document.getElementById('meta').textContent =
+    'session ' + sid + ' — ' + (info.model_class || '?') + ', ' +
+    (info.num_params || '?') + ' params — ' + updates.length + ' samples' +
+    (last.iterations_per_sec ?
+     ' — ' + last.iterations_per_sec.toFixed(2) + ' it/s' : '') +
+    (last.device_memory ? ' — mem ' +
+     (last.device_memory.bytes_in_use / 1048576).toFixed(0) + ' MiB' : '');
+  drawSeries(document.getElementById('score'),
+    [{name: 'score', pts: updates.map(u => [u.iteration, u.score])}]);
+  const layers = {};
+  updates.forEach(u => {
+    Object.entries(u.layer_stats || {}).forEach(([lk, ps]) => {
+      Object.entries(ps).forEach(([pn, d]) => {
+        const key = lk + '/' + pn;
+        (layers[key] = layers[key] || []).push([u.iteration, d.update_mm]);
+      });
+    });
+  });
+  drawSeries(document.getElementById('mm'),
+    Object.entries(layers).slice(0, 6)
+      .map(([name, pts]) => ({name, pts})));
+}
+refresh(); setInterval(refresh, 3000);
+</script></body></html>
+"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    storage: Optional[StatsStorage] = None
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    def _json(self, obj, code=200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        url = urlparse(self.path)
+        q = parse_qs(url.query)
+        sid = (q.get("sid") or [None])[0]
+        storage = type(self).storage
+        if url.path in ("/", "/train", "/index.html"):
+            body = _PAGE.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif url.path == "/api/sessions":
+            self._json(storage.list_session_ids() if storage else [])
+        elif url.path == "/api/static":
+            info = storage.get_static_info(sid) if storage and sid else None
+            self._json(info or {})
+        elif url.path == "/api/updates":
+            ups = storage.get_updates(sid) if storage and sid else []
+            self._json(ups)
+        else:
+            self._json({"error": "not found"}, 404)
+
+
+class UIServer:
+    """Reference: `PlayUIServer` / `UIServer.getInstance()`."""
+
+    def __init__(self, port: int = 9000, host: str = "127.0.0.1"):
+        self.host = host
+        self.port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._handler = type("BoundHandler", (_Handler,), {})
+
+    def attach(self, storage: StatsStorage) -> "UIServer":
+        self._handler.storage = storage
+        return self
+
+    def start(self) -> "UIServer":
+        self._httpd = ThreadingHTTPServer((self.host, self.port), self._handler)
+        self.port = self._httpd.server_address[1]  # resolve port 0
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/"
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

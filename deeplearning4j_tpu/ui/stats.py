@@ -1,0 +1,150 @@
+"""StatsListener: rich periodic training telemetry.
+
+TPU-native equivalent of the reference's
+`deeplearning4j-ui-model/.../stats/BaseStatsListener.java:43,273`: every N
+iterations it samples score, per-layer parameter/gradient/update mean
+magnitudes and histograms, per-step wall time, throughput, learning-rate
+info and device memory, and routes the record through a
+`StatsStorageRouter` (`api/storage.py`). Where the reference pulls
+gradients off the host model object, here gradient/update magnitudes are
+computed INSIDE the jitted train step (only scalars leave the device —
+`MultiLayerNetwork._train_step(collect_stats=True)`); histograms are taken
+from the params pytree on the sampled iterations only.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.api.storage import StatsStorageRouter
+from deeplearning4j_tpu.optimize.listeners import IterationListener
+
+
+class StatsListener(IterationListener):
+    """See module docstring. `frequency` = sample every N iterations."""
+
+    requires_training_stats = True
+
+    def __init__(self, storage: StatsStorageRouter, frequency: int = 10,
+                 session_id: Optional[str] = None, worker_id: str = "worker_0",
+                 collect_histograms: bool = True, histogram_bins: int = 20):
+        self.storage = storage
+        self.frequency = max(1, int(frequency))
+        self.session_id = session_id or f"session_{uuid.uuid4().hex[:12]}"
+        self.worker_id = worker_id
+        self.collect_histograms = collect_histograms
+        self.histogram_bins = int(histogram_bins)
+        self._static_sent = False
+        self._last_time: Optional[float] = None
+        self._last_iter = 0
+
+    # ------------------------------------------------------------- helpers
+
+    def _send_static(self, model) -> None:
+        info: Dict[str, Any] = {
+            "session_id": self.session_id,
+            "worker_id": self.worker_id,
+            "model_class": type(model).__name__,
+            "num_params": int(model.num_params()),
+        }
+        try:
+            info["model_config_json"] = model.conf.to_json()
+        except Exception:
+            pass
+        self.storage.put_static_info(info)
+        self._static_sent = True
+
+    def _histogram(self, arr: np.ndarray):
+        counts, edges = np.histogram(arr, bins=self.histogram_bins)
+        return {"min": float(edges[0]), "max": float(edges[-1]),
+                "counts": counts.tolist()}
+
+    @staticmethod
+    def _device_memory() -> Optional[Dict[str, int]]:
+        try:
+            import jax
+
+            stats = jax.local_devices()[0].memory_stats()
+            if not stats:
+                return None
+            return {k: int(v) for k, v in stats.items()
+                    if k in ("bytes_in_use", "peak_bytes_in_use",
+                             "bytes_limit", "largest_alloc_size")}
+        except Exception:
+            return None
+
+    # ---------------------------------------------------------------- hook
+
+    def iteration_done(self, model, iteration: int) -> None:
+        if not self._static_sent:
+            self._send_static(model)
+        if iteration % self.frequency != 0:
+            return
+        now = time.perf_counter()
+        record: Dict[str, Any] = {
+            "session_id": self.session_id,
+            "worker_id": self.worker_id,
+            "iteration": int(iteration),
+            "score": float(model.score_value),
+        }
+        if self._last_time is not None and iteration > self._last_iter:
+            dt = now - self._last_time
+            record["iterations_per_sec"] = (iteration - self._last_iter) / dt
+            record["ms_per_iteration"] = 1000.0 * dt / (iteration - self._last_iter)
+        self._last_time = now
+        self._last_iter = iteration
+
+        # In-jit gradient/update/param mean magnitudes (device scalars).
+        tstats = getattr(model, "last_training_stats", None)
+        if tstats:
+            record["layer_stats"] = {
+                lk: {pn: {k: float(v) for k, v in d.items()}
+                     for pn, d in lstats.items()}
+                for lk, lstats in tstats.items()
+            }
+        if self.collect_histograms:
+            hists: Dict[str, Any] = {}
+            for lk, lparams in model.params_tree.items():
+                for pn, arr in lparams.items():
+                    hists[f"{lk}/{pn}"] = self._histogram(
+                        np.asarray(arr, dtype="float32").ravel())
+            record["param_histograms"] = hists
+        mem = self._device_memory()
+        if mem:
+            record["device_memory"] = mem
+        self.storage.put_update(record)
+
+
+class ProfilerListener(IterationListener):
+    """Opt-in `jax.profiler` trace around a window of iterations — the
+    XPlane-level analog of the reference's per-phase timing stats
+    (SURVEY.md §5 tracing). Produces a TensorBoard-loadable trace dir."""
+
+    def __init__(self, log_dir: str, start_iteration: int = 10,
+                 num_iterations: int = 5):
+        self.log_dir = log_dir
+        self.start_iteration = int(start_iteration)
+        self.stop_iteration = int(start_iteration + num_iterations)
+        self._active = False
+
+    def iteration_done(self, model, iteration: int) -> None:
+        import jax
+
+        if not self._active and iteration == self.start_iteration:
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+        elif self._active and iteration >= self.stop_iteration:
+            jax.block_until_ready(model.params_tree)
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def on_epoch_end(self, model) -> None:
+        if self._active:  # never leak an open trace
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
