@@ -78,6 +78,37 @@ class ListDataSetIterator(DataSetIterator):
         return sum(b.num_examples() for b in self._batches)
 
 
+# Below this many bytes, one device_put of the whole batch tuple wins
+# (saves per-message round trips: 1.0ms vs 5.2ms for a LeNet batch on a
+# tunneled TPU). Above it, the batched-transfer RPC degrades badly
+# (178ms vs 23ms for a ResNet batch) and per-array puts win.
+_TUPLE_PUT_MAX_BYTES = 4 << 20
+
+
+def stage_to_device(ds: DataSet) -> DataSet:
+    """Transfer one DataSet's arrays host->device, choosing the transfer
+    shape empirically fastest for the batch size (see _TUPLE_PUT_MAX_BYTES)."""
+    import jax
+
+    parts = [np.asarray(ds.features)]
+    idx = {"features": 0}
+    for name in ("labels", "features_mask", "labels_mask"):
+        a = getattr(ds, name)
+        if a is not None:
+            idx[name] = len(parts)
+            parts.append(np.asarray(a))
+    if sum(p.nbytes for p in parts) <= _TUPLE_PUT_MAX_BYTES:
+        staged = jax.device_put(tuple(parts))
+    else:
+        staged = [jax.device_put(p) for p in parts]
+    return DataSet(
+        staged[0],
+        staged[idx["labels"]] if "labels" in idx else None,
+        staged[idx["features_mask"]] if "features_mask" in idx else None,
+        staged[idx["labels_mask"]] if "labels_mask" in idx else None,
+    )
+
+
 class AsyncDataSetIterator(DataSetIterator):
     """Background-thread prefetch to device (reference:
     `AsyncDataSetIterator.java` — the host-side I/O boundary of the fit()
@@ -91,14 +122,7 @@ class AsyncDataSetIterator(DataSetIterator):
     def _put(self, ds: DataSet) -> DataSet:
         if not self.device_prefetch:
             return ds
-        import jax
-
-        return DataSet(
-            jax.device_put(np.asarray(ds.features)),
-            None if ds.labels is None else jax.device_put(np.asarray(ds.labels)),
-            None if ds.features_mask is None else jax.device_put(np.asarray(ds.features_mask)),
-            None if ds.labels_mask is None else jax.device_put(np.asarray(ds.labels_mask)),
-        )
+        return stage_to_device(ds)
 
     def __iter__(self):
         q: "queue.Queue" = queue.Queue(maxsize=self.queue_size)
@@ -151,6 +175,59 @@ class AsyncDataSetIterator(DataSetIterator):
     def reset(self):
         if hasattr(self.base, "reset"):
             self.base.reset()
+
+
+class DeviceCacheDataSetIterator(DataSetIterator):
+    """Stage every batch to DEVICE memory once, replay from HBM thereafter.
+
+    TPU-native counterpart of the reference's `CachingDataSetIterator`
+    (`deeplearning4j-core/.../datasets/iterator/CachingDataSetIterator.java`),
+    which caches prepared DataSets host-side. On TPU the expensive boundary is
+    the host->device link — on a serialized transport, transfers cannot
+    overlap compute at all (measured: concurrent 38.5MB puts degrade 23ms ->
+    800ms while slowing the train step 2.7x) — so the cache lives in HBM.
+    Use for datasets that fit in device memory (MNIST/CIFAR scale); for
+    streaming-scale data use AsyncDataSetIterator and accept the link cost.
+    """
+
+    def __init__(self, base: Iterable, max_bytes: Optional[int] = None):
+        self.base = base
+        self.max_bytes = max_bytes
+        self._cache: Optional[List[DataSet]] = None
+
+    def _ds_bytes(self, ds: DataSet) -> int:
+        return sum(
+            np.asarray(a).nbytes
+            for a in (ds.features, ds.labels, ds.features_mask, ds.labels_mask)
+            if a is not None
+        )
+
+    def __iter__(self):
+        if self._cache is None:
+            staged, total = [], 0
+            for ds in self.base:
+                total += self._ds_bytes(ds)
+                if self.max_bytes is not None and total > self.max_bytes:
+                    raise MemoryError(
+                        f"DeviceCacheDataSetIterator: dataset exceeds "
+                        f"max_bytes={self.max_bytes}; use AsyncDataSetIterator "
+                        f"for streaming-scale data"
+                    )
+                staged.append(stage_to_device(ds))
+            self._cache = staged
+        return iter(self._cache)
+
+    def reset(self):
+        pass  # cache replays; the base iterator is consumed exactly once
+
+    def invalidate(self):
+        """Drop the device cache (e.g. after the underlying data changed)."""
+        self._cache = None
+
+    def total_examples(self):
+        if self._cache is not None:
+            return sum(d.num_examples() for d in self._cache)
+        return None
 
 
 class MultipleEpochsIterator(DataSetIterator):

@@ -69,6 +69,7 @@ class ComputationGraph:
         )
         self._jit_cache: Dict[Any, Any] = {}
         self._rnn_state: Dict[str, Any] = {}
+        self._clock = None  # on-device (step, rng) carry; see _device_clock
 
 
     @property
@@ -125,8 +126,20 @@ class ComputationGraph:
             for name in self.layer_vertices
         }
         self._train_rng = jax.random.PRNGKey(g.seed ^ 0x5EED)
+        self._clock = None
         self._initialized = True
         return self
+
+    def _device_clock(self):
+        """On-device (step, rng) carry, advanced inside the jitted train step
+        — the hot loop makes zero host->device transfers (a host scalar
+        conversion costs milliseconds over a tunneled device transport)."""
+        if self._clock is None:
+            self._clock = (
+                jax.device_put(np.float32(self.iteration)),
+                self._train_rng,
+            )
+        return self._clock
 
     # --------------------------------------------------------------- forward
 
@@ -198,7 +211,7 @@ class ComputationGraph:
             self._jit_cache[key] = self._build_jit(kind, **static)
         return self._jit_cache[key]
 
-    def _build_jit(self, kind: str, train=False, keep_rnn_state=False):
+    def _build_jit(self, kind: str, train=False, keep_rnn_state=False, advance=False):
         if kind == "output":
             def output_fn(params, state, inputs, fmasks, rng):
                 outs, new_state, _, _ = self._forward_fn(
@@ -220,21 +233,33 @@ class ComputationGraph:
                 return self._loss_from_outputs(params, outs, labels, lmasks, aux, omasks)[0]
             return jax.jit(score_fn)
         if kind == "train_step":
-            def step_fn(params, state, opt_state, inputs, labels, fmasks, lmasks, step, rng):
-                return self._train_step(params, state, opt_state, inputs, labels,
-                                        fmasks, lmasks, step, rng, carry_rnn=False)
+            def step_fn(params, state, opt_state, inputs, labels, fmasks, lmasks, clock):
+                step, key = clock
+                key, sub = jax.random.split(key)
+                out = self._train_step(params, state, opt_state, inputs, labels,
+                                       fmasks, lmasks, step, sub, carry_rnn=False)
+                return out + ((step + 1.0, key),)
             return jax.jit(step_fn, donate_argnums=(0, 2))
         if kind == "train_step_stats":
-            def step_fn_s(params, state, opt_state, inputs, labels, fmasks, lmasks, step, rng):
-                return self._train_step(params, state, opt_state, inputs, labels,
-                                        fmasks, lmasks, step, rng, carry_rnn=False,
-                                        collect_stats=True)
+            def step_fn_s(params, state, opt_state, inputs, labels, fmasks, lmasks, clock):
+                step, key = clock
+                key, sub = jax.random.split(key)
+                out = self._train_step(params, state, opt_state, inputs, labels,
+                                       fmasks, lmasks, step, sub, carry_rnn=False,
+                                       collect_stats=True)
+                return out + ((step + 1.0, key),)
             return jax.jit(step_fn_s, donate_argnums=(0, 2))
         if kind == "train_step_tbptt":
-            def step_fn2(params, state, opt_state, inputs, labels, fmasks, lmasks, step, rng, ebs):
-                return self._train_step(params, state, opt_state, inputs, labels,
-                                        fmasks, lmasks, step, rng, carry_rnn=True,
-                                        ebs=ebs)
+            # `advance` static: chunks of one sequence share a step value;
+            # only the final chunk ticks the clock.
+            def step_fn2(params, state, opt_state, inputs, labels, fmasks, lmasks, clock, ebs):
+                step, key = clock
+                key, sub = jax.random.split(key)
+                out = self._train_step(params, state, opt_state, inputs, labels,
+                                       fmasks, lmasks, step, sub, carry_rnn=True,
+                                       ebs=ebs)
+                new_step = step + 1.0 if advance else step
+                return out + ((new_step, key),)
             return jax.jit(step_fn2, donate_argnums=(0, 2))
         raise ValueError(kind)
 
@@ -404,12 +429,11 @@ class ComputationGraph:
         # of one chunk still counts — reference divide-by-minibatch).
         full_lmasks = mds.labels_masks
         ebs = tuple(
-            jnp.asarray(
+            jax.device_put(np.float32(
                 losses_mod.effective_batch_size(
                     l, full_lmasks[i] if full_lmasks is not None else None
-                ),
-                jnp.float32,
-            )
+                )
+            ))
             for i, l in enumerate(mds.labels)
         )
         for lab in mds.labels:
@@ -441,7 +465,8 @@ class ComputationGraph:
                 labels_masks=None if mds.labels_masks is None
                 else [time_slice(m, sl, is_mask=True) for m in mds.labels_masks],
             )
-            self._fit_one(chunk, tbptt=True, count_iteration=False, ebs=ebs)
+            self._fit_one(chunk, tbptt=True, count_iteration=False, ebs=ebs,
+                          advance=ci == n_chunks - 1)
         # Drop rnn carries, keep declared (BN) state.
         declared = {n: set(v.layer.state_shapes()) for n, v in self.layer_vertices.items()}
         self.state = {
@@ -456,17 +481,21 @@ class ComputationGraph:
             listener.iteration_done(self, self.iteration)
 
     def _next_rng(self):
+        if self._clock is not None:
+            # The rng stream's continuation lives in the device clock; pull it
+            # back to the host-side attribute before splitting.
+            self._train_rng = self._clock[1]
+            self._clock = None
         self._train_rng, sub = jax.random.split(self._train_rng)
         return sub
 
     def _fit_one(self, mds: MultiDataSet, tbptt: bool = False,
-                 count_iteration: bool = True, ebs=None):
+                 count_iteration: bool = True, ebs=None, advance=True):
         if tbptt:
-            kind = "train_step_tbptt"
+            step_fn = self._get_jit("train_step_tbptt", advance=advance)
         else:
             kind = "train_step_stats" if self._collect_stats else "train_step"
-        step_fn = self._get_jit(kind)
-        step = jnp.asarray(self.iteration, jnp.float32)
+            step_fn = self._get_jit(kind)
         fmasks = None
         if mds.features_masks is not None and any(m is not None for m in mds.features_masks):
             fmasks = [None if m is None else jnp.asarray(m) for m in mds.features_masks]
@@ -477,16 +506,16 @@ class ComputationGraph:
             self.params_tree, self.state, self.opt_state,
             [jnp.asarray(f) for f in mds.features],
             [jnp.asarray(l) for l in mds.labels],
-            fmasks, lmasks, step, self._next_rng(),
+            fmasks, lmasks, self._device_clock(),
         ]
         if tbptt:
             args.append(ebs)
         out = step_fn(*args)
-        if len(out) == 5:
-            self.params_tree, self.state, self.opt_state, loss, stats = out
+        if len(out) == 6:
+            self.params_tree, self.state, self.opt_state, loss, stats, self._clock = out
             self.last_training_stats = stats
         else:
-            self.params_tree, self.state, self.opt_state, loss = out
+            self.params_tree, self.state, self.opt_state, loss, self._clock = out
         self._score = loss  # device scalar; sync deferred to score_value
         if count_iteration:
             self.iteration += 1

@@ -19,8 +19,11 @@ from deeplearning4j_tpu.nn import activations
 def batchnorm_apply(conf, params, state, x, *, rng=None, train=False, mask=None):
     axes = tuple(range(x.ndim - 1))
     if train and conf.is_minibatch:
+        # Single-pass stats: mean and mean-of-squares fuse into ONE read of x
+        # (jnp.var would re-read the activation for (x-mean)^2 — the train
+        # step is HBM-bandwidth bound on TPU, so each avoided pass counts).
         mean = jnp.mean(x, axis=axes)
-        var = jnp.var(x, axis=axes)
+        var = jnp.mean(x * x, axis=axes) - mean * mean
         decay = conf.decay
         new_state = {
             "mean": decay * state["mean"] + (1.0 - decay) * mean,

@@ -62,6 +62,7 @@ class MultiLayerNetwork:
         self._score = float("nan")
         self.listeners: List[Any] = []
         self._rnn_state: Dict[str, Dict[str, jnp.ndarray]] = {}
+        self._clock = None  # on-device (step, rng) carry; see _device_clock
         self._initialized = False
         self._collect_stats = False
         self.last_training_stats: Dict[str, Any] = {}
@@ -129,8 +130,24 @@ class MultiLayerNetwork:
             for i, lk in enumerate(self.layer_keys)
         }
         self._train_rng = jax.random.PRNGKey(g.seed ^ 0x5EED)
+        self._clock = None
         self._initialized = True
         return self
+
+    # ------------------------------------------------------------- clock
+    # The (step, rng) pair lives ON DEVICE and is advanced inside the jitted
+    # train step. Converting a host scalar per iteration costs milliseconds
+    # over a high-latency device transport (measured ~7ms for a np scalar on
+    # a tunneled TPU), so the hot loop never transfers: one async dispatch
+    # per step, all-device arguments.
+
+    def _device_clock(self):
+        if self._clock is None:
+            self._clock = (
+                jax.device_put(np.float32(self.iteration)),
+                self._train_rng,
+            )
+        return self._clock
 
     # --------------------------------------------------------------- forward
 
@@ -187,7 +204,7 @@ class MultiLayerNetwork:
         self._jit_cache[key] = fn
         return fn
 
-    def _build_jit(self, kind: str, train=False, keep_rnn_state=False, with_aux=False):
+    def _build_jit(self, kind: str, train=False, keep_rnn_state=False, advance=False):
         if kind == "output":
             def output_fn(params, state, x, fmask, rng):
                 final, new_state, _, _ = self._forward_fn(
@@ -202,20 +219,33 @@ class MultiLayerNetwork:
                 return self._loss_from_preout(params, preout, y, lmask, aux)[0]
             return jax.jit(score_fn)
         if kind == "train_step":
-            def step_plain(params, state, opt_state, x, y, fmask, lmask, step, rng):
-                return self._train_step(params, state, opt_state, x, y, fmask,
-                                        lmask, step, rng, carry_rnn=False)
+            def step_plain(params, state, opt_state, x, y, fmask, lmask, clock):
+                step, key = clock
+                key, sub = jax.random.split(key)
+                out = self._train_step(params, state, opt_state, x, y, fmask,
+                                       lmask, step, sub, carry_rnn=False)
+                return out + ((step + 1.0, key),)
             return jax.jit(step_plain, donate_argnums=(0, 2))
         if kind == "train_step_stats":
-            def step_stats(params, state, opt_state, x, y, fmask, lmask, step, rng):
-                return self._train_step(params, state, opt_state, x, y, fmask,
-                                        lmask, step, rng, carry_rnn=False,
-                                        collect_stats=True)
+            def step_stats(params, state, opt_state, x, y, fmask, lmask, clock):
+                step, key = clock
+                key, sub = jax.random.split(key)
+                out = self._train_step(params, state, opt_state, x, y, fmask,
+                                       lmask, step, sub, carry_rnn=False,
+                                       collect_stats=True)
+                return out + ((step + 1.0, key),)
             return jax.jit(step_stats, donate_argnums=(0, 2))
         if kind == "train_step_tbptt":
-            def step_tbptt(params, state, opt_state, x, y, fmask, lmask, step, rng, eb):
-                return self._train_step(params, state, opt_state, x, y, fmask,
-                                        lmask, step, rng, carry_rnn=True, eb=eb)
+            # `advance` is static: all chunks of one sequence share the same
+            # step value (reference: one optimize iteration per sequence);
+            # only the final chunk ticks the clock.
+            def step_tbptt(params, state, opt_state, x, y, fmask, lmask, clock, eb):
+                step, key = clock
+                key, sub = jax.random.split(key)
+                out = self._train_step(params, state, opt_state, x, y, fmask,
+                                       lmask, step, sub, carry_rnn=True, eb=eb)
+                new_step = step + 1.0 if advance else step
+                return out + ((new_step, key),)
             return jax.jit(step_tbptt, donate_argnums=(0, 2))
         if kind == "feedforward":
             def ff_fn(params, state, x, fmask, rng):
@@ -440,7 +470,10 @@ class MultiLayerNetwork:
         if key not in self._jit_cache:
             prep = self.conf.input_preprocessors.get(layer_idx)
 
-            def step_fn(lparams, opt_state, full_params, state, x, step, rng):
+            def step_fn(lparams, opt_state, full_params, state, x, clock):
+                step, key = clock
+                key, rng = jax.random.split(key)
+
                 def loss_fn(lp):
                     # Forward through the frozen stack below this layer.
                     h, _, _, _ = self._forward_fn(
@@ -455,16 +488,15 @@ class MultiLayerNetwork:
                 lr = self._schedules[layer_idx](step)
                 st, deltas = self._updaters[layer_idx].update(opt_state, grads, lr, step)
                 new_lp = {k: lparams[k] - deltas[k] for k in lparams}
-                return new_lp, st, loss
+                return new_lp, st, loss, (step + 1.0, key)
 
             # No donation: the layer's param buffers also appear inside
             # full_params (arg 2), so they cannot be safely donated.
             self._jit_cache[key] = jax.jit(step_fn)
         step_fn = self._jit_cache[key]
-        new_lp, new_opt, loss = step_fn(
+        new_lp, new_opt, loss, self._clock = step_fn(
             self.params_tree[lk], self.opt_state[lk], self.params_tree,
-            self.state, x, jnp.asarray(self.iteration, jnp.float32),
-            self._next_rng(),
+            self.state, x, self._device_clock(),
         )
         self.params_tree = {**self.params_tree, lk: new_lp}
         self.opt_state = {**self.opt_state, lk: new_opt}
@@ -474,26 +506,30 @@ class MultiLayerNetwork:
             listener.iteration_done(self, self.iteration)
 
     def _next_rng(self):
+        if self._clock is not None:
+            # The rng stream's continuation lives in the device clock; pull it
+            # back to the host-side attribute before splitting.
+            self._train_rng = self._clock[1]
+            self._clock = None
         self._train_rng, sub = jax.random.split(self._train_rng)
         return sub
 
     def _fit_one(self, ds: DataSet):
         collect = self._collect_stats
         step_fn = self._get_jit("train_step_stats" if collect else "train_step")
-        step = jnp.asarray(self.iteration, jnp.float32)
         out = step_fn(
             self.params_tree, self.state, self.opt_state,
             jnp.asarray(ds.features),
             jnp.asarray(ds.labels),
             None if ds.features_mask is None else jnp.asarray(ds.features_mask),
             None if ds.labels_mask is None else jnp.asarray(ds.labels_mask),
-            step, self._next_rng(),
+            self._device_clock(),
         )
         if collect:
-            self.params_tree, self.state, self.opt_state, loss, stats = out
+            self.params_tree, self.state, self.opt_state, loss, stats, self._clock = out
             self.last_training_stats = stats  # device scalars, fetched lazily
         else:
-            self.params_tree, self.state, self.opt_state, loss = out
+            self.params_tree, self.state, self.opt_state, loss, self._clock = out
         self._score = loss  # device scalar; sync deferred to score_value
         self.iteration += 1
         for listener in self.listeners:
@@ -509,10 +545,9 @@ class MultiLayerNetwork:
         saved_state = self.state
         # Divisor from the FULL-sequence mask: a row masked out of one chunk
         # (shorter sequence) still counts, reference divide-by-minibatch.
-        eb = jnp.asarray(
-            losses_mod.effective_batch_size(ds.features, ds.labels_mask),
-            jnp.float32,
-        )
+        eb = jax.device_put(np.float32(
+            losses_mod.effective_batch_size(ds.features, ds.labels_mask)
+        ))
         for ci in range(n_chunks):
             sl = slice(ci * fwd, min((ci + 1) * fwd, t))
             if ds.labels is None or ds.labels.ndim != 3:
@@ -526,15 +561,14 @@ class MultiLayerNetwork:
                 ds.features_mask[:, sl] if ds.features_mask is not None else None,
                 ds.labels_mask[:, sl] if ds.labels_mask is not None else None,
             )
-            step_fn = self._get_jit("train_step_tbptt")
-            step = jnp.asarray(self.iteration, jnp.float32)
-            self.params_tree, self.state, self.opt_state, loss = step_fn(
+            step_fn = self._get_jit("train_step_tbptt", advance=ci == n_chunks - 1)
+            self.params_tree, self.state, self.opt_state, loss, self._clock = step_fn(
                 self.params_tree, self.state, self.opt_state,
                 jnp.asarray(chunk.features),
                 jnp.asarray(chunk.labels),
                 None if chunk.features_mask is None else jnp.asarray(chunk.features_mask),
                 None if chunk.labels_mask is None else jnp.asarray(chunk.labels_mask),
-                step, self._next_rng(), eb,
+                self._device_clock(), eb,
             )
             self._score = loss  # device scalar; sync deferred to score_value
         # Reset rnn carries after the sequence; keep persistent (BN) state.
