@@ -89,10 +89,44 @@ def _map_activation(name: Optional[str]) -> str:
         raise KerasImportException(f"Unsupported Keras activation: {name!r}")
 
 
-def _map_loss(name: Optional[str]) -> str:
+def _map_loss(name) -> str:
+    """Map a Keras loss identifier to a framework loss name.
+
+    The reference raises `UnsupportedKerasConfigurationException` for
+    unknown losses (`KerasLayer.mapLossFunction`); mirror that instead of
+    silently substituting mse."""
     if not name:
         return "mse"
-    return _LOSSES.get(name, "mse")
+    if isinstance(name, (dict, list, tuple)):
+        raise KerasImportException(
+            f"Per-output loss specs ({type(name).__name__}) must be resolved "
+            "per output before mapping — use _loss_for_output")
+    try:
+        return _LOSSES[name]
+    except KeyError:
+        raise KerasImportException(f"Unsupported Keras loss: {name!r}")
+
+
+def _loss_for_output(training, output_name: str, index: int) -> str:
+    """Resolve the compiled loss for one output of a (possibly multi-output)
+    model: dict losses map by output name, list losses by position."""
+    loss = (training or {}).get("loss")
+    if isinstance(loss, dict):
+        entry = loss.get(output_name)
+        if entry is None and len(loss) == 1:
+            entry = next(iter(loss.values()))
+        if entry is None:
+            raise KerasImportException(
+                f"training_config loss dict has no entry for output "
+                f"{output_name!r} (keys: {sorted(loss)})")
+        return _map_loss(entry)
+    if isinstance(loss, (list, tuple)):
+        if index >= len(loss):
+            raise KerasImportException(
+                f"training_config loss list has {len(loss)} entries but "
+                f"output index is {index}")
+        return _map_loss(loss[index])
+    return _map_loss(loss)
 
 
 def _pair(v, default=(1, 1)) -> Tuple[int, int]:
@@ -144,26 +178,62 @@ def _input_type_from_shape(shape, dim_ordering: str) -> InputType:
     raise KerasImportException(f"Unsupported input shape {shape}")
 
 
-def _layer_dim_ordering(cfg: Dict[str, Any]) -> str:
+def _layer_dim_ordering(cfg: Dict[str, Any], default: str = "th") -> str:
     v = cfg.get("dim_ordering") or cfg.get("data_format")
     if v in ("th", "channels_first"):
         return "th"
     if v in ("tf", "channels_last"):
         return "tf"
-    return "th"  # Keras 1 default
+    return default  # Keras 1 default is "th"; see _model_dim_ordering
+
+
+def _model_dim_ordering(specs: List[Dict[str, Any]], h5_attrs=None) -> str:
+    """Infer the model-wide dim ordering when the layer carrying
+    batch_input_shape has no dim_ordering/data_format key (real Keras files
+    never store it on InputLayer — ADVICE r2). Order of evidence: the first
+    Conv/Pooling layer that records an ordering, then the file's
+    keras_version attr (Keras 2 default = channels_last), else Keras 1's
+    'th' default."""
+    def walk(spec_list):
+        for spec in spec_list:
+            cfg = spec.get("config", {}) or {}
+            v = cfg.get("dim_ordering") or cfg.get("data_format")
+            if v in ("th", "channels_first"):
+                return "th"
+            if v in ("tf", "channels_last"):
+                return "tf"
+            inner = cfg.get("layers")
+            if isinstance(inner, list):  # nested Model/Sequential
+                found = walk(inner)
+                if found:
+                    return found
+        return None
+
+    found = walk(specs)
+    if found:
+        return found
+    if h5_attrs is not None:
+        kv = h5_attrs.get("keras_version")
+        if isinstance(kv, bytes):
+            kv = kv.decode()
+        if kv and not str(kv).startswith("1"):
+            return "tf"
+    return "th"
 
 
 class _Converter:
     """Keras layer list -> framework layers, tracking weight mapping."""
 
-    def __init__(self, training_config: Optional[Dict[str, Any]] = None):
+    def __init__(self, training_config: Optional[Dict[str, Any]] = None,
+                 default_dim_ordering: str = "th"):
         self.training_config = training_config or {}
         self.layers: List[Any] = []
         # our-layer-index -> (_KerasLayer, kind) for weight loading
         self.weight_map: Dict[int, Tuple[_KerasLayer, str]] = {}
         self.input_type: Optional[InputType] = None
         self._pending_pad: Tuple[int, int] = (0, 0)
-        self.dim_ordering = "th"
+        self.default_dim_ordering = default_dim_ordering
+        self.dim_ordering = default_dim_ordering
 
     # -------------------------------------------------------------- layers
 
@@ -171,7 +241,8 @@ class _Converter:
         cfg = kl.config
         cname = kl.class_name
         if self.input_type is None and cfg.get("batch_input_shape"):
-            self.dim_ordering = _layer_dim_ordering(cfg)
+            self.dim_ordering = _layer_dim_ordering(
+                cfg, self.default_dim_ordering)
             self.input_type = _input_type_from_shape(
                 cfg["batch_input_shape"][1:], self.dim_ordering)
         handler = getattr(self, f"_on_{cname}", None)
@@ -307,25 +378,28 @@ class _Converter:
         appended — appending keeps `weight_map` indices valid."""
         from deeplearning4j_tpu.nn.conf.layers import LossLayer
 
-        loss = _map_loss(self.training_config.get("loss"))
-        for i in range(len(self.layers) - 1, -1, -1):
-            layer = self.layers[i]
-            if isinstance(layer, DropoutLayer):
-                continue
-            act = getattr(layer, "activation", None) or "identity"
-            if loss == "mse" and act == "softmax":
-                loss = "mcxent"
-            if isinstance(layer, DenseLayer) and not isinstance(layer, OutputLayer):
-                self.layers[i] = OutputLayer(
-                    n_out=layer.n_out, activation=act, loss_function=loss)
-            elif isinstance(layer, ActivationLayer):
-                self.layers[i] = LossLayer(activation=act, loss_function=loss)
-            elif type(layer).__name__ not in (
-                    "OutputLayer", "RnnOutputLayer", "LossLayer"):
-                # param-free loss head keeps the Keras function unchanged
-                self.layers.append(LossLayer(activation="identity",
-                                             loss_function=loss))
-            break
+        loss = _loss_for_output(self.training_config, "", 0)
+        # Trailing Dropout layers are no-ops at inference and would sit
+        # after the output head; drop them (they carry no weights, and only
+        # trailing indices are removed, so weight_map stays valid).
+        while self.layers and isinstance(self.layers[-1], DropoutLayer):
+            self.layers.pop()
+        if not self.layers:
+            raise KerasImportException("Model has no convertible layers")
+        layer = self.layers[-1]
+        act = getattr(layer, "activation", None) or "identity"
+        if loss == "mse" and act == "softmax":
+            loss = "mcxent"
+        if isinstance(layer, DenseLayer) and not isinstance(layer, OutputLayer):
+            self.layers[-1] = OutputLayer(
+                n_out=layer.n_out, activation=act, loss_function=loss)
+        elif isinstance(layer, ActivationLayer):
+            self.layers[-1] = LossLayer(activation=act, loss_function=loss)
+        elif type(layer).__name__ not in (
+                "OutputLayer", "RnnOutputLayer", "LossLayer"):
+            # param-free loss head keeps the Keras function unchanged
+            self.layers.append(LossLayer(activation="identity",
+                                         loss_function=loss))
 
 
 # ----------------------------------------------------------- weight loading
@@ -494,8 +568,10 @@ def import_keras_sequential_model_and_weights(path, input_type: Optional[InputTy
             raise KerasImportException(
                 f"Not a Sequential model: {topo.get('class_name')!r} "
                 "(use import_keras_model_and_weights)")
-        conv = _Converter(training)
-        for spec in _sequential_layer_specs(topo):
+        specs = _sequential_layer_specs(topo)
+        conv = _Converter(training,
+                          default_dim_ordering=_model_dim_ordering(specs, f.attrs))
+        for spec in specs:
             conv.convert(_KerasLayer(spec))
         conv.finalize_output_layer()
         itype = input_type or conv.input_type
@@ -539,6 +615,7 @@ def import_keras_model_and_weights(path):
                 "Sequential model: use import_keras_sequential_model_and_weights")
         cfg = topo["config"]
         specs = [_KerasLayer(s) for s in cfg["layers"]]
+        default_ordering = _model_dim_ordering(cfg["layers"], f.attrs)
         input_names = [e[0] for e in cfg.get("input_layers", [])]
         output_names = [e[0] for e in cfg.get("output_layers", [])]
 
@@ -555,7 +632,10 @@ def import_keras_model_and_weights(path):
             cname = kl.class_name
             if cname == "InputLayer":
                 shape = kl.config.get("batch_input_shape")
-                ordering = _layer_dim_ordering(kl.config)
+                # InputLayer configs never carry dim_ordering/data_format in
+                # real Keras files; fall back to the model-wide ordering
+                # inferred from the first conv/pool layer or keras_version.
+                ordering = _layer_dim_ordering(kl.config, default_ordering)
                 input_types.append(_input_type_from_shape(shape[1:], ordering))
                 gb.add_inputs(kl.name)
                 graph_names[kl.name] = kl.name
@@ -613,12 +693,12 @@ def import_keras_model_and_weights(path):
         # Output vertices: convert a trailing plain Dense into an OutputLayer
         # with the compiled loss so the imported graph is trainable
         # (reference: `KerasModel` attaches the loss to output layers).
-        loss = _map_loss((training or {}).get("loss"))
         from deeplearning4j_tpu.nn.conf.graph import LayerVertex as _LV
 
         from deeplearning4j_tpu.nn.conf.layers import LossLayer as _LossLayer
 
-        for name in output_names:
+        for out_idx, name in enumerate(output_names):
+            loss = _loss_for_output(training, name, out_idx)
             vname = graph_names[name]
             v = gb._vertices.get(vname)
             if not isinstance(v, _LV):

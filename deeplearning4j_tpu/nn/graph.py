@@ -211,7 +211,8 @@ class ComputationGraph:
             self._jit_cache[key] = self._build_jit(kind, **static)
         return self._jit_cache[key]
 
-    def _build_jit(self, kind: str, train=False, keep_rnn_state=False, advance=False):
+    def _build_jit(self, kind: str, train=False, keep_rnn_state=False,
+                   advance=False, collect=False):
         if kind == "output":
             def output_fn(params, state, inputs, fmasks, rng):
                 outs, new_state, _, _ = self._forward_fn(
@@ -251,13 +252,14 @@ class ComputationGraph:
             return jax.jit(step_fn_s, donate_argnums=(0, 2))
         if kind == "train_step_tbptt":
             # `advance` static: chunks of one sequence share a step value;
-            # only the final chunk ticks the clock.
+            # only the final chunk ticks the clock. `collect` adds the
+            # StatsListener scalars so tBPTT training reports them too.
             def step_fn2(params, state, opt_state, inputs, labels, fmasks, lmasks, clock, ebs):
                 step, key = clock
                 key, sub = jax.random.split(key)
                 out = self._train_step(params, state, opt_state, inputs, labels,
                                        fmasks, lmasks, step, sub, carry_rnn=True,
-                                       ebs=ebs)
+                                       ebs=ebs, collect_stats=collect)
                 new_step = step + 1.0 if advance else step
                 return out + ((new_step, key),)
             return jax.jit(step_fn2, donate_argnums=(0, 2))
@@ -492,7 +494,8 @@ class ComputationGraph:
     def _fit_one(self, mds: MultiDataSet, tbptt: bool = False,
                  count_iteration: bool = True, ebs=None, advance=True):
         if tbptt:
-            step_fn = self._get_jit("train_step_tbptt", advance=advance)
+            step_fn = self._get_jit("train_step_tbptt", advance=advance,
+                                    collect=self._collect_stats)
         else:
             kind = "train_step_stats" if self._collect_stats else "train_step"
             step_fn = self._get_jit(kind)

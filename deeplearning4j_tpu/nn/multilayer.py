@@ -204,7 +204,8 @@ class MultiLayerNetwork:
         self._jit_cache[key] = fn
         return fn
 
-    def _build_jit(self, kind: str, train=False, keep_rnn_state=False, advance=False):
+    def _build_jit(self, kind: str, train=False, keep_rnn_state=False,
+                   advance=False, collect=False):
         if kind == "output":
             def output_fn(params, state, x, fmask, rng):
                 final, new_state, _, _ = self._forward_fn(
@@ -238,12 +239,14 @@ class MultiLayerNetwork:
         if kind == "train_step_tbptt":
             # `advance` is static: all chunks of one sequence share the same
             # step value (reference: one optimize iteration per sequence);
-            # only the final chunk ticks the clock.
+            # only the final chunk ticks the clock. `collect` adds the
+            # StatsListener scalars (grad/update/param mean magnitudes).
             def step_tbptt(params, state, opt_state, x, y, fmask, lmask, clock, eb):
                 step, key = clock
                 key, sub = jax.random.split(key)
                 out = self._train_step(params, state, opt_state, x, y, fmask,
-                                       lmask, step, sub, carry_rnn=True, eb=eb)
+                                       lmask, step, sub, carry_rnn=True, eb=eb,
+                                       collect_stats=collect)
                 new_step = step + 1.0 if advance else step
                 return out + ((new_step, key),)
             return jax.jit(step_tbptt, donate_argnums=(0, 2))
@@ -561,8 +564,10 @@ class MultiLayerNetwork:
                 ds.features_mask[:, sl] if ds.features_mask is not None else None,
                 ds.labels_mask[:, sl] if ds.labels_mask is not None else None,
             )
-            step_fn = self._get_jit("train_step_tbptt", advance=ci == n_chunks - 1)
-            self.params_tree, self.state, self.opt_state, loss, self._clock = step_fn(
+            collect = self._collect_stats
+            step_fn = self._get_jit("train_step_tbptt",
+                                    advance=ci == n_chunks - 1, collect=collect)
+            out = step_fn(
                 self.params_tree, self.state, self.opt_state,
                 jnp.asarray(chunk.features),
                 jnp.asarray(chunk.labels),
@@ -570,6 +575,12 @@ class MultiLayerNetwork:
                 None if chunk.labels_mask is None else jnp.asarray(chunk.labels_mask),
                 self._device_clock(), eb,
             )
+            if collect:
+                (self.params_tree, self.state, self.opt_state, loss, stats,
+                 self._clock) = out
+                self.last_training_stats = stats
+            else:
+                self.params_tree, self.state, self.opt_state, loss, self._clock = out
             self._score = loss  # device scalar; sync deferred to score_value
         # Reset rnn carries after the sequence; keep persistent (BN) state.
         self.state = {

@@ -23,12 +23,15 @@ from deeplearning4j_tpu.keras.import_model import (
 )
 
 
-def write_keras_h5(path, model_config, weights, training_config=None):
+def write_keras_h5(path, model_config, weights, training_config=None,
+                   keras_version=None):
     """Write a Keras-1-format model file: config attrs + weight groups."""
     with h5py.File(path, "w") as f:
         f.attrs["model_config"] = json.dumps(model_config).encode()
         if training_config is not None:
             f.attrs["training_config"] = json.dumps(training_config).encode()
+        if keras_version is not None:
+            f.attrs["keras_version"] = keras_version.encode()
         root = f.create_group("model_weights")
         for layer_name, wlist in weights.items():
             grp = root.create_group(layer_name)
@@ -388,3 +391,127 @@ class TestFunctionalModel:
         e = np.exp(logits - logits.max(axis=1, keepdims=True))
         np.testing.assert_allclose(got, e / e.sum(axis=1, keepdims=True),
                                    rtol=1e-5, atol=1e-6)
+
+
+class TestAdviceRegressions:
+    """Regression tests for the round-2 advisor findings (ADVICE.md)."""
+
+    def test_functional_channels_last_inferred_from_conv(self, tmp_path, rng):
+        """InputLayer configs never carry data_format in real Keras files;
+        the ordering must be inferred from the first conv layer. With the
+        old 'th' fallback the [None,4,6,3] input parsed as (c=4,h=6,w=3)
+        and weight application failed."""
+        k = rng.randn(3, 3, 3, 2).astype("float32")  # HWIO, cin=3
+        Wo = rng.randn(2 * 2 * 4, 2).astype("float32")
+        cfg = {
+            "class_name": "Model",
+            "config": {
+                "layers": [
+                    {"class_name": "InputLayer", "name": "in",
+                     "config": {"name": "in",
+                                "batch_input_shape": [None, 4, 6, 3]},
+                     "inbound_nodes": []},
+                    {"class_name": "Conv2D", "name": "conv",
+                     "config": {"name": "conv", "filters": 2,
+                                "kernel_size": [3, 3], "strides": [1, 1],
+                                "padding": "valid",
+                                "data_format": "channels_last",
+                                "activation": "relu"},
+                     "inbound_nodes": [[["in", 0, 0]]]},
+                    {"class_name": "Flatten", "name": "flat",
+                     "config": {"name": "flat"},
+                     "inbound_nodes": [[["conv", 0, 0]]]},
+                    {"class_name": "Dense", "name": "out",
+                     "config": {"name": "out", "units": 2,
+                                "activation": "softmax"},
+                     "inbound_nodes": [[["flat", 0, 0]]]},
+                ],
+                "input_layers": [["in", 0, 0]],
+                "output_layers": [["out", 0, 0]],
+            },
+        }
+        path = str(tmp_path / "cl.h5")
+        write_keras_h5(path, cfg, {
+            "conv": [("conv_W", k), ("conv_b", np.zeros(2))],
+            "out": [("out_W", Wo), ("out_b", np.zeros(2))],
+        }, TRAIN_CFG)
+        net = import_keras_model_and_weights(path)
+        x = rng.randn(2, 4, 6, 3).astype("float32")
+        got = net.output_single(x)
+        conv = np.maximum(_conv2d_hwio(x, k, np.zeros(2)), 0.0)
+        logits = conv.reshape(2, -1) @ Wo
+        e = np.exp(logits - logits.max(axis=1, keepdims=True))
+        np.testing.assert_allclose(got, e / e.sum(axis=1, keepdims=True),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_keras2_version_attr_defaults_channels_last(self, tmp_path, rng):
+        """No layer records an ordering: the file's keras_version attr
+        decides (Keras 2 default = channels_last)."""
+        k = rng.randn(3, 3, 3, 2).astype("float32")  # HWIO
+        cfg = seq_config([
+            {"class_name": "Conv2D",
+             "config": {"name": "c", "filters": 2, "kernel_size": [3, 3],
+                        "activation": "relu",
+                        "batch_input_shape": [None, 4, 6, 3]}},
+            {"class_name": "Flatten", "config": {"name": "f"}},
+            {"class_name": "Dense",
+             "config": {"name": "d", "units": 2, "activation": "softmax"}},
+        ])
+        path = str(tmp_path / "k2.h5")
+        write_keras_h5(path, cfg, {
+            "c": [("c_W", k), ("c_b", np.zeros(2))],
+            "d": [("d_W", rng.randn(16, 2)), ("d_b", np.zeros(2))],
+        }, TRAIN_CFG, keras_version="2.2.4")
+        net = import_keras_sequential_model_and_weights(path)
+        out = net.output(rng.randn(2, 4, 6, 3).astype("float32"))
+        assert out.shape == (2, 2)
+
+    def test_unknown_loss_raises(self, tmp_path, rng):
+        cfg = seq_config([
+            {"class_name": "Dense",
+             "config": {"name": "d", "output_dim": 2, "activation": "softmax",
+                        "batch_input_shape": [None, 3]}},
+        ])
+        path = str(tmp_path / "badloss.h5")
+        write_keras_h5(path, cfg,
+                       {"d": [("d_W", rng.randn(3, 2)), ("d_b", np.zeros(2))]},
+                       {"loss": "my_custom_loss",
+                        "optimizer_config": {"config": {"lr": 0.01}}})
+        with pytest.raises(KerasImportException, match="loss"):
+            import_keras_sequential_model_and_weights(path)
+
+    def test_dict_loss_resolved_per_output(self, tmp_path, rng):
+        cfg = seq_config([
+            {"class_name": "Dense",
+             "config": {"name": "d", "output_dim": 2, "activation": "softmax",
+                        "batch_input_shape": [None, 3]}},
+        ])
+        path = str(tmp_path / "dictloss.h5")
+        write_keras_h5(path, cfg,
+                       {"d": [("d_W", rng.randn(3, 2)), ("d_b", np.zeros(2))]},
+                       {"loss": {"d": "categorical_crossentropy"},
+                        "optimizer_config": {"config": {"lr": 0.01}}})
+        net = import_keras_sequential_model_and_weights(path)
+        assert net.layers[-1].loss_function == "mcxent"
+
+    def test_trailing_dropout_dropped_and_trainable(self, tmp_path, rng):
+        """A trailing Dropout previously survived as the last layer, so
+        fit() raised 'Last layer is not an output layer'."""
+        cfg = seq_config([
+            {"class_name": "Dense",
+             "config": {"name": "d", "output_dim": 3, "activation": "softmax",
+                        "batch_input_shape": [None, 4]}},
+            {"class_name": "Dropout", "config": {"name": "drop", "p": 0.3}},
+        ])
+        path = str(tmp_path / "traildrop.h5")
+        write_keras_h5(path, cfg,
+                       {"d": [("d_W", rng.randn(4, 3)), ("d_b", np.zeros(3))]},
+                       TRAIN_CFG)
+        net = import_keras_sequential_model_and_weights(path)
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        X = rng.randn(8, 4).astype("float32")
+        Y = np.eye(3)[rng.randint(0, 3, 8)].astype("float32")
+        s0 = net.score(DataSet(X, Y))
+        for _ in range(10):
+            net.fit(X, Y)
+        assert net.score(DataSet(X, Y)) < s0
