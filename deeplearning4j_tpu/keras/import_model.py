@@ -89,6 +89,21 @@ def _map_activation(name: Optional[str]) -> str:
         raise KerasImportException(f"Unsupported Keras activation: {name!r}")
 
 
+_LOSS_CLASS_NAMES = {
+    # tf.keras >=2.3 serialized loss objects ({'class_name','config'}).
+    "CategoricalCrossentropy": "categorical_crossentropy",
+    "SparseCategoricalCrossentropy": "sparse_categorical_crossentropy",
+    "BinaryCrossentropy": "binary_crossentropy",
+    "MeanSquaredError": "mse",
+    "MeanAbsoluteError": "mae",
+    "KLDivergence": "kullback_leibler_divergence",
+    "Poisson": "poisson",
+    "CosineSimilarity": "cosine_proximity",
+    "Hinge": "hinge",
+    "SquaredHinge": "squared_hinge",
+}
+
+
 def _map_loss(name) -> str:
     """Map a Keras loss identifier to a framework loss name.
 
@@ -97,6 +112,12 @@ def _map_loss(name) -> str:
     silently substituting mse."""
     if not name:
         return "mse"
+    if isinstance(name, dict) and "class_name" in name:
+        cname = name["class_name"]
+        if cname not in _LOSS_CLASS_NAMES:
+            raise KerasImportException(
+                f"Unsupported serialized Keras loss class: {cname!r}")
+        name = _LOSS_CLASS_NAMES[cname]
     if isinstance(name, (dict, list, tuple)):
         raise KerasImportException(
             f"Per-output loss specs ({type(name).__name__}) must be resolved "
@@ -112,6 +133,8 @@ def _loss_for_output(training, output_name: str, index: int) -> str:
     model: dict losses map by output name, list losses by position."""
     loss = (training or {}).get("loss")
     if isinstance(loss, dict):
+        if "class_name" in loss:  # serialized loss object, not a per-output map
+            return _map_loss(loss)
         entry = loss.get(output_name)
         if entry is None and len(loss) == 1:
             entry = next(iter(loss.values()))
@@ -178,7 +201,7 @@ def _input_type_from_shape(shape, dim_ordering: str) -> InputType:
     raise KerasImportException(f"Unsupported input shape {shape}")
 
 
-def _layer_dim_ordering(cfg: Dict[str, Any], default: str = "th") -> str:
+def _layer_dim_ordering(cfg: Dict[str, Any], default="th"):
     v = cfg.get("dim_ordering") or cfg.get("data_format")
     if v in ("th", "channels_first"):
         return "th"
@@ -197,11 +220,9 @@ def _model_dim_ordering(specs: List[Dict[str, Any]], h5_attrs=None) -> str:
     def walk(spec_list):
         for spec in spec_list:
             cfg = spec.get("config", {}) or {}
-            v = cfg.get("dim_ordering") or cfg.get("data_format")
-            if v in ("th", "channels_first"):
-                return "th"
-            if v in ("tf", "channels_last"):
-                return "tf"
+            found = _layer_dim_ordering(cfg, default=None)
+            if found:
+                return found
             inner = cfg.get("layers")
             if isinstance(inner, list):  # nested Model/Sequential
                 found = walk(inner)

@@ -402,9 +402,13 @@ class ComputationGraph:
                 iterator.reset()
             except Exception:
                 pass
+        for listener in self.listeners:
+            listener.on_epoch_start(self)
         for item in iterator:
             self._fit_dispatch(_as_mds(item))
         self.epoch += 1
+        for listener in self.listeners:
+            listener.on_epoch_end(self)
         return self
 
     def _fit_dispatch(self, mds: MultiDataSet):

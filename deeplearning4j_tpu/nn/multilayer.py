@@ -44,6 +44,8 @@ from deeplearning4j_tpu.datasets.dataset import DataSet
 def _as_dataset(data, labels=None) -> DataSet:
     if isinstance(data, DataSet):
         return data
+    if labels is None and isinstance(data, tuple) and len(data) == 2:
+        data, labels = data  # score((x, y)) / fit((x, y)) convenience form
     return DataSet(np.asarray(data), None if labels is None else np.asarray(labels))
 
 
@@ -394,7 +396,8 @@ class MultiLayerNetwork:
         (reference: `MultiLayerNetwork.fit(DataSetIterator)` `:976`)."""
         if not self._initialized:
             self.init()
-        if labels is not None or isinstance(data, DataSet):
+        if labels is not None or isinstance(data, DataSet) or (
+                isinstance(data, tuple) and len(data) == 2):
             iterator = [_as_dataset(data, labels)]
         else:
             iterator = data
@@ -416,12 +419,14 @@ class MultiLayerNetwork:
                     iterator.reset()
                 except Exception:
                     pass
-        if not self.conf.backprop:
-            self.epoch += 1
-            return self
-        for ds in iterator:
-            self._fit_dispatch(ds)
+        for listener in self.listeners:
+            listener.on_epoch_start(self)
+        if self.conf.backprop:
+            for ds in iterator:
+                self._fit_dispatch(ds)
         self.epoch += 1
+        for listener in self.listeners:
+            listener.on_epoch_end(self)
         return self
 
     def _fit_dispatch(self, ds: DataSet):

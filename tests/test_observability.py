@@ -1,0 +1,263 @@
+"""Observability stack tests: StatsListener -> storage -> UIServer.
+
+Reference analogs: `BaseStatsListener.java:43,273` (stats collection),
+`InMemoryStatsStorage`/`FileStatsStorage` (`api/storage/impl/`), the Play
+UI's train-module JSON routes (`TrainModule.java:92-99`), and the
+TrainingListener epoch hooks (`optimize/api/TrainingListener.java`).
+
+These exercise the engines' `train_step_stats` jit variants in CI (the
+stats pytree shape is load-bearing for the UI) and the epoch-hook dispatch
+from both engines' fit().
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.api.storage import (
+    FileStatsStorage,
+    InMemoryStatsStorage,
+)
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    DenseLayer,
+    LSTM,
+    OutputLayer,
+    RnnOutputLayer,
+)
+from deeplearning4j_tpu.nn.conf.neural_net import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.listeners import IterationListener
+from deeplearning4j_tpu.ui.server import UIServer
+from deeplearning4j_tpu.ui.stats import ProfilerListener, StatsListener
+
+
+def mlp_net():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).learning_rate(0.1).updater("sgd")
+            .list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss_function="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def graph_net():
+    gb = (NeuralNetConfiguration.builder()
+          .seed(7).learning_rate(0.1).updater("sgd")
+          .graph_builder()
+          .add_inputs("in")
+          .add_layer("d", DenseLayer(n_out=8, activation="tanh"), "in")
+          .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                        loss_function="mcxent"), "d")
+          .set_outputs("out"))
+    gb.set_input_types(InputType.feed_forward(4))
+    return ComputationGraph(gb.build()).init()
+
+
+def batch(rng, b=16):
+    x = rng.randn(b, 4).astype("float32")
+    y = np.eye(3)[rng.randint(0, 3, b)].astype("float32")
+    return x, y
+
+
+class TestStatsListener:
+    def test_mln_records_content(self, rng):
+        storage = InMemoryStatsStorage()
+        net = mlp_net()
+        net.set_listeners(StatsListener(storage, frequency=1,
+                                        session_id="s1"))
+        assert net._collect_stats  # stats jit variant engaged
+        x, y = batch(rng)
+        for _ in range(3):
+            net.fit(x, y)
+
+        info = storage.get_static_info("s1")
+        assert info["model_class"] == "MultiLayerNetwork"
+        assert info["num_params"] == net.num_params()
+        updates = storage.get_updates("s1")
+        assert len(updates) == 3
+        rec = updates[-1]
+        assert np.isfinite(rec["score"])
+        # In-jit mean magnitudes for every param of every trainable layer.
+        ls = rec["layer_stats"]
+        for lk in net.layer_keys:
+            if net.params_tree.get(lk):
+                for pn in net.params_tree[lk]:
+                    for stat in ("grad_mm", "update_mm", "param_mm"):
+                        assert np.isfinite(ls[lk][pn][stat])
+        # Histograms cover the same params.
+        assert any(k.endswith("/W") for k in rec["param_histograms"])
+        counts = next(iter(rec["param_histograms"].values()))["counts"]
+        assert sum(counts) > 0
+
+    def test_graph_records_content(self, rng):
+        storage = InMemoryStatsStorage()
+        net = graph_net()
+        net.set_listeners(StatsListener(storage, frequency=1,
+                                        session_id="g1",
+                                        collect_histograms=False))
+        x, y = batch(rng)
+        for _ in range(2):
+            net.fit(x, y)
+        rec = storage.get_latest_update("g1")
+        assert rec["layer_stats"]["d"]["W"]["grad_mm"] >= 0
+        assert rec["layer_stats"]["out"]["W"]["update_mm"] >= 0
+
+    def test_tbptt_stats_collected(self, rng):
+        """tBPTT training must feed StatsListener too (ADVICE r2: the tbptt
+        jit previously never collected, leaving stale/no stats)."""
+        conf = (NeuralNetConfiguration.builder()
+                .seed(7).learning_rate(0.05).updater("sgd")
+                .list()
+                .layer(LSTM(n_out=6, activation="tanh"))
+                .layer(RnnOutputLayer(n_out=3, activation="softmax",
+                                      loss_function="mcxent"))
+                .set_input_type(InputType.recurrent(4, 12))
+                .backprop_type("truncatedbptt")
+                .t_bptt_forward_length(4).t_bptt_backward_length(4)
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        storage = InMemoryStatsStorage()
+        net.set_listeners(StatsListener(storage, frequency=1,
+                                        session_id="t1",
+                                        collect_histograms=False))
+        x = rng.randn(2, 12, 4).astype("float32")
+        y = np.eye(3)[rng.randint(0, 3, (2, 12))].astype("float32")
+        net.fit(x, y)
+        rec = storage.get_latest_update("t1")
+        ls = rec["layer_stats"]
+        assert np.isfinite(ls["layer_0"]["W"]["grad_mm"])
+        assert np.isfinite(ls["layer_1"]["W"]["update_mm"])
+
+
+class TestFileStatsStorage:
+    def test_jsonl_roundtrip(self, tmp_path, rng):
+        path = str(tmp_path / "stats.jsonl")
+        storage = FileStatsStorage(path)
+        storage.put_static_info({"session_id": "f1", "worker_id": "w0",
+                                 "model_class": "X", "num_params": 3})
+        storage.put_update({"session_id": "f1", "iteration": 1, "score": 0.5})
+        storage.put_update({"session_id": "f1", "iteration": 2, "score": 0.4})
+
+        # Fresh instance reads back what the first wrote (restart survival).
+        readback = FileStatsStorage(path)
+        assert readback.list_session_ids() == ["f1"]
+        assert readback.get_static_info("f1")["num_params"] == 3
+        ups = readback.get_updates("f1")
+        assert [u["iteration"] for u in ups] == [1, 2]
+        assert readback.get_latest_update("f1")["score"] == 0.4
+        # Every line is valid JSON with a timestamp.
+        with open(path) as f:
+            for line in f:
+                assert "timestamp" in json.loads(line)
+
+    def test_listener_through_file_storage(self, tmp_path, rng):
+        storage = FileStatsStorage(str(tmp_path / "s.jsonl"))
+        net = mlp_net()
+        net.set_listeners(StatsListener(storage, frequency=1, session_id="f2",
+                                        collect_histograms=False))
+        x, y = batch(rng)
+        net.fit(x, y)
+        assert storage.get_latest_update("f2")["iteration"] == 1
+
+
+class TestUIServer:
+    def test_endpoints_over_http(self, rng):
+        storage = InMemoryStatsStorage()
+        net = mlp_net()
+        net.set_listeners(StatsListener(storage, frequency=1, session_id="u1",
+                                        collect_histograms=False))
+        x, y = batch(rng)
+        net.fit(x, y)
+
+        server = UIServer(port=0).attach(storage).start()
+        try:
+            def get(path):
+                with urllib.request.urlopen(server.url.rstrip("/") + path,
+                                            timeout=5) as r:
+                    return r.status, r.read()
+
+            status, body = get("/api/sessions")
+            assert status == 200 and json.loads(body) == ["u1"]
+            status, body = get("/api/static?sid=u1")
+            assert json.loads(body)["model_class"] == "MultiLayerNetwork"
+            status, body = get("/api/updates?sid=u1")
+            ups = json.loads(body)
+            assert len(ups) == 1 and np.isfinite(ups[0]["score"])
+            status, body = get("/")
+            assert status == 200 and b"training UI" in body
+            status, _ = urllib.request.urlopen(
+                server.url.rstrip("/") + "/api/sessions", timeout=5).status, None
+        finally:
+            server.stop()
+
+    def test_unknown_path_404(self):
+        server = UIServer(port=0).attach(InMemoryStatsStorage()).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(server.url.rstrip("/") + "/nope",
+                                       timeout=5)
+            assert ei.value.code == 404
+        finally:
+            server.stop()
+
+
+class TestProfilerListener:
+    def test_trace_dir_created(self, tmp_path, rng):
+        log_dir = str(tmp_path / "trace")
+        net = mlp_net()
+        net.set_listeners(ProfilerListener(log_dir, start_iteration=2,
+                                           num_iterations=2))
+        x, y = batch(rng)
+        for _ in range(6):
+            net.fit(x, y)
+        import glob
+        import os
+        assert os.path.isdir(log_dir)
+        assert glob.glob(os.path.join(log_dir, "**", "*.xplane.pb"),
+                         recursive=True), "no xplane trace written"
+
+
+class _EpochSpy(IterationListener):
+    def __init__(self):
+        self.starts = 0
+        self.ends = 0
+        self.iters = 0
+
+    def on_epoch_start(self, model):
+        self.starts += 1
+
+    def on_epoch_end(self, model):
+        self.ends += 1
+
+    def iteration_done(self, model, iteration):
+        self.iters += 1
+
+
+class TestEpochHooks:
+    def test_mln_dispatches_epoch_hooks(self, rng):
+        net = mlp_net()
+        spy = _EpochSpy()
+        net.set_listeners(spy)
+        x, y = batch(rng)
+        ds = DataSet(x, y)
+        net.fit([ds, ds])   # one epoch, two batches
+        net.fit([ds])       # second epoch
+        assert spy.starts == 2
+        assert spy.ends == 2
+        assert spy.iters == 3
+
+    def test_graph_dispatches_epoch_hooks(self, rng):
+        net = graph_net()
+        spy = _EpochSpy()
+        net.set_listeners(spy)
+        x, y = batch(rng)
+        net.fit(x, y)
+        assert spy.starts == 1 and spy.ends == 1 and spy.iters == 1
