@@ -20,7 +20,10 @@ import numpy as np
 from deeplearning4j_tpu.nn import activations as activations_mod
 from deeplearning4j_tpu.nn import losses as losses_mod
 from deeplearning4j_tpu.nn import params as params_mod
-from deeplearning4j_tpu.nn.conf.enums import BackpropType
+from deeplearning4j_tpu.nn.conf.enums import (
+    BackpropType,
+    OptimizationAlgorithm,
+)
 from deeplearning4j_tpu.nn.conf.graph import (
     DuplicateToTimeSeriesVertex,
     LastTimeStepVertex,
@@ -212,7 +215,32 @@ class ComputationGraph:
         return self._jit_cache[key]
 
     def _build_jit(self, kind: str, train=False, keep_rnn_state=False,
-                   advance=False, collect=False):
+                   advance=False, collect=False, algo=None):
+        if kind == "solver_step":
+            from jax.flatten_util import ravel_pytree
+
+            from deeplearning4j_tpu.optimize import solvers as solvers_mod
+
+            g = self.conf.global_conf
+            iterations = max(1, g.iterations)
+            mls = max(1, int(g.max_num_line_search_iterations))
+
+            def solver_fn(params, state, inputs, labels, fmasks, lmasks):
+                w0, unravel = ravel_pytree(params)
+
+                def loss_flat(w):
+                    p = unravel(w)
+                    outs, _, aux, omasks = self._forward_fn(
+                        p, state, inputs, None, False, fmasks)
+                    return self._loss_from_outputs(
+                        p, outs, labels, lmasks, aux, omasks)[0]
+
+                w, loss = solvers_mod.minimize(
+                    algo, loss_flat, w0, iterations=iterations,
+                    max_line_search=mls)
+                return unravel(w), loss
+
+            return jax.jit(solver_fn, donate_argnums=(0,))
         if kind == "output":
             def output_fn(params, state, inputs, fmasks, rng):
                 outs, new_state, _, _ = self._forward_fn(
@@ -415,6 +443,9 @@ class ComputationGraph:
         """tBPTT/plain dispatch + iterations loop for one staged batch —
         shared by `fit()` and `ParallelWrapper`."""
         g = self.conf.global_conf
+        algo = OptimizationAlgorithm.of(g.optimization_algo)
+        if algo != OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT:
+            return self._fit_solver(mds, algo)
         tbptt = BackpropType.of(self.conf.backprop_type) == BackpropType.TRUNCATED_BPTT
         for _ in range(max(1, g.iterations)):
             if tbptt and any(
@@ -424,6 +455,32 @@ class ComputationGraph:
                 self._fit_tbptt(mds)
             else:
                 self._fit_one(mds)
+
+    def _fit_solver(self, mds: MultiDataSet, algo):
+        """Full-batch LBFGS/CG/line-search optimize of one batch (reference:
+        `Solver.java:41-110`); see `MultiLayerNetwork._fit_solver`."""
+        g = self.conf.global_conf
+        fn = self._get_jit("solver_step", algo=str(algo))
+        fmasks = None
+        if mds.features_masks is not None and any(
+                m is not None for m in mds.features_masks):
+            fmasks = [None if m is None else jnp.asarray(m)
+                      for m in mds.features_masks]
+        lmasks = None
+        if mds.labels_masks is not None and any(
+                m is not None for m in mds.labels_masks):
+            lmasks = [None if m is None else jnp.asarray(m)
+                      for m in mds.labels_masks]
+        self.params_tree, loss = fn(
+            self.params_tree, self.state,
+            [jnp.asarray(f) for f in mds.features],
+            [jnp.asarray(l) for l in mds.labels],
+            fmasks, lmasks,
+        )
+        self._score = loss
+        self.iteration += max(1, g.iterations)
+        for listener in self.listeners:
+            listener.iteration_done(self, self.iteration)
 
     def _fit_tbptt(self, mds: MultiDataSet):
         """Truncated BPTT over a DAG (reference: `ComputationGraph` tBPTT path):

@@ -31,7 +31,11 @@ import numpy as np
 from deeplearning4j_tpu.nn import activations as activations_mod
 from deeplearning4j_tpu.nn import losses as losses_mod
 from deeplearning4j_tpu.nn import params as params_mod
-from deeplearning4j_tpu.nn.conf.enums import BackpropType, LossFunction
+from deeplearning4j_tpu.nn.conf.enums import (
+    BackpropType,
+    LossFunction,
+    OptimizationAlgorithm,
+)
 from deeplearning4j_tpu.nn.conf.layers import CenterLossOutputLayer
 from deeplearning4j_tpu.nn.conf.neural_net import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.layers import OUTPUT_LAYER_TYPES, get_impl
@@ -207,7 +211,31 @@ class MultiLayerNetwork:
         return fn
 
     def _build_jit(self, kind: str, train=False, keep_rnn_state=False,
-                   advance=False, collect=False):
+                   advance=False, collect=False, algo=None):
+        if kind == "solver_step":
+            from jax.flatten_util import ravel_pytree
+
+            from deeplearning4j_tpu.optimize import solvers as solvers_mod
+
+            g = self.conf.global_conf
+            iterations = max(1, g.iterations)
+            mls = max(1, int(g.max_num_line_search_iterations))
+
+            def solver_fn(params, state, x, y, fmask, lmask):
+                w0, unravel = ravel_pytree(params)
+
+                def loss_flat(w):
+                    p = unravel(w)
+                    preout, _, _, aux = self._forward_fn(
+                        p, state, x, None, False, fmask)
+                    return self._loss_from_preout(p, preout, y, lmask, aux)[0]
+
+                w, loss = solvers_mod.minimize(
+                    algo, loss_flat, w0, iterations=iterations,
+                    max_line_search=mls)
+                return unravel(w), loss
+
+            return jax.jit(solver_fn, donate_argnums=(0,))
         if kind == "output":
             def output_fn(params, state, x, fmask, rng):
                 final, new_state, _, _ = self._forward_fn(
@@ -434,12 +462,34 @@ class MultiLayerNetwork:
         shared by `fit()` and `ParallelWrapper` so sharded training honors
         the same backprop-type config."""
         g = self.conf.global_conf
+        algo = OptimizationAlgorithm.of(g.optimization_algo)
+        if algo != OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT:
+            return self._fit_solver(ds, algo)
         tbptt = BackpropType.of(self.conf.backprop_type) == BackpropType.TRUNCATED_BPTT
         for _ in range(max(1, g.iterations)):
             if tbptt and ds.features.ndim == 3 and ds.features.shape[1] > self.conf.tbptt_fwd_length:
                 self._fit_tbptt(ds)
             else:
                 self._fit_one(ds)
+
+    def _fit_solver(self, ds: DataSet, algo):
+        """Full-batch LBFGS/CG/line-search optimize of one batch (reference:
+        `Solver.java:41-110` dispatching to `optimize/solvers/`); the whole
+        `iterations`-step solver loop is one jitted XLA computation
+        (`optimize/solvers.py`). Deterministic forward (no dropout, BN
+        running stats) so the line search sees a stable objective."""
+        g = self.conf.global_conf
+        fn = self._get_jit("solver_step", algo=str(algo))
+        self.params_tree, loss = fn(
+            self.params_tree, self.state,
+            jnp.asarray(ds.features), jnp.asarray(ds.labels),
+            None if ds.features_mask is None else jnp.asarray(ds.features_mask),
+            None if ds.labels_mask is None else jnp.asarray(ds.labels_mask),
+        )
+        self._score = loss
+        self.iteration += max(1, g.iterations)
+        for listener in self.listeners:
+            listener.iteration_done(self, self.iteration)
 
     # ------------------------------------------------------------- pretrain
 

@@ -1,0 +1,192 @@
+"""Second-order / line-search solvers.
+
+TPU-native equivalents of the reference's `optimize/solvers/` family —
+`LBFGS.java`, `ConjugateGradient.java`, `LineGradientDescent.java`,
+`BackTrackLineSearch.java`. The reference runs these as host loops mutating
+the flat parameter view; here each is a pure, jit-traceable function over
+the flat parameter vector: the WHOLE multi-iteration optimize loop
+(`BaseOptimizer.optimize()` analog) compiles to one XLA computation —
+`lax.scan` over iterations, `lax.while_loop` for the backtracking line
+search, fixed-size circular buffers for the L-BFGS history.
+
+Engines call these through `minimize()` when the config's
+`optimization_algo` is not SGD (reference: `Solver.java:41-110` dispatch).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.enums import OptimizationAlgorithm
+
+Array = jax.Array
+
+
+def backtrack_line_search(loss_fn: Callable[[Array], Array], w: Array,
+                          loss0: Array, grad: Array, direction: Array,
+                          max_iters: int = 5, step0: float = 1.0,
+                          rho: float = 0.5, c1: float = 1e-4
+                          ) -> Tuple[Array, Array, Array]:
+    """Armijo backtracking (reference: `BackTrackLineSearch.java` — same
+    sufficient-decrease test, geometric step shrink). Returns
+    (w_new, loss_new, step_taken); if no step satisfies the condition within
+    `max_iters` shrinks, returns the unchanged point with step 0 (the
+    reference's `step = 0` failure path, letting CG/L-BFGS restart).
+    """
+    slope = jnp.vdot(direction, grad)
+
+    def cond(carry):
+        alpha, it, _, loss_new = carry
+        return jnp.logical_and(it < max_iters,
+                               loss_new > loss0 + c1 * alpha * slope)
+
+    def body(carry):
+        alpha, it, _, _ = carry
+        alpha = alpha * rho
+        return alpha, it + 1, w + alpha * direction, loss_fn(w + alpha * direction)
+
+    alpha0 = jnp.asarray(step0, w.dtype)
+    init = (alpha0, jnp.asarray(0, jnp.int32), w + alpha0 * direction,
+            loss_fn(w + alpha0 * direction))
+    alpha, _, w_new, loss_new = jax.lax.while_loop(cond, body, init)
+    ok = loss_new <= loss0 + c1 * alpha * slope
+    w_out = jnp.where(ok, w_new, w)
+    loss_out = jnp.where(ok, loss_new, loss0)
+    step_out = jnp.where(ok, alpha, 0.0)
+    return w_out, loss_out, step_out
+
+
+def _line_gradient_descent(loss_fn, w0, iterations, max_line_search):
+    """Steepest descent + line search (reference: `LineGradientDescent.java`)."""
+    vg = jax.value_and_grad(loss_fn)
+
+    def step(carry, _):
+        w, _ = carry
+        loss, g = vg(w)
+        # Normalized direction keeps step0=1 meaningful across scales
+        # (reference normalizes via setupSearchState/GradientStepFunction).
+        d = -g / (jnp.linalg.norm(g) + 1e-12)
+        w_new, loss_new, _ = backtrack_line_search(
+            loss_fn, w, loss, g, d, max_iters=max_line_search)
+        return (w_new, loss_new), loss_new
+
+    (w, loss), _ = jax.lax.scan(step, (w0, loss_fn(w0)), None,
+                                length=iterations)
+    return w, loss
+
+
+def _conjugate_gradient(loss_fn, w0, iterations, max_line_search):
+    """Nonlinear CG, Polak-Ribière+ with automatic restart (reference:
+    `ConjugateGradient.java` — PR beta, restart when beta <= 0 or the line
+    search fails)."""
+    vg = jax.value_and_grad(loss_fn)
+    loss0, g0 = vg(w0)
+
+    def step(carry, _):
+        w, loss, g, d = carry
+        w_new, loss_new, alpha = backtrack_line_search(
+            loss_fn, w, loss, g, d, max_iters=max_line_search)
+        loss_new, g_new = vg(w_new)
+        beta = jnp.vdot(g_new, g_new - g) / (jnp.vdot(g, g) + 1e-30)
+        beta = jnp.maximum(beta, 0.0)           # PR+ restart
+        beta = jnp.where(alpha > 0.0, beta, 0.0)  # failed search -> steepest
+        d_new = -g_new + beta * d
+        # Ensure descent; otherwise reset to steepest descent.
+        d_new = jnp.where(jnp.vdot(d_new, g_new) < 0.0, d_new, -g_new)
+        return (w_new, loss_new, g_new, d_new), loss_new
+
+    init = (w0, loss0, g0, -g0)
+    (w, loss, _, _), _ = jax.lax.scan(step, init, None, length=iterations)
+    return w, loss
+
+
+def _lbfgs(loss_fn, w0, iterations, max_line_search, history=10):
+    """L-BFGS two-loop recursion over a fixed-size circular (s, y) history
+    (reference: `LBFGS.java` — the reference uses a LinkedList of the last m
+    (s, y) pairs; a ring buffer is the static-shape equivalent XLA needs)."""
+    vg = jax.value_and_grad(loss_fn)
+    n = w0.shape[0]
+    m = history
+
+    def direction(g, S, Y, rho, k):
+        """Two-loop recursion with masking for unfilled slots."""
+        q = g
+        alphas = jnp.zeros((m,), w0.dtype)
+        valid_count = jnp.minimum(k, m)
+
+        def loop1(i, qa):
+            q, alphas = qa
+            idx = jnp.mod(k - 1 - i, m)
+            valid = i < valid_count
+            a = rho[idx] * jnp.vdot(S[idx], q)
+            a = jnp.where(valid, a, 0.0)
+            q = q - a * Y[idx]
+            alphas = alphas.at[idx].set(a)
+            return q, alphas
+
+        q, alphas = jax.lax.fori_loop(0, m, loop1, (q, alphas))
+        # Initial Hessian scaling gamma = s.y / y.y of the newest pair.
+        newest = jnp.mod(k - 1, m)
+        sy = jnp.vdot(S[newest], Y[newest])
+        yy = jnp.vdot(Y[newest], Y[newest])
+        gamma = jnp.where(k > 0, sy / (yy + 1e-30), 1.0)
+        r = gamma * q
+
+        def loop2(i, r):
+            idx = jnp.mod(k - valid_count + i, m)
+            valid = i < valid_count
+            b = rho[idx] * jnp.vdot(Y[idx], r)
+            upd = S[idx] * (alphas[idx] - b)
+            return r + jnp.where(valid, upd, 0.0)
+
+        r = jax.lax.fori_loop(0, m, loop2, r)
+        return -r
+
+    def step(carry, _):
+        w, loss, g, S, Y, rho, k = carry
+        d = direction(g, S, Y, rho, k)
+        # Fall back to steepest descent if d is not a descent direction.
+        d = jnp.where(jnp.vdot(d, g) < 0.0, d, -g / (jnp.linalg.norm(g) + 1e-12))
+        w_new, _, alpha = backtrack_line_search(
+            loss_fn, w, loss, g, d, max_iters=max_line_search)
+        loss_new, g_new = vg(w_new)
+        s = w_new - w
+        y = g_new - g
+        sy = jnp.vdot(s, y)
+        # Only store curvature pairs with s.y > 0 (positive definiteness).
+        store = jnp.logical_and(alpha > 0.0, sy > 1e-12)
+        slot = jnp.mod(k, m)
+        S = jnp.where(store, S.at[slot].set(s), S)
+        Y = jnp.where(store, Y.at[slot].set(y), Y)
+        rho = jnp.where(store, rho.at[slot].set(1.0 / (sy + 1e-30)), rho)
+        k = k + jnp.where(store, 1, 0)
+        return (w_new, loss_new, g_new, S, Y, rho, k), loss_new
+
+    loss0, g0 = vg(w0)
+    init = (w0, loss0, g0,
+            jnp.zeros((m, n), w0.dtype), jnp.zeros((m, n), w0.dtype),
+            jnp.zeros((m,), w0.dtype), jnp.asarray(0, jnp.int32))
+    (w, loss, *_), _ = jax.lax.scan(step, init, None, length=iterations)
+    return w, loss
+
+
+def minimize(algo, loss_fn: Callable[[Array], Array], w0: Array,
+             iterations: int = 10, max_line_search: int = 5,
+             history: int = 10) -> Tuple[Array, Array]:
+    """Run `iterations` solver iterations of `algo` from `w0`; returns
+    (w_final, final_loss). Pure and jit-traceable (reference dispatch:
+    `Solver.java:41-110`)."""
+    algo = OptimizationAlgorithm.of(algo)
+    if algo == OptimizationAlgorithm.LINE_GRADIENT_DESCENT:
+        return _line_gradient_descent(loss_fn, w0, iterations, max_line_search)
+    if algo == OptimizationAlgorithm.CONJUGATE_GRADIENT:
+        return _conjugate_gradient(loss_fn, w0, iterations, max_line_search)
+    if algo == OptimizationAlgorithm.LBFGS:
+        return partial(_lbfgs, history=history)(
+            loss_fn, w0, iterations, max_line_search)
+    raise ValueError(f"minimize() does not handle {algo!r} (SGD uses the "
+                     "fused jitted train step)")
