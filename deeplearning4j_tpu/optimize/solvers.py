@@ -163,7 +163,14 @@ def _lbfgs(loss_fn, w0, iterations, max_line_search, history=10):
         S = jnp.where(store, S.at[slot].set(s), S)
         Y = jnp.where(store, Y.at[slot].set(y), Y)
         rho = jnp.where(store, rho.at[slot].set(1.0 / (sy + 1e-30)), rho)
-        k = k + jnp.where(store, 1, 0)
+        # On a rejected pair, RESTART (drop the history) instead of freezing
+        # it: a stale history keeps proposing the same rejected quasi-Newton
+        # direction with a stale gamma, whose tiny accepted steps never yield
+        # s.y > 0, so the solver stalls permanently (the reference avoids the
+        # stall by restarting/widening in `BackTrackLineSearch.java`). With
+        # k reset to 0 the next direction is steepest descent and fresh
+        # curvature pairs are captured again.
+        k = jnp.where(store, k + 1, 0)
         return (w_new, loss_new, g_new, S, Y, rho, k), loss_new
 
     loss0, g0 = vg(w0)
