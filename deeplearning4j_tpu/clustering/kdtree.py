@@ -1,0 +1,134 @@
+"""KD-tree for nearest-neighbor search.
+
+Equivalent of the reference's `clustering/kdtree/KDTree.java` (insert,
+nearest-neighbor, k-NN, range search over axis-aligned splits). A KD-tree
+is a host-side search structure in the reference too (pure Java over
+INDArray rows); the TPU framework keeps it host-side as a batch-build
+median-split tree over numpy arrays — device work is only worthwhile for
+the brute-force path, which `knn_brute` provides via one [Q, N] distance
+matrix for large batches of queries.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+class _Node:
+    __slots__ = ("point_idx", "axis", "left", "right")
+
+    def __init__(self, point_idx: int, axis: int):
+        self.point_idx = point_idx
+        self.axis = axis
+        self.left: Optional["_Node"] = None
+        self.right: Optional["_Node"] = None
+
+
+class KDTree:
+    """Median-split KD-tree. `insert` parity with the reference plus a bulk
+    constructor (`KDTree(points)`) that builds a balanced tree."""
+
+    def __init__(self, points: Optional[np.ndarray] = None, dims: Optional[int] = None):
+        if points is not None:
+            points = np.asarray(points, np.float64)
+            self._points: List[np.ndarray] = []
+            self.dims = points.shape[1]
+            self._root = None
+            for p in points:   # balanced bulk build
+                self._points.append(p)
+            idx = np.arange(len(points))
+            self._root = self._build(points, idx, 0)
+        else:
+            if dims is None:
+                raise ValueError("provide points or dims")
+            self.dims = dims
+            self._points = []
+            self._root = None
+
+    def _build(self, points: np.ndarray, idx: np.ndarray, depth: int):
+        if len(idx) == 0:
+            return None
+        axis = depth % self.dims
+        order = idx[np.argsort(points[idx, axis], kind="stable")]
+        mid = len(order) // 2
+        node = _Node(int(order[mid]), axis)
+        node.left = self._build(points, order[:mid], depth + 1)
+        node.right = self._build(points, order[mid + 1:], depth + 1)
+        return node
+
+    # ------------------------------------------------------------- insert
+
+    def insert(self, point: np.ndarray) -> None:
+        point = np.asarray(point, np.float64)
+        idx = len(self._points)
+        self._points.append(point)
+        if self._root is None:
+            self._root = _Node(idx, 0)
+            return
+        node = self._root
+        depth = 0
+        while True:
+            axis = depth % self.dims
+            if point[axis] < self._points[node.point_idx][axis]:
+                if node.left is None:
+                    node.left = _Node(idx, (depth + 1) % self.dims)
+                    return
+                node = node.left
+            else:
+                if node.right is None:
+                    node.right = _Node(idx, (depth + 1) % self.dims)
+                    return
+                node = node.right
+            depth += 1
+
+    def size(self) -> int:
+        return len(self._points)
+
+    # ------------------------------------------------------------ queries
+
+    def nn(self, query: np.ndarray) -> Tuple[float, np.ndarray]:
+        """Nearest neighbor: (distance, point) — reference `KDTree.nn`."""
+        d, i = self.knn_indices(query, 1)[0]
+        return d, self._points[i]
+
+    def knn(self, query: np.ndarray, k: int) -> List[Tuple[float, np.ndarray]]:
+        return [(d, self._points[i]) for d, i in self.knn_indices(query, k)]
+
+    def knn_indices(self, query: np.ndarray, k: int) -> List[Tuple[float, int]]:
+        query = np.asarray(query, np.float64)
+        best: List[Tuple[float, int]] = []  # kept sorted, max size k
+
+        def visit(node):
+            if node is None:
+                return
+            p = self._points[node.point_idx]
+            d = float(np.linalg.norm(query - p))
+            if len(best) < k or d < best[-1][0]:
+                best.append((d, node.point_idx))
+                best.sort(key=lambda t: t[0])
+                del best[k:]
+            diff = query[node.axis] - p[node.axis]
+            near, far = (node.left, node.right) if diff < 0 else (node.right, node.left)
+            visit(near)
+            # Prune: only cross the splitting plane if it can contain a
+            # closer point than the current k-th best.
+            if len(best) < k or abs(diff) < best[-1][0]:
+                visit(far)
+
+        visit(self._root)
+        return best
+
+
+def knn_brute(points: np.ndarray, queries: np.ndarray, k: int
+              ) -> Tuple[np.ndarray, np.ndarray]:
+    """Brute-force batched k-NN: one [Q, N] distance matrix (the MXU-shaped
+    path for large query batches). Returns (distances [Q,k], indices [Q,k])."""
+    points = np.asarray(points, np.float64)
+    queries = np.asarray(queries, np.float64)
+    d2 = (np.sum(queries ** 2, axis=1)[:, None]
+          - 2.0 * queries @ points.T + np.sum(points ** 2, axis=1)[None, :])
+    idx = np.argsort(d2, axis=1)[:, :k]
+    d = np.sqrt(np.maximum(np.take_along_axis(d2, idx, axis=1), 0.0))
+    return d, idx

@@ -1,0 +1,91 @@
+"""Vantage-point tree.
+
+Equivalent of the reference's `clustering/vptree/VPTree.java` (metric-space
+nearest-neighbor structure; the reference uses it to find input-space
+neighbors for Barnes-Hut t-SNE). Build: pick a vantage point, split the
+remainder at the median distance into inside/outside balls; search prunes
+balls by the triangle inequality.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+class _VPNode:
+    __slots__ = ("idx", "mu", "inside", "outside")
+
+    def __init__(self, idx: int, mu: float):
+        self.idx = idx
+        self.mu = mu
+        self.inside: Optional["_VPNode"] = None
+        self.outside: Optional["_VPNode"] = None
+
+
+class VPTree:
+    """VP-tree over a fixed point set with euclidean or cosine distance
+    (reference `VPTree(items, distanceFunction)`)."""
+
+    def __init__(self, points: np.ndarray, distance_function: str = "euclidean",
+                 seed: int = 12345):
+        self.points = np.asarray(points, np.float64)
+        self.distance_function = distance_function
+        if distance_function == "cosine":
+            norms = np.linalg.norm(self.points, axis=1, keepdims=True)
+            self._unit = self.points / np.maximum(norms, 1e-12)
+        rng = np.random.RandomState(seed)
+        self._root = self._build(np.arange(len(self.points)), rng)
+
+    def _dist(self, i: int, idx: np.ndarray) -> np.ndarray:
+        if self.distance_function == "cosine":
+            return 1.0 - self._unit[idx] @ self._unit[i]
+        return np.linalg.norm(self.points[idx] - self.points[i], axis=1)
+
+    def _dist_to_query(self, q: np.ndarray, idx: int) -> float:
+        if self.distance_function == "cosine":
+            qn = q / max(np.linalg.norm(q), 1e-12)
+            return float(1.0 - self._unit[idx] @ qn)
+        return float(np.linalg.norm(self.points[idx] - q))
+
+    def _build(self, idx: np.ndarray, rng) -> Optional[_VPNode]:
+        if len(idx) == 0:
+            return None
+        vp_pos = rng.randint(len(idx))
+        vp = int(idx[vp_pos])
+        rest = np.delete(idx, vp_pos)
+        if len(rest) == 0:
+            return _VPNode(vp, 0.0)
+        d = self._dist(vp, rest)
+        mu = float(np.median(d))
+        node = _VPNode(vp, mu)
+        node.inside = self._build(rest[d < mu], rng)
+        node.outside = self._build(rest[d >= mu], rng)
+        return node
+
+    def knn(self, query: np.ndarray, k: int) -> List[Tuple[float, int]]:
+        """k nearest (distance, index) pairs, ascending."""
+        query = np.asarray(query, np.float64)
+        best: List[Tuple[float, int]] = []
+
+        def visit(node):
+            if node is None:
+                return
+            d = self._dist_to_query(query, node.idx)
+            if len(best) < k or d < best[-1][0]:
+                best.append((d, node.idx))
+                best.sort(key=lambda t: t[0])
+                del best[k:]
+            tau = best[-1][0] if len(best) == k else np.inf
+            if d < node.mu:
+                visit(node.inside)
+                if d + tau >= node.mu:
+                    visit(node.outside)
+            else:
+                visit(node.outside)
+                if d - tau <= node.mu:
+                    visit(node.inside)
+
+        visit(self._root)
+        return best

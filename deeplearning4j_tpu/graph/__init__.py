@@ -1,0 +1,5 @@
+from deeplearning4j_tpu.graph.api import Edge, Graph, NoEdgeHandling, Vertex
+from deeplearning4j_tpu.graph.deepwalk import DeepWalk, GraphVectors
+
+__all__ = ["Edge", "Graph", "NoEdgeHandling", "Vertex", "DeepWalk",
+           "GraphVectors"]

@@ -1,0 +1,169 @@
+"""GloVe — Global Vectors for word representation.
+
+Equivalent of the reference's `models/glove/Glove.java:41` (standalone GloVe
+on the SequenceVectors chassis) with `models/glove/AbstractCoOccurrences.java`
+(windowed cooccurrence counting with 1/distance weighting, symmetric option)
+and `models/embeddings/learning/impl/elements/GloVe.java` (AdaGrad regression
+on log-cooccurrence, xMax=100, alpha=0.75). The reference spills cooccurrence
+shards to disk and trains pair-at-a-time under Hogwild threads
+(`models/glove/count/`); here counting is one host-side hash pass producing a
+COO triple array, and training is shuffled fixed-size batches through the
+jitted `ops/glove.glove_step` kernel — same objective, deterministic,
+device-resident.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.tokenization import TokenizerFactory
+from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabConstructor
+from deeplearning4j_tpu.nlp.word2vec import WordVectors
+from deeplearning4j_tpu.ops.glove import glove_step
+
+
+class CoOccurrences:
+    """Windowed cooccurrence counter (reference:
+    `AbstractCoOccurrences.java:321-372` — weight 1/distance within the
+    window; `symmetric` also credits the mirrored pair)."""
+
+    def __init__(self, window_size: int = 5, symmetric: bool = True):
+        self.window_size = window_size
+        self.symmetric = symmetric
+
+    def count(self, sequences: Iterable[np.ndarray], num_words: int
+              ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Returns DIRECTED COO arrays (rows, cols, weights): each in-window
+        pair is credited (x, j); `symmetric` also credits the mirrored
+        (j, x) entry, exactly the reference's storage
+        (`AbstractCoOccurrences.java:364-372`)."""
+        counts: Dict[Tuple[int, int], float] = {}
+        for seq in sequences:
+            n = len(seq)
+            for x in range(n):
+                wx = int(seq[x])
+                stop = min(x + self.window_size + 1, n)
+                for j in range(x + 1, stop):
+                    wj = int(seq[j])
+                    w = 1.0 / (j - x)
+                    counts[(wx, wj)] = counts.get((wx, wj), 0.0) + w
+                    if self.symmetric:
+                        counts[(wj, wx)] = counts.get((wj, wx), 0.0) + w
+        if not counts:
+            return (np.zeros(0, np.int32), np.zeros(0, np.int32),
+                    np.zeros(0, np.float32))
+        rows = np.fromiter((k[0] for k in counts), np.int32, len(counts))
+        cols = np.fromiter((k[1] for k in counts), np.int32, len(counts))
+        vals = np.fromiter(counts.values(), np.float32, len(counts))
+        return rows, cols, vals
+
+
+class Glove(WordVectors):
+    """GloVe trainer (builder-parameter parity with `Glove.Builder`:
+    min_word_frequency, layer_size/vector length, window_size, epochs
+    (`iterations()` aliases epochs in the reference builder), xMax, alpha,
+    learning_rate, shuffle, symmetric, seed, batch_size)."""
+
+    def __init__(
+        self,
+        sentences: Optional[Iterable] = None,
+        *,
+        min_word_frequency: int = 1,
+        layer_size: int = 100,
+        window_size: int = 5,
+        epochs: int = 5,
+        seed: int = 12345,
+        learning_rate: float = 0.05,
+        x_max: float = 100.0,
+        alpha: float = 0.75,
+        shuffle: bool = True,
+        symmetric: bool = True,
+        batch_size: int = 4096,
+        tokenizer_factory: Optional[TokenizerFactory] = None,
+    ):
+        self.min_word_frequency = min_word_frequency
+        self.layer_size = layer_size
+        self.window_size = window_size
+        self.epochs = epochs
+        self.seed = seed
+        self.learning_rate = learning_rate
+        self.x_max = x_max
+        self.alpha = alpha
+        self.shuffle = shuffle
+        self.symmetric = symmetric
+        self.batch_size = batch_size
+        self.tokenizer_factory = tokenizer_factory or TokenizerFactory()
+        self._sentences = sentences
+        self.vocab: Optional[VocabCache] = None
+        self.syn0 = None
+        self.bias = None
+        self.error_per_epoch: List[float] = []
+
+    def _tokenize_corpus(self) -> List[List[str]]:
+        corpus = []
+        for s in self._sentences:
+            if isinstance(s, str):
+                corpus.append(self.tokenizer_factory.create(s).get_tokens())
+            else:
+                corpus.append(list(s))
+        return corpus
+
+    def fit(self) -> "Glove":
+        corpus = self._tokenize_corpus()
+        self.vocab = VocabConstructor(self.min_word_frequency).build(corpus)
+        V, D = self.vocab.num_words(), self.layer_size
+        rng = np.random.RandomState(self.seed)
+
+        seqs = [
+            np.asarray([self.vocab.index_of(t) for t in seq
+                        if self.vocab.contains_word(t)], np.int32)
+            for seq in corpus
+        ]
+        rows, cols, vals = CoOccurrences(
+            self.window_size, self.symmetric).count(seqs, V)
+        if len(rows) == 0:
+            raise ValueError("empty cooccurrence matrix — corpus too small")
+
+        # Reference init (GloveWeightLookupTable.resetWeights): syn0 uniform
+        # scaled by layer size, bias zero; AdaGrad history zero.
+        syn0 = jnp.asarray(((rng.rand(V, D) - 0.5) / D).astype(np.float32))
+        bias = jnp.zeros((V,), jnp.float32)
+        hist_w = jnp.zeros((V, D), jnp.float32)
+        hist_b = jnp.zeros((V,), jnp.float32)
+
+        B = min(self.batch_size, max(len(rows), 1))
+        n_pairs = len(rows)
+        lr = jnp.float32(self.learning_rate)
+        x_max = jnp.float32(self.x_max)
+        alpha = jnp.float32(self.alpha)
+
+        for _ in range(self.epochs):
+            order = rng.permutation(n_pairs) if self.shuffle else np.arange(n_pairs)
+            # Losses stay device-side until epoch end so batch dispatches
+            # pipeline instead of syncing per batch.
+            batch_losses = []
+            for start in range(0, n_pairs, B):
+                take = order[start:start + B]
+                fill = len(take)
+                br = np.zeros(B, np.int32)
+                bc = np.zeros(B, np.int32)
+                bv = np.ones(B, np.float32)
+                pm = np.zeros(B, np.float32)
+                br[:fill] = rows[take]
+                bc[:fill] = cols[take]
+                bv[:fill] = vals[take]
+                pm[:fill] = 1.0
+                syn0, bias, hist_w, hist_b, loss = glove_step(
+                    syn0, bias, hist_w, hist_b,
+                    jnp.asarray(br), jnp.asarray(bc), jnp.asarray(bv),
+                    jnp.asarray(pm), lr, x_max, alpha)
+                batch_losses.append(loss)
+            epoch_err = float(jnp.sum(jnp.stack(batch_losses)))
+            self.error_per_epoch.append(epoch_err / max(n_pairs, 1))
+
+        self.bias = np.asarray(bias)
+        WordVectors.__init__(self, self.vocab, np.asarray(syn0))
+        return self

@@ -8,12 +8,12 @@ drive hierarchical softmax.
 
 from __future__ import annotations
 
-import heapq
-import itertools
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional
 
 import numpy as np
+
+from deeplearning4j_tpu.util.huffman import huffman_codes
 
 
 @dataclass
@@ -71,38 +71,19 @@ class VocabCache:
 
 
 def build_huffman(cache: VocabCache, max_code_length: int = 40) -> int:
-    """Assign Huffman codes/points (reference: `Huffman.java`). Returns the
-    number of inner nodes (= syn1 rows needed)."""
+    """Assign Huffman codes/points (reference: `Huffman.java`, MAX_CODE_LENGTH
+    40). Returns the number of inner nodes (= syn1 rows needed). The tree
+    itself comes from the shared `util/huffman.py` core (also used
+    degree-keyed by DeepWalk's GraphHuffman equivalent)."""
     n = cache.num_words()
     if n == 0:
         return 0
-    counter = itertools.count()
-    heap = [(w.frequency, next(counter), w.index, None, None) for w in cache._by_index]
-    heapq.heapify(heap)
-    parent: Dict[int, tuple] = {}
-    next_inner = n
-    while len(heap) > 1:
-        f1, _, n1, _, _ = heapq.heappop(heap)
-        f2, _, n2, _, _ = heapq.heappop(heap)
-        inner = next_inner
-        next_inner += 1
-        parent[n1] = (inner, 0)
-        parent[n2] = (inner, 1)
-        heapq.heappush(heap, (f1 + f2, next(counter), inner, n1, n2))
-    root = heap[0][2]
-    for w in cache._by_index:
-        codes, points = [], []
-        node = w.index
-        while node != root:
-            p, bit = parent[node]
-            codes.append(bit)
-            points.append(p - n)  # inner-node index into syn1
-            node = p
-        codes.reverse()
-        points.reverse()
-        w.codes = codes[:max_code_length]
-        w.points = points[:max_code_length]
-    return max(next_inner - n, 1)
+    freqs = [w.frequency for w in cache._by_index]
+    codes, points, n_inner = huffman_codes(freqs, max_code_length)
+    for w, c, p in zip(cache._by_index, codes, points):
+        w.codes = c
+        w.points = p
+    return n_inner
 
 
 class VocabConstructor:

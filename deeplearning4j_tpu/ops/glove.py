@@ -1,0 +1,65 @@
+"""Batched GloVe AdaGrad update kernel.
+
+TPU-native equivalent of the reference's per-pair GloVe learning step
+(reference: `models/embeddings/learning/impl/elements/GloVe.java:180-220`
+`iterateSample` — prediction = w_i.w_j + b_i + b_j - log X_ij, weighted by
+f(X) = (X/xMax)^alpha capped at 1, per-element AdaGrad). The reference
+iterates cooccurrence pairs one at a time under Hogwild threads; here a
+BATCH of (row, col, count) triples becomes one jitted program —
+gather -> weighted-residual -> segment-sum scatter-add -> AdaGrad — with
+donated tables, exactly the redesign SURVEY.md §7 hard-part (c) prescribes
+for Hogwild embedding updates.
+
+Duplicate indices inside a batch are aggregated before the AdaGrad state
+update (the standard sparse-AdaGrad formulation): H += (sum g)^2, then
+w -= lr * (sum g) / sqrt(H + eps).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+ADAGRAD_EPS = 1e-6
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+def glove_step(syn0, bias, hist_w, hist_b, rows, cols, counts, mask,
+               lr, x_max, alpha):
+    """One batched GloVe update.
+
+    syn0: [V, D] vectors (shared between the two roles — the reference's
+    single-table formulation, `GloVe.java:216` updates syn0 for both
+    elements); bias: [V]; hist_w/hist_b: AdaGrad accumulators shaped like
+    syn0/bias. rows/cols: [B] pair indices; counts: [B] cooccurrence
+    weights; mask: [B] marks real (non-padding) pairs.
+
+    Returns (syn0, bias, hist_w, hist_b, batch_loss) where batch_loss is
+    the summed weighted squared error 0.5 * f(X) * pred^2 over real pairs
+    (reference tracks the same per-sample error via `errorCounter`).
+    """
+    V, D = syn0.shape
+    wi = syn0[rows]                                    # [B, D]
+    wj = syn0[cols]
+    pred = (jnp.sum(wi * wj, axis=-1) + bias[rows] + bias[cols]
+            - jnp.log(jnp.maximum(counts, 1e-12)))     # [B]
+    f = jnp.where(counts > x_max, 1.0, (counts / x_max) ** alpha)
+    fdiff = f * pred * mask                            # [B] gradient factor
+
+    # d pred/d wi = wj (and vice versa); biases get fdiff directly.
+    g_vec = jnp.concatenate([fdiff[:, None] * wj, fdiff[:, None] * wi])  # [2B, D]
+    g_b = jnp.concatenate([fdiff, fdiff])              # [2B]
+    idx = jnp.concatenate([rows, cols])                # [2B]
+
+    agg = jax.ops.segment_sum(g_vec, idx, num_segments=V)   # [V, D]
+    agg_b = jax.ops.segment_sum(g_b, idx, num_segments=V)   # [V]
+
+    hist_w = hist_w + agg * agg
+    hist_b = hist_b + agg_b * agg_b
+    syn0 = syn0 - lr * agg / jnp.sqrt(hist_w + ADAGRAD_EPS)
+    bias = bias - lr * agg_b / jnp.sqrt(hist_b + ADAGRAD_EPS)
+
+    loss = 0.5 * jnp.sum(f * pred * pred * mask)
+    return syn0, bias, hist_w, hist_b, loss

@@ -1,0 +1,116 @@
+"""Clustering + t-SNE tests.
+
+Reference analogs: `deeplearning4j-core/src/test/.../clustering/`
+(`KMeansTest.java`, `KDTreeTest.java`, `VPTreeTest.java`) and
+`plot/Test...Tsne`-style checks (embedding separates well-separated input
+clusters, KL divergence decreases).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import KDTree, KMeansClustering, VPTree
+from deeplearning4j_tpu.clustering.kdtree import knn_brute
+from deeplearning4j_tpu.plot import BarnesHutTsne, Tsne
+
+
+def _blobs(rng, centers, n=40, noise=0.5):
+    X = np.concatenate([c + rng.randn(n, len(c)) * noise for c in centers])
+    labels = np.repeat(np.arange(len(centers)), n)
+    return X.astype(np.float32), labels
+
+
+class TestKMeans:
+    def test_recovers_blobs(self, rng):
+        centers = np.array([[0., 0.], [10., 0.], [0., 10.]])
+        X, labels = _blobs(np.random.RandomState(0), centers)
+        cs = KMeansClustering.setup(3, max_iterations=50).apply_to(X)
+        # Perfect purity on well-separated blobs.
+        for b in range(3):
+            a = cs.assignments[labels == b]
+            assert (a == a[0]).all()
+        # Each found center is near a true center.
+        for c in cs.centers:
+            assert np.min(np.linalg.norm(centers - c, axis=1)) < 1.0
+
+    def test_cosine_distance(self, rng):
+        r = np.random.RandomState(0)
+        # Two directions, different magnitudes.
+        X = np.concatenate([
+            np.outer(r.rand(30) * 5 + 1, [1.0, 0.0]) + r.randn(30, 2) * 0.05,
+            np.outer(r.rand(30) * 5 + 1, [0.0, 1.0]) + r.randn(30, 2) * 0.05,
+        ]).astype(np.float32)
+        cs = KMeansClustering.setup(2, 30, distance_function="cosine").apply_to(X)
+        assert (cs.assignments[:30] == cs.assignments[0]).all()
+        assert (cs.assignments[30:] == cs.assignments[30]).all()
+        assert cs.assignments[0] != cs.assignments[30]
+
+    def test_k_larger_than_points_raises(self):
+        with pytest.raises(ValueError):
+            KMeansClustering.setup(5).apply_to(np.zeros((3, 2), np.float32))
+
+
+class TestTrees:
+    def test_kdtree_matches_brute_force(self, rng):
+        r = np.random.RandomState(0)
+        P = r.randn(300, 4)
+        tree = KDTree(P)
+        for _ in range(10):
+            q = r.randn(4)
+            got = [i for _, i in tree.knn_indices(q, 7)]
+            _, want = knn_brute(P, q[None], 7)
+            assert got == list(want[0])
+
+    def test_kdtree_incremental_insert(self):
+        tree = KDTree(dims=2)
+        pts = [[0, 0], [5, 5], [1, 1], [9, 9]]
+        for p in pts:
+            tree.insert(np.asarray(p, float))
+        assert tree.size() == 4
+        d, p = tree.nn(np.array([1.2, 1.2]))
+        np.testing.assert_array_equal(p, [1, 1])
+
+    def test_vptree_matches_brute_force(self, rng):
+        r = np.random.RandomState(1)
+        P = r.randn(300, 4)
+        tree = VPTree(P)
+        for _ in range(10):
+            q = r.randn(4)
+            got = [i for _, i in tree.knn(q, 5)]
+            _, want = knn_brute(P, q[None], 5)
+            assert got == list(want[0])
+
+    def test_vptree_cosine(self):
+        P = np.array([[1, 0], [2, 0.01], [0, 1], [0.01, 3]], float)
+        tree = VPTree(P, distance_function="cosine")
+        got = [i for _, i in tree.knn(np.array([1.0, 0.001]), 2)]
+        assert set(got) == {0, 1}
+
+
+class TestTsne:
+    def test_separates_blobs_and_kl_decreases(self, rng):
+        centers = np.zeros((3, 10))
+        centers[0, 0] = 8
+        centers[1, 1] = 8
+        X, labels = _blobs(np.random.RandomState(0), centers, n=30, noise=0.3)
+        ts = Tsne(max_iter=300, perplexity=10, seed=1)
+        Y = ts.fit_transform(X)
+        assert Y.shape == (90, 2)
+        assert ts.kl_divergences[-1] < ts.kl_divergences[0] * 0.25
+        cent = np.stack([Y[labels == b].mean(0) for b in range(3)])
+        intra = np.mean([np.linalg.norm(Y[labels == b] - cent[b], axis=1).mean()
+                         for b in range(3)])
+        inter = np.mean([np.linalg.norm(cent[i] - cent[j])
+                         for i in range(3) for j in range(i + 1, 3)])
+        assert inter > 1.5 * intra, (inter, intra)
+
+    def test_barnes_hut_alias(self, rng):
+        X, _ = _blobs(np.random.RandomState(0), np.eye(3) * 5, n=10)
+        bh = BarnesHutTsne(theta=0.5, max_iter=50, perplexity=5, seed=1)
+        Y = bh.fit(X).Y
+        assert Y.shape == (30, 2)
+        assert bh.theta == 0.5
+
+    def test_too_few_points_raises(self):
+        with pytest.raises(ValueError):
+            Tsne().fit_transform(np.zeros((2, 3)))

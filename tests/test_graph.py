@@ -1,0 +1,128 @@
+"""Graph API + DeepWalk tests.
+
+Reference analogs: `deeplearning4j-graph/src/test/` — `TestGraph.java`
+(adjacency/degree/edge handling), `TestGraphLoading.java` (edge-list files),
+`DeepWalkGradientCheck.java` / `TestDeepWalk.java` (fit on a small graph,
+similarity sanity, save/load round-trip).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.graph import DeepWalk, Graph, GraphVectors, NoEdgeHandling
+from deeplearning4j_tpu.graph.api import NoEdgesException
+from deeplearning4j_tpu.graph.data import load_undirected_graph, load_weighted_graph
+from deeplearning4j_tpu.graph.deepwalk import huffman_codes
+from deeplearning4j_tpu.graph.iterators import RandomWalkIterator, random_walks
+
+
+def _two_communities(rng, size=10, p=0.6):
+    g = Graph(2 * size)
+    for base in (0, size):
+        for i in range(base, base + size):
+            for j in range(i + 1, base + size):
+                if rng.rand() < p:
+                    g.add_edge(i, j)
+    g.add_edge(size - 1, size)  # bridge
+    return g
+
+
+class TestGraphApi:
+    def test_undirected_edge_degree(self):
+        g = Graph(4)
+        g.add_edge(0, 1)
+        g.add_edge(1, 2, directed=True)
+        assert g.get_vertex_degree(0) == 1
+        assert g.get_vertex_degree(1) == 2  # undirected back-edge + out-edge
+        assert g.get_vertex_degree(2) == 0  # directed edge adds no out-edge
+        assert list(g.get_connected_vertex_indices(1)) == [0, 2]
+        assert g.num_edges() == 2
+
+    def test_loaders(self, tmp_path):
+        p = tmp_path / "edges.csv"
+        p.write_text("# comment\n0,1\n1,2\n")
+        g = load_undirected_graph(str(p), 3)
+        assert g.num_edges() == 2
+        pw = tmp_path / "weighted.csv"
+        pw.write_text("0,1,2.5\n")
+        gw = load_weighted_graph(str(pw), 2)
+        _, cumw, _ = gw.neighbor_table()
+        assert cumw[0, 0] == pytest.approx(2.5)
+
+
+class TestRandomWalks:
+    def test_shapes_and_connectivity(self, rng):
+        g = _two_communities(np.random.RandomState(0))
+        walks = random_walks(g, 8, rng=np.random.RandomState(1))
+        assert walks.shape == (20, 9)
+        assert (walks[:, 0] == np.arange(20)).all()
+        # Every consecutive pair is an actual edge.
+        for row in walks:
+            for a, b in zip(row[:-1], row[1:]):
+                assert b in g.get_connected_vertex_indices(int(a))
+
+    def test_self_loop_on_disconnected(self):
+        g = Graph(3)
+        g.add_edge(0, 1)
+        walks = random_walks(g, 5, rng=np.random.RandomState(0))
+        assert (walks[2] == 2).all()  # isolated vertex 2 stays put
+
+    def test_exception_on_disconnected(self):
+        g = Graph(3)
+        g.add_edge(0, 1)
+        with pytest.raises(NoEdgesException):
+            random_walks(g, 5,
+                         no_edge_handling=NoEdgeHandling.EXCEPTION_ON_DISCONNECTED)
+
+    def test_iterator_facade(self):
+        g = Graph(4)
+        for i in range(3):
+            g.add_edge(i, i + 1)
+        it = RandomWalkIterator(g, 6, seed=7)
+        walks = list(it)
+        assert len(walks) == 4
+        assert it.walk_length() == 6
+        it.reset()
+        assert it.has_next()
+        np.testing.assert_array_equal(it.next(), walks[0])  # deterministic
+
+
+class TestHuffman:
+    def test_codes_prefix_free_and_degree_ordered(self):
+        codes, points, n_inner = huffman_codes(np.array([10, 1, 1, 1, 5]))
+        assert len(codes) == 5 and n_inner == 4
+        assert len(codes[0]) == min(len(c) for c in codes)
+        # Prefix-free: no code is a prefix of another.
+        tuples = [tuple(c) for c in codes]
+        for i, a in enumerate(tuples):
+            for j, b in enumerate(tuples):
+                if i != j:
+                    assert a != b[: len(a)]
+
+
+class TestDeepWalk:
+    def test_community_separation(self, rng):
+        g = _two_communities(np.random.RandomState(0))
+        dw = DeepWalk(vector_size=16, window_size=3, learning_rate=0.05,
+                      epochs=30, seed=3, batch_size=512)
+        dw.fit(g, walk_length=20)
+        within = np.mean([dw.similarity(i, j)
+                          for i in range(10) for j in range(i + 1, 10)])
+        across = np.mean([dw.similarity(i, j)
+                          for i in range(10) for j in range(10, 20)])
+        assert within > across + 0.3, (within, across)
+        assert all(n < 10 for n in dw.vertices_nearest(0, 3))
+
+    def test_save_load_roundtrip(self, rng, tmp_path):
+        g = _two_communities(np.random.RandomState(1), size=5)
+        dw = DeepWalk(vector_size=8, epochs=2, seed=1).fit(g, walk_length=8)
+        path = str(tmp_path / "vecs.txt")
+        dw.save(path)
+        gv = GraphVectors.load(path)
+        np.testing.assert_allclose(gv.syn0, dw.syn0.astype(np.float32),
+                                   atol=1e-6)
+        assert gv.num_vertices() == 10
+
+    def test_initialize_from_degrees(self):
+        dw = DeepWalk(vector_size=4).initialize(np.array([3, 2, 1, 1]))
+        assert dw._syn0.shape == (4, 4)

@@ -1,0 +1,90 @@
+"""NLP embedding tests (Word2Vec / GloVe / ParagraphVectors).
+
+Reference analogs: `deeplearning4j-nlp` test suite — `Word2VecTests.java`,
+`models/glove/GloveTest.java` (fit on a small corpus, check similarity /
+nearest words), vocab + Huffman construction tests. Small synthetic
+two-topic corpora keep runtime test-suite friendly.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp.glove import CoOccurrences, Glove
+from deeplearning4j_tpu.nlp.vocab import VocabConstructor, build_huffman
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+ANIMALS = ["cat", "dog", "bird", "fish", "horse"]
+VEHICLES = ["car", "truck", "bus", "train", "plane"]
+
+
+def _cluster_corpus(rng, n=300, length=6):
+    sents = []
+    for _ in range(n):
+        group = ANIMALS if rng.rand() < 0.5 else VEHICLES
+        sents.append(" ".join(rng.choice(group, length)))
+    return sents
+
+
+class TestVocab:
+    def test_min_frequency_and_order(self):
+        cache = VocabConstructor(min_word_frequency=2).build(
+            [["a", "a", "a", "b", "b", "c"]])
+        assert cache.words() == ["a", "b"]  # c dropped, sorted by frequency
+        assert cache.index_of("a") == 0
+
+    def test_huffman_prefix_free(self):
+        cache = VocabConstructor().build(
+            [["w%d" % i] * (i + 1) for i in range(8)])
+        build_huffman(cache)
+        codes = {tuple(w.codes) for w in cache._by_index}
+        assert len(codes) == 8  # all distinct
+        # Most frequent word gets the shortest code.
+        lengths = [len(w.codes) for w in cache._by_index]
+        assert lengths[0] == min(lengths)
+
+
+class TestCoOccurrences:
+    def test_distance_weighting(self):
+        rows, cols, vals = CoOccurrences(window_size=2).count(
+            [np.array([0, 1, 2], np.int32)], 3)
+        got = {(int(r), int(c)): float(v) for r, c, v in zip(rows, cols, vals)}
+        # (0,1) and (1,2) adjacent -> 1.0; (0,2) at distance 2 -> 0.5
+        assert got[(0, 1)] == pytest.approx(1.0)
+        assert got[(1, 2)] == pytest.approx(1.0)
+        assert got[(0, 2)] == pytest.approx(0.5)
+
+    def test_window_cutoff(self):
+        rows, cols, vals = CoOccurrences(window_size=1).count(
+            [np.array([0, 1, 2], np.int32)], 3)
+        got = {(int(r), int(c)) for r, c in zip(rows, cols)}
+        assert (0, 2) not in got
+
+
+class TestGlove:
+    def test_clusters_and_error_decreases(self, rng):
+        sents = _cluster_corpus(rng)
+        g = Glove(sents, layer_size=24, epochs=20, window_size=5, seed=1,
+                  batch_size=64).fit()
+        assert g.error_per_epoch[-1] < g.error_per_epoch[0] * 0.1
+        assert g.similarity("cat", "dog") > 0.5
+        assert g.similarity("cat", "car") < 0.5
+        assert set(g.words_nearest("cat", 4)) == set(ANIMALS) - {"cat"}
+
+    def test_empty_corpus_raises(self):
+        with pytest.raises(ValueError):
+            Glove([""], epochs=1).fit()
+
+
+class TestWord2Vec:
+    @pytest.mark.parametrize("kwargs", [
+        dict(negative=0, cbow=False),   # skip-gram hierarchical softmax
+        dict(negative=5, cbow=False),   # skip-gram negative sampling
+        dict(negative=0, cbow=True),    # CBOW hierarchical softmax
+    ])
+    def test_clusters(self, rng, kwargs):
+        sents = _cluster_corpus(rng, n=250)
+        w = Word2Vec(sents, layer_size=24, epochs=3, window_size=4, seed=1,
+                     learning_rate=0.05, batch_size=256, **kwargs).fit()
+        within = w.similarity("cat", "dog")
+        across = w.similarity("cat", "car")
+        assert within > across, (kwargs, within, across)
