@@ -33,13 +33,10 @@ class KDTree:
     def __init__(self, points: Optional[np.ndarray] = None, dims: Optional[int] = None):
         if points is not None:
             points = np.asarray(points, np.float64)
-            self._points: List[np.ndarray] = []
             self.dims = points.shape[1]
-            self._root = None
-            for p in points:   # balanced bulk build
-                self._points.append(p)
-            idx = np.arange(len(points))
-            self._root = self._build(points, idx, 0)
+            self._points: List[np.ndarray] = list(points)
+            # Balanced bulk build via recursive median split.
+            self._root = self._build(points, np.arange(len(points)), 0)
         else:
             if dims is None:
                 raise ValueError("provide points or dims")
@@ -99,10 +96,17 @@ class KDTree:
     def knn_indices(self, query: np.ndarray, k: int) -> List[Tuple[float, int]]:
         query = np.asarray(query, np.float64)
         best: List[Tuple[float, int]] = []  # kept sorted, max size k
-
-        def visit(node):
+        # Explicit stack instead of recursion: an insert-built tree can be a
+        # depth-N spine (no rebalancing), which would blow the recursion
+        # limit. Entries are (node, plane_distance); the plane check is
+        # re-evaluated at pop time against the now-tighter k-th best.
+        stack = [(self._root, 0.0)]
+        while stack:
+            node, plane = stack.pop()
             if node is None:
-                return
+                continue
+            if len(best) == k and plane >= best[-1][0]:
+                continue  # pruned: splitting plane farther than k-th best
             p = self._points[node.point_idx]
             d = float(np.linalg.norm(query - p))
             if len(best) < k or d < best[-1][0]:
@@ -111,13 +115,9 @@ class KDTree:
                 del best[k:]
             diff = query[node.axis] - p[node.axis]
             near, far = (node.left, node.right) if diff < 0 else (node.right, node.left)
-            visit(near)
-            # Prune: only cross the splitting plane if it can contain a
-            # closer point than the current k-th best.
-            if len(best) < k or abs(diff) < best[-1][0]:
-                visit(far)
-
-        visit(self._root)
+            # Push far first so the near side is explored first (LIFO).
+            stack.append((far, abs(diff)))
+            stack.append((near, 0.0))
         return best
 
 

@@ -104,14 +104,17 @@ class KMeansClustering:
         # strictly improves on that and stays deterministic).
         for _ in range(max(self.n_init, 1)):
             centers = [points[rng.randint(N)]]
+            # Running elementwise minimum: one distance pass per new center
+            # (O(K*N)) instead of re-scanning every chosen center (O(K^2*N)).
+            d2 = np.sum((points - centers[0]) ** 2, axis=1)
             for _ in range(1, self.k):
-                d2 = np.min(
-                    [np.sum((points - c) ** 2, axis=1) for c in centers], axis=0)
                 total = d2.sum()
                 if total > 0:
-                    centers.append(points[rng.choice(N, p=d2 / total)])
+                    c = points[rng.choice(N, p=d2 / total)]
                 else:  # all remaining points coincide with a chosen center
-                    centers.append(points[rng.randint(N)])
+                    c = points[rng.randint(N)]
+                centers.append(c)
+                d2 = np.minimum(d2, np.sum((points - c) ** 2, axis=1))
             c, a, d = _lloyd(pts, jnp.asarray(np.stack(centers)),
                              self.max_iterations, cosine)
             inertia = float(jnp.sum(d * d))

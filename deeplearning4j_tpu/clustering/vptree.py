@@ -60,8 +60,22 @@ class VPTree:
         d = self._dist(vp, rest)
         mu = float(np.median(d))
         node = _VPNode(vp, mu)
-        node.inside = self._build(rest[d < mu], rng)
-        node.outside = self._build(rest[d >= mu], rng)
+        inside = d < mu
+        if not inside.any():
+            # mu == min(d): ties at the median. Move the tied points inside
+            # (d <= mu keeps the pruning inequalities valid on both sides).
+            inside = d <= mu
+            if inside.all():
+                # ALL distances equal mu: every point sits exactly on the
+                # boundary, so any partition satisfies both pruning bounds —
+                # split by index to guarantee O(log N) depth on
+                # duplicate-heavy data instead of recursing once per point.
+                half = len(rest) // 2
+                node.inside = self._build(rest[:half], rng)
+                node.outside = self._build(rest[half:], rng)
+                return node
+        node.inside = self._build(rest[inside], rng)
+        node.outside = self._build(rest[~inside], rng)
         return node
 
     def knn(self, query: np.ndarray, k: int) -> List[Tuple[float, int]]:

@@ -19,7 +19,7 @@ from typing import Dict, Iterable, List, Optional, Tuple
 import jax.numpy as jnp
 import numpy as np
 
-from deeplearning4j_tpu.nlp.tokenization import TokenizerFactory
+from deeplearning4j_tpu.nlp.tokenization import TokenizerFactory, tokenize_corpus
 from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabConstructor
 from deeplearning4j_tpu.nlp.word2vec import WordVectors
 from deeplearning4j_tpu.ops.glove import glove_step
@@ -34,7 +34,7 @@ class CoOccurrences:
         self.window_size = window_size
         self.symmetric = symmetric
 
-    def count(self, sequences: Iterable[np.ndarray], num_words: int
+    def count(self, sequences: Iterable[np.ndarray]
               ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Returns DIRECTED COO arrays (rows, cols, weights): each in-window
         pair is credited (x, j); `symmetric` also credits the mirrored
@@ -102,17 +102,8 @@ class Glove(WordVectors):
         self.bias = None
         self.error_per_epoch: List[float] = []
 
-    def _tokenize_corpus(self) -> List[List[str]]:
-        corpus = []
-        for s in self._sentences:
-            if isinstance(s, str):
-                corpus.append(self.tokenizer_factory.create(s).get_tokens())
-            else:
-                corpus.append(list(s))
-        return corpus
-
     def fit(self) -> "Glove":
-        corpus = self._tokenize_corpus()
+        corpus = tokenize_corpus(self._sentences, self.tokenizer_factory)
         self.vocab = VocabConstructor(self.min_word_frequency).build(corpus)
         V, D = self.vocab.num_words(), self.layer_size
         rng = np.random.RandomState(self.seed)
@@ -123,7 +114,7 @@ class Glove(WordVectors):
             for seq in corpus
         ]
         rows, cols, vals = CoOccurrences(
-            self.window_size, self.symmetric).count(seqs, V)
+            self.window_size, self.symmetric).count(seqs)
         if len(rows) == 0:
             raise ValueError("empty cooccurrence matrix — corpus too small")
 

@@ -62,3 +62,16 @@ class TokenizerFactory:
 
 
 DefaultTokenizerFactory = TokenizerFactory
+
+
+def tokenize_corpus(sentences, tokenizer_factory: "TokenizerFactory") -> List[List[str]]:
+    """Tokenize a corpus of raw strings and/or pre-split token lists (the
+    shared sentence-ingest step of every embedding trainer — reference:
+    `SentenceTransformer` feeding `SequenceVectors`)."""
+    corpus = []
+    for s in sentences:
+        if isinstance(s, str):
+            corpus.append(tokenizer_factory.create(s).get_tokens())
+        else:
+            corpus.append(list(s))
+    return corpus

@@ -17,7 +17,7 @@ from typing import Iterable, List, Optional, Sequence
 import jax.numpy as jnp
 import numpy as np
 
-from deeplearning4j_tpu.nlp.tokenization import TokenizerFactory
+from deeplearning4j_tpu.nlp.tokenization import TokenizerFactory, tokenize_corpus
 from deeplearning4j_tpu.nlp.vocab import (
     VocabCache,
     VocabConstructor,
@@ -121,17 +121,8 @@ class Word2Vec(WordVectors):
 
     # ------------------------------------------------------------------ fit
 
-    def _tokenize_corpus(self) -> List[List[str]]:
-        corpus = []
-        for s in self._sentences:
-            if isinstance(s, str):
-                corpus.append(self.tokenizer_factory.create(s).get_tokens())
-            else:
-                corpus.append(list(s))
-        return corpus
-
     def fit(self) -> "Word2Vec":
-        corpus = self._tokenize_corpus()
+        corpus = tokenize_corpus(self._sentences, self.tokenizer_factory)
         self.vocab = VocabConstructor(self.min_word_frequency).build(corpus)
         n_inner = build_huffman(self.vocab)
         V, D = self.vocab.num_words(), self.layer_size
@@ -186,20 +177,9 @@ class Word2Vec(WordVectors):
                 return
             pm = np.zeros(B, np.float32)
             pm[:fill] = 1.0
-            if self.cbow:
-                if self.negative > 0:
-                    raise NotImplementedError(
-                        "CBOW with negative sampling is not implemented; use "
-                        "hierarchical softmax (negative=0) for CBOW"
-                    )
-                self.syn0, self.syn1 = kernels.hs_cbow_step(
-                    self.syn0, self.syn1, jnp.asarray(buf_ctx),
-                    jnp.asarray(buf_ctx_mask),
-                    jnp.asarray(codes_tbl[buf_word]),
-                    jnp.asarray(points_tbl[buf_word]),
-                    jnp.asarray(cmask_tbl[buf_word]), jnp.asarray(pm),
-                    jnp.float32(lr))
-            elif self.negative > 0:
+            if self.negative > 0:
+                # Shared negative-sampling batch: positive word first, then
+                # K unigram-table draws (both CBOW and skip-gram NS modes).
                 K = self.negative
                 targets = np.zeros((B, 1 + K), np.int32)
                 labels = np.zeros((B, 1 + K), np.float32)
@@ -207,9 +187,23 @@ class Word2Vec(WordVectors):
                 labels[:, 0] = 1.0
                 targets[:, 1:] = self._neg_table[
                     rng.randint(0, len(self._neg_table), (B, K))]
-                self.syn0, self.syn1neg = kernels.ns_skipgram_step(
-                    self.syn0, self.syn1neg, jnp.asarray(buf_center),
-                    jnp.asarray(targets), jnp.asarray(labels), jnp.asarray(pm),
+                if self.cbow:
+                    self.syn0, self.syn1neg = kernels.ns_cbow_step(
+                        self.syn0, self.syn1neg, jnp.asarray(buf_ctx),
+                        jnp.asarray(buf_ctx_mask), jnp.asarray(targets),
+                        jnp.asarray(labels), jnp.asarray(pm), jnp.float32(lr))
+                else:
+                    self.syn0, self.syn1neg = kernels.ns_skipgram_step(
+                        self.syn0, self.syn1neg, jnp.asarray(buf_center),
+                        jnp.asarray(targets), jnp.asarray(labels),
+                        jnp.asarray(pm), jnp.float32(lr))
+            elif self.cbow:
+                self.syn0, self.syn1 = kernels.hs_cbow_step(
+                    self.syn0, self.syn1, jnp.asarray(buf_ctx),
+                    jnp.asarray(buf_ctx_mask),
+                    jnp.asarray(codes_tbl[buf_word]),
+                    jnp.asarray(points_tbl[buf_word]),
+                    jnp.asarray(cmask_tbl[buf_word]), jnp.asarray(pm),
                     jnp.float32(lr))
             else:
                 self.syn0, self.syn1 = kernels.hs_skipgram_step(

@@ -81,6 +81,10 @@ class Layer:
     l1: Optional[float] = None
     l2: Optional[float] = None
     dropout: Optional[float] = None  # retain probability; 0/1/None disables
+    # DropConnect: when true, `dropout` is applied to the INPUT WEIGHTS
+    # instead of the input activations (reference: `conf.isUseDropConnect()`
+    # read in `BaseLayer.preOutput:371-373` / `LSTMHelpers.java:98-101`).
+    use_drop_connect: Optional[bool] = None
     bias_init: Optional[float] = None
     updater: Optional[Any] = None
     momentum: Optional[float] = None
@@ -590,7 +594,10 @@ class VariationalAutoencoder(FeedForwardLayer):
 
     encoder_layer_sizes: Tuple[int, ...] = (100,)
     decoder_layer_sizes: Tuple[int, ...] = (100,)
-    reconstruction_distribution: str = "gaussian"  # gaussian | bernoulli
+    # "gaussian" | "bernoulli" | "exponential", or a composite list of
+    # (name, data_size) pairs (reference: `conf/layers/variational/`
+    # ReconstructionDistribution SPI incl. Composite).
+    reconstruction_distribution: Any = "gaussian"
     pzx_activation: Any = Activation.IDENTITY
     num_samples: int = 1
 
@@ -610,9 +617,10 @@ class VariationalAutoencoder(FeedForwardLayer):
             shapes[f"dW{i}"] = (prev, size)
             shapes[f"db{i}"] = (size,)
             prev = size
-        dist_mult = 2 if self.reconstruction_distribution == "gaussian" else 1
-        shapes["pXZW"] = (prev, self.n_in * dist_mult)
-        shapes["pXZB"] = (self.n_in * dist_mult,)
+        from deeplearning4j_tpu.nn.layers.variational import dist_input_size
+        dist_size = dist_input_size(self.reconstruction_distribution, self.n_in)
+        shapes["pXZW"] = (prev, dist_size)
+        shapes["pXZB"] = (dist_size,)
         return shapes
 
     def is_pretrainable(self):

@@ -45,7 +45,7 @@ from deeplearning4j_tpu.nn.conf.preprocessors import (
 # (reference: NeuralNetConfiguration.Builder global defaults applied per layer).
 _INHERITED_FIELDS = (
     "activation", "weight_init", "dist", "learning_rate", "bias_learning_rate",
-    "l1", "l2", "dropout", "bias_init", "updater", "momentum",
+    "l1", "l2", "dropout", "use_drop_connect", "bias_init", "updater", "momentum",
     "adam_mean_decay", "adam_var_decay", "rho", "rms_decay", "epsilon",
     "gradient_normalization", "gradient_normalization_threshold",
 )

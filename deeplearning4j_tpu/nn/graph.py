@@ -29,6 +29,7 @@ from deeplearning4j_tpu.nn.conf.graph import (
     LastTimeStepVertex,
     LayerVertex,
 )
+from deeplearning4j_tpu.nn.conf.layers import is_bias_param
 from deeplearning4j_tpu.nn.conf.neural_net import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.layers import OUTPUT_LAYER_TYPES, get_impl
 from deeplearning4j_tpu.ops import grad_norm as grad_norm_mod
@@ -392,7 +393,10 @@ class ComputationGraph:
             bias_lr = float(layer.bias_learning_rate if layer.bias_learning_rate is not None else base_lr)
             if bias_lr != base_lr and base_lr != 0.0:
                 factor = bias_lr / base_lr
-                deltas = {k: (d * factor if k == "b" else d) for k, d in deltas.items()}
+                # Per param TYPE via is_bias_param (b_f/b_b, vb/eb/db, beta),
+                # matching reference `LayerUpdater.java:243`.
+                deltas = {k: (d * factor if is_bias_param(k) else d)
+                          for k, d in deltas.items()}
             new_params[name] = {k: params[name][k] - sign * deltas[k] for k in params[name]}
             new_opt[name] = st
             if collect_stats:
@@ -479,6 +483,10 @@ class ComputationGraph:
         )
         self._score = loss
         self.iteration += max(1, g.iterations)
+        # Stats snapshots are SGD-path only; clear stale ones (see
+        # `MultiLayerNetwork._fit_solver`). Listener cadence deviation vs
+        # `BaseOptimizer` is documented there too.
+        self.last_training_stats = {}
         for listener in self.listeners:
             listener.iteration_done(self, self.iteration)
 

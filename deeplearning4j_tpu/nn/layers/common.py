@@ -20,6 +20,29 @@ def inverted_dropout(x: jnp.ndarray, retain: Optional[float], rng, train: bool) 
     return jnp.where(keep, x / retain, 0.0)
 
 
+def layer_input_dropout(conf, x: jnp.ndarray, rng, train: bool) -> jnp.ndarray:
+    """Input-activation dropout, SKIPPED when the layer is in DropConnect
+    mode (reference: `BaseLayer.applyDropOutIfNecessary:487` requires
+    `!conf.isUseDropConnect()` — the two modes are mutually exclusive)."""
+    if getattr(conf, "use_drop_connect", False):
+        return x
+    return inverted_dropout(x, conf.dropout, rng, train)
+
+
+def maybe_drop_connect(conf, W: jnp.ndarray, rng, train: bool) -> jnp.ndarray:
+    """DropConnect on an input-weight matrix: when `use_drop_connect` is
+    set, the layer's dropout rate is applied to W (inverted scaling) at
+    train time (reference: `Dropout.applyDropConnect` called from
+    `BaseLayer.preOutput:371-373` and `LSTMHelpers.java:98-101` — input
+    weights only, never recurrent weights)."""
+    retain = conf.dropout
+    if (not train or rng is None or not getattr(conf, "use_drop_connect", False)
+            or retain is None or retain <= 0.0 or retain >= 1.0):
+        return W
+    keep = jax.random.bernoulli(rng, retain, W.shape)
+    return jnp.where(keep, W / retain, 0.0)
+
+
 def apply_mask(x: jnp.ndarray, mask: Optional[jnp.ndarray]) -> jnp.ndarray:
     """Zero masked timesteps. x: [b, t, f], mask: [b, t]."""
     if mask is None:

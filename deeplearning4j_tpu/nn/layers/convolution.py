@@ -14,7 +14,11 @@ import jax.numpy as jnp
 
 from deeplearning4j_tpu.nn import activations
 from deeplearning4j_tpu.nn.conf.enums import ConvolutionMode, PoolingType
-from deeplearning4j_tpu.nn.layers.common import inverted_dropout
+from deeplearning4j_tpu.nn.layers.common import (
+    inverted_dropout,
+    layer_input_dropout,
+    maybe_drop_connect,
+)
 
 _DIMS = ("NHWC", "HWIO", "NHWC")
 
@@ -28,10 +32,12 @@ def _conv_padding(conf, h, w):
 
 
 def conv2d_apply(conf, params, state, x, *, rng=None, train=False, mask=None):
-    x = inverted_dropout(x, conf.dropout, rng, train)
+    x = layer_input_dropout(conf, x, rng, train)
+    # Reference applies DropConnect to conv kernels too
+    # (`ConvolutionLayer.java:218-219`).
     out = jax.lax.conv_general_dilated(
         x,
-        params["W"].astype(x.dtype),
+        maybe_drop_connect(conf, params["W"], rng, train).astype(x.dtype),
         window_strides=conf.stride,
         padding=_conv_padding(conf, x.shape[1], x.shape[2]),
         rhs_dilation=conf.dilation,

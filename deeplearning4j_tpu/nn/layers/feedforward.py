@@ -14,12 +14,16 @@ import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.nn import activations
-from deeplearning4j_tpu.nn.layers.common import inverted_dropout
+from deeplearning4j_tpu.nn.layers.common import (
+    inverted_dropout,
+    layer_input_dropout,
+    maybe_drop_connect,
+)
 
 
 def dense_apply(conf, params, state, x, *, rng=None, train=False, mask=None):
-    x = inverted_dropout(x, conf.dropout, rng, train)
-    out = x @ params["W"]
+    x = layer_input_dropout(conf, x, rng, train)
+    out = x @ maybe_drop_connect(conf, params["W"], rng, train)
     if "b" in params:
         out = out + params["b"]
     out = activations.resolve(conf.activation)(out)
@@ -28,8 +32,8 @@ def dense_apply(conf, params, state, x, *, rng=None, train=False, mask=None):
 
 def preoutput(conf, params, state, x, *, rng=None, train=False, mask=None):
     """Linear pre-activation (used by output layers for stable fused losses)."""
-    x = inverted_dropout(x, conf.dropout, rng, train)
-    out = x @ params["W"]
+    x = layer_input_dropout(conf, x, rng, train)
+    out = x @ maybe_drop_connect(conf, params["W"], rng, train)
     if "b" in params:
         out = out + params["b"]
     return out, state, mask

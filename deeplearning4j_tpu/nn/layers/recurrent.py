@@ -26,7 +26,11 @@ import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.nn import activations
-from deeplearning4j_tpu.nn.layers.common import inverted_dropout
+from deeplearning4j_tpu.nn.layers.common import (
+    inverted_dropout,
+    layer_input_dropout,
+    maybe_drop_connect,
+)
 
 
 def _lstm_scan(conf, params, x, mask, h0, c0, peephole: bool, reverse: bool = False,
@@ -88,7 +92,9 @@ def lstm_apply(conf, params, state, x, *, rng=None, train=False, mask=None,
     """GravesLSTM / LSTM forward. `state` (if non-None dict with h/c) seeds the
     initial hidden state — used by `rnn_time_step` stateful inference
     (reference: `MultiLayerNetwork.rnnTimeStep:2230`)."""
-    x = inverted_dropout(x, conf.dropout, rng, train)
+    x = layer_input_dropout(conf, x, rng, train)
+    # DropConnect applies to the input weights only (LSTMHelpers.java:98-101).
+    params = {**params, "W": maybe_drop_connect(conf, params["W"], rng, train)}
     if state and "h" in state:
         h0, c0 = state["h"], state["c"]
     else:
@@ -106,7 +112,12 @@ def standard_lstm_apply(conf, params, state, x, **kw):
 
 
 def bidirectional_lstm_apply(conf, params, state, x, *, rng=None, train=False, mask=None):
-    x = inverted_dropout(x, conf.dropout, rng, train)
+    x = layer_input_dropout(conf, x, rng, train)
+    if rng is not None and getattr(conf, "use_drop_connect", False):
+        r_f, r_b = jax.random.split(rng)
+        params = {**params,
+                  "W_f": maybe_drop_connect(conf, params["W_f"], r_f, train),
+                  "W_b": maybe_drop_connect(conf, params["W_b"], r_b, train)}
     h0, c0 = _zeros_state(x, conf.n_out)
     fwd, _ = _lstm_scan(conf, params, x, mask, h0, c0, True, reverse=False, suffix="_f")
     bwd, _ = _lstm_scan(conf, params, x, mask, h0, c0, True, reverse=True, suffix="_b")
@@ -114,13 +125,13 @@ def bidirectional_lstm_apply(conf, params, state, x, *, rng=None, train=False, m
 
 
 def simple_rnn_apply(conf, params, state, x, *, rng=None, train=False, mask=None):
-    x = inverted_dropout(x, conf.dropout, rng, train)
+    x = layer_input_dropout(conf, x, rng, train)
     act = activations.resolve(conf.activation)
     if state and "h" in state:
         h0 = state["h"]
     else:
         h0 = jnp.zeros((x.shape[0], conf.n_out), x.dtype)
-    xw = x @ params["W"] + params["b"]
+    xw = x @ maybe_drop_connect(conf, params["W"], rng, train) + params["b"]
 
     def step(h_prev, inp):
         xw_t, m_t = inp

@@ -2,9 +2,15 @@
 
 Equivalent of the reference's `nn/layers/variational/VariationalAutoencoder.java:48-79`
 (1063 LoC): own encoder/decoder MLP stacks, pluggable reconstruction
-distribution (gaussian | bernoulli), reparameterization-trick sampling.
-Supervised forward = encoder mean (the reference's activate()); the ELBO
-pretrain loss is `vae_pretrain_loss`, driven by the layerwise pretrain loop.
+distribution SPI (reference `nn/conf/layers/variational/
+ReconstructionDistribution.java` with Gaussian / Bernoulli / Exponential /
+Composite impls), reparameterization-trick sampling. Supervised forward =
+encoder mean (the reference's activate()); the ELBO pretrain loss is
+`vae_pretrain_loss`, driven by the layerwise pretrain loop.
+
+A distribution spec is either a string ("gaussian" | "bernoulli" |
+"exponential") or, for the composite (`CompositeReconstructionDistribution`),
+a list of (name, data_size) pairs partitioning the feature axis.
 """
 
 from __future__ import annotations
@@ -13,6 +19,63 @@ import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.nn import activations
+
+
+# --------------------------------------------------------------------------
+# Reconstruction-distribution SPI
+
+
+def dist_input_size(dist, data_size: int) -> int:
+    """Decoder-output width for `data_size` features (reference:
+    `ReconstructionDistribution.distributionInputSize`)."""
+    if isinstance(dist, (list, tuple)) and not isinstance(dist, str):
+        if sum(size for _, size in dist) != data_size:
+            raise ValueError(
+                "composite reconstruction distribution sizes "
+                f"{[s for _, s in dist]} must sum to the data size {data_size}")
+        total = 0
+        for name, size in dist:
+            total += dist_input_size(name, size)
+        return total
+    if dist == "gaussian":
+        return 2 * data_size   # [mean, log var] per feature
+    if dist in ("bernoulli", "exponential"):
+        return data_size
+    raise ValueError(f"unknown reconstruction distribution {dist!r}")
+
+
+def neg_log_prob(dist, x, pre):
+    """Per-example negative log-probability [B] given decoder pre-output
+    (reference: `exampleNegLogProbability` of each distribution impl)."""
+    if isinstance(dist, (list, tuple)) and not isinstance(dist, str):
+        # Composite: slice x by data sizes and pre by distribution input
+        # sizes, in order (reference `CompositeReconstructionDistribution
+        # .java:143-160`).
+        total = 0.0
+        x_off = 0
+        p_off = 0
+        for name, size in dist:
+            p_size = dist_input_size(name, size)
+            total = total + neg_log_prob(
+                name, x[:, x_off:x_off + size], pre[:, p_off:p_off + p_size])
+            x_off += size
+            p_off += p_size
+        return total
+    if dist == "bernoulli":
+        p = jnp.clip(jax.nn.sigmoid(pre), 1e-7, 1 - 1e-7)
+        return -jnp.sum(x * jnp.log(p) + (1 - x) * jnp.log(1 - p), axis=-1)
+    if dist == "gaussian":
+        dmean, dlogv = jnp.split(pre, 2, axis=-1)
+        return 0.5 * jnp.sum(
+            dlogv + (x - dmean) ** 2 / jnp.exp(dlogv) + jnp.log(2 * jnp.pi),
+            axis=-1)
+    if dist == "exponential":
+        # gamma = pre (identity activation); lambda = exp(gamma);
+        # log p(x) = gamma - lambda * x (reference
+        # `ExponentialReconstructionDistribution.java:61-68`).
+        lam = jnp.exp(pre)
+        return -jnp.sum(pre - lam * x, axis=-1)
+    raise ValueError(f"unknown reconstruction distribution {dist!r}")
 
 
 def _mlp(x, params, prefix, n_layers, act):
@@ -50,19 +113,7 @@ def vae_pretrain_loss(conf, params, x, rng):
         eps = jax.random.normal(jax.random.fold_in(rng, s), mean.shape, mean.dtype)
         z = mean + jnp.exp(0.5 * log_var) * eps
         dec = vae_decode(conf, params, z)
-        if conf.reconstruction_distribution == "bernoulli":
-            p = jax.nn.sigmoid(dec)
-            recon = -jnp.sum(
-                x * jnp.log(jnp.clip(p, 1e-7, 1.0))
-                + (1 - x) * jnp.log(jnp.clip(1 - p, 1e-7, 1.0)),
-                axis=-1,
-            )
-        else:  # gaussian: decoder outputs [mean, log_var] per feature
-            dmean, dlogv = jnp.split(dec, 2, axis=-1)
-            recon = 0.5 * jnp.sum(
-                dlogv + (x - dmean) ** 2 / jnp.exp(dlogv) + jnp.log(2 * jnp.pi), axis=-1
-            )
-        total = total + recon
+        total = total + neg_log_prob(conf.reconstruction_distribution, x, dec)
     recon = total / conf.num_samples
     kl = -0.5 * jnp.sum(1 + log_var - mean ** 2 - jnp.exp(log_var), axis=-1)
     return jnp.mean(recon + kl)
@@ -78,13 +129,5 @@ def vae_reconstruction_prob(conf, params, x, rng, num_samples=None):
         eps = jax.random.normal(jax.random.fold_in(rng, s), mean.shape, mean.dtype)
         z = mean + jnp.exp(0.5 * log_var) * eps
         dec = vae_decode(conf, params, z)
-        if conf.reconstruction_distribution == "bernoulli":
-            p = jnp.clip(jax.nn.sigmoid(dec), 1e-7, 1 - 1e-7)
-            logp = jnp.sum(x * jnp.log(p) + (1 - x) * jnp.log(1 - p), axis=-1)
-        else:
-            dmean, dlogv = jnp.split(dec, 2, axis=-1)
-            logp = -0.5 * jnp.sum(
-                dlogv + (x - dmean) ** 2 / jnp.exp(dlogv) + jnp.log(2 * jnp.pi), axis=-1
-            )
-        logps.append(logp)
+        logps.append(-neg_log_prob(conf.reconstruction_distribution, x, dec))
     return jax.scipy.special.logsumexp(jnp.stack(logps), axis=0) - jnp.log(float(ns))

@@ -36,7 +36,7 @@ from deeplearning4j_tpu.nn.conf.enums import (
     LossFunction,
     OptimizationAlgorithm,
 )
-from deeplearning4j_tpu.nn.conf.layers import CenterLossOutputLayer
+from deeplearning4j_tpu.nn.conf.layers import CenterLossOutputLayer, is_bias_param
 from deeplearning4j_tpu.nn.conf.neural_net import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.layers import OUTPUT_LAYER_TYPES, get_impl
 from deeplearning4j_tpu.ops import grad_norm as grad_norm_mod
@@ -390,7 +390,12 @@ class MultiLayerNetwork:
             bias_lr = float(layer.bias_learning_rate if layer.bias_learning_rate is not None else base_lr)
             if bias_lr != base_lr and base_lr != 0.0:
                 factor = bias_lr / base_lr
-                deltas = {k: (d * factor if k in ("b",) else d) for k, d in deltas.items()}
+                # is_bias_param covers every bias name (b, b_f/b_b for
+                # bidirectional RNNs, vb/eb/db for RBM/VAE, beta for BN) —
+                # reference `LayerUpdater.java:243` applies biasLearningRate
+                # per param TYPE, not only to params literally named "b".
+                deltas = {k: (d * factor if is_bias_param(k) else d)
+                          for k, d in deltas.items()}
             new_params[lk] = {
                 k: params[lk][k] - sign * deltas[k] for k in params[lk]
             }
@@ -425,7 +430,10 @@ class MultiLayerNetwork:
         if not self._initialized:
             self.init()
         if labels is not None or isinstance(data, DataSet) or (
-                isinstance(data, tuple) and len(data) == 2):
+                isinstance(data, tuple) and len(data) == 2
+                and not isinstance(data[0], DataSet)):
+            # The DataSet guard keeps a 2-element tuple OF DataSets (a valid
+            # small iterator) from being misread as an (x, y) pair.
             iterator = [_as_dataset(data, labels)]
         else:
             iterator = data
@@ -488,6 +496,14 @@ class MultiLayerNetwork:
         )
         self._score = loss
         self.iteration += max(1, g.iterations)
+        # Per-layer grad/update stats are an SGD-path feature; clear any
+        # stale snapshot from a previous SGD run so a StatsListener attached
+        # on the solver path never reports stats from another optimizer.
+        self.last_training_stats = {}
+        # Deviation from the reference: `BaseOptimizer` fires listeners once
+        # per SOLVER ITERATION; the jitted whole-loop solver surfaces one
+        # callback per batch (iteration count still advances by
+        # g.iterations), trading listener granularity for an XLA-fused loop.
         for listener in self.listeners:
             listener.iteration_done(self, self.iteration)
 

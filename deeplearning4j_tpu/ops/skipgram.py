@@ -99,6 +99,45 @@ def ns_skipgram_step(syn0, syn1neg, centers, targets, labels, pair_mask, lr):
 
 
 @partial(jax.jit, donate_argnums=(0, 1))
+def ns_cbow_step(syn0, syn1neg, context, context_mask, targets, labels,
+                 pair_mask, lr):
+    """Negative-sampling CBOW update (reference: `AggregateCBOW` native op
+    invoked from `learning/impl/elements/CBOW.java:160` with negative > 0 —
+    word2vec.c semantics: h = mean of context vectors trained against the
+    positive word + K sampled negatives on syn1neg, with the accumulated
+    input gradient distributed to every context word).
+
+    context: [B, W] padded context indices; context_mask: [B, W];
+    targets: [B, 1+K] (positive first); labels: [B, 1+K] 1/0.
+    """
+    V, D = syn0.shape
+    B, W = context.shape
+    cm = context_mask * pair_mask[:, None]
+    counts = jnp.maximum(jnp.sum(cm, axis=1, keepdims=True), 1.0)
+    ctx = syn0[context] * cm[:, :, None]
+    h = jnp.sum(ctx, axis=1) / counts                 # [B, D]
+
+    tv = syn1neg[targets]                             # [B, 1+K, D]
+    logits = jnp.einsum("bd,bkd->bk", h, tv)
+    f = jax.nn.sigmoid(logits)
+    lab = labels.astype(syn0.dtype)
+    g = (lab - f) * lr * pair_mask[:, None]
+    g = jnp.where(logits > MAX_EXP, (lab - 1.0) * lr * pair_mask[:, None], g)
+    g = jnp.where(logits < -MAX_EXP, lab * lr * pair_mask[:, None], g)
+
+    h_grad = jnp.einsum("bk,bkd->bd", g, tv)          # [B, D]
+    K1 = targets.shape[1]
+    contrib = (g[:, :, None] * h[:, None, :]).reshape(B * K1, D)
+    syn1neg = syn1neg + _clip_rows(jax.ops.segment_sum(
+        contrib, targets.reshape(-1), num_segments=syn1neg.shape[0]))
+
+    per_word = jnp.broadcast_to(h_grad[:, None, :], (B, W, D)) * cm[:, :, None]
+    syn0 = syn0 + _clip_rows(jax.ops.segment_sum(
+        per_word.reshape(B * W, D), context.reshape(-1), num_segments=V))
+    return syn0, syn1neg
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
 def hs_cbow_step(syn0, syn1, context, context_mask, codes, points, code_mask,
                  pair_mask, lr):
     """Hierarchical-softmax CBOW update: h = mean of context vectors; the

@@ -9,6 +9,7 @@ two-topic corpora keep runtime test-suite friendly.
 import numpy as np
 import pytest
 
+from deeplearning4j_tpu.nlp import serializer
 from deeplearning4j_tpu.nlp.glove import CoOccurrences, Glove
 from deeplearning4j_tpu.nlp.vocab import VocabConstructor, build_huffman
 from deeplearning4j_tpu.nlp.word2vec import Word2Vec
@@ -46,7 +47,7 @@ class TestVocab:
 class TestCoOccurrences:
     def test_distance_weighting(self):
         rows, cols, vals = CoOccurrences(window_size=2).count(
-            [np.array([0, 1, 2], np.int32)], 3)
+            [np.array([0, 1, 2], np.int32)])
         got = {(int(r), int(c)): float(v) for r, c, v in zip(rows, cols, vals)}
         # (0,1) and (1,2) adjacent -> 1.0; (0,2) at distance 2 -> 0.5
         assert got[(0, 1)] == pytest.approx(1.0)
@@ -55,7 +56,7 @@ class TestCoOccurrences:
 
     def test_window_cutoff(self):
         rows, cols, vals = CoOccurrences(window_size=1).count(
-            [np.array([0, 1, 2], np.int32)], 3)
+            [np.array([0, 1, 2], np.int32)])
         got = {(int(r), int(c)) for r, c in zip(rows, cols)}
         assert (0, 2) not in got
 
@@ -80,6 +81,7 @@ class TestWord2Vec:
         dict(negative=0, cbow=False),   # skip-gram hierarchical softmax
         dict(negative=5, cbow=False),   # skip-gram negative sampling
         dict(negative=0, cbow=True),    # CBOW hierarchical softmax
+        dict(negative=5, cbow=True),    # CBOW negative sampling
     ])
     def test_clusters(self, rng, kwargs):
         sents = _cluster_corpus(rng, n=250)
@@ -88,3 +90,71 @@ class TestWord2Vec:
         within = w.similarity("cat", "dog")
         across = w.similarity("cat", "car")
         assert within > across, (kwargs, within, across)
+
+
+class TestWordVectorSerializer:
+    """Reference analog: `WordVectorSerializerTest.java` — Google
+    binary/text round-trips against hand-written fixtures."""
+
+    def _tiny_model(self, rng):
+        sents = _cluster_corpus(rng, n=40)
+        return Word2Vec(sents, layer_size=8, epochs=1, seed=1,
+                        batch_size=64).fit()
+
+    def test_text_roundtrip(self, rng, tmp_path):
+        w = self._tiny_model(rng)
+        p = str(tmp_path / "vecs.txt")
+        serializer.write_word_vectors(w, p)
+        back = serializer.load_google_model(p, binary=False)
+        assert back.vocab.words() == w.vocab.words()
+        np.testing.assert_allclose(back.syn0, np.asarray(w.syn0, np.float32),
+                                   rtol=1e-5)
+
+    def test_text_no_header(self, rng, tmp_path):
+        w = self._tiny_model(rng)
+        p = str(tmp_path / "vecs.txt")
+        serializer.write_word_vectors(w, p, header=False)
+        back = serializer.load_txt_vectors(p)
+        assert back.vocab.words() == w.vocab.words()
+
+    def test_binary_roundtrip(self, rng, tmp_path):
+        w = self._tiny_model(rng)
+        p = str(tmp_path / "vecs.bin")
+        serializer.write_google_binary(w, p)
+        back = serializer.load_google_model(p, binary=True)
+        assert back.vocab.words() == w.vocab.words()
+        np.testing.assert_allclose(back.syn0, np.asarray(w.syn0, np.float32))
+
+    def test_binary_hand_written_fixture(self, tmp_path):
+        """Bytes laid out by hand in the Google .bin format — a shared
+        write/read misunderstanding cannot pass this."""
+        import struct
+        p = tmp_path / "fixture.bin"
+        vecs = {"hello": [1.0, -2.5, 3.25], "world": [0.5, 0.0, -1.0]}
+        blob = b"2 3\n"
+        for word, v in vecs.items():
+            blob += word.encode() + b" " + struct.pack("<3f", *v) + b"\n"
+        p.write_bytes(blob)
+        back = serializer.load_google_binary(str(p))
+        assert back.vocab.words() == ["hello", "world"]
+        np.testing.assert_allclose(back.get_word_vector("hello"),
+                                   [1.0, -2.5, 3.25])
+        assert back.similarity("hello", "world") == pytest.approx(
+            float(np.dot([1.0, -2.5, 3.25], [0.5, 0.0, -1.0])
+                  / np.linalg.norm([1.0, -2.5, 3.25])
+                  / np.linalg.norm([0.5, 0.0, -1.0])), abs=1e-6)
+
+    def test_full_model_roundtrip(self, rng, tmp_path):
+        w = self._tiny_model(rng)
+        p = str(tmp_path / "model.zip")
+        serializer.write_full_model(w, p)
+        back = serializer.load_full_model(p)
+        assert back.layer_size == w.layer_size
+        assert back.vocab.words() == w.vocab.words()
+        np.testing.assert_allclose(np.asarray(back.syn0),
+                                   np.asarray(w.syn0, np.float32))
+        np.testing.assert_allclose(np.asarray(back.syn1),
+                                   np.asarray(w.syn1, np.float32))
+        # Huffman codes survive (needed to continue training).
+        w0, b0 = w.vocab._by_index[0], back.vocab._by_index[0]
+        assert w0.codes == b0.codes and w0.points == b0.points
