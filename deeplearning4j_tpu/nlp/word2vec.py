@@ -121,20 +121,49 @@ class Word2Vec(WordVectors):
 
     # ------------------------------------------------------------------ fit
 
-    def fit(self) -> "Word2Vec":
+    def fit(self, sentences: Optional[Iterable] = None) -> "Word2Vec":
+        if sentences is not None:
+            self._sentences = sentences
+        if self._sentences is None:
+            raise ValueError(
+                "no sentences to train on — pass them to the constructor or "
+                "to fit(sentences=...)")
         corpus = tokenize_corpus(self._sentences, self.tokenizer_factory)
-        self.vocab = VocabConstructor(self.min_word_frequency).build(corpus)
-        n_inner = build_huffman(self.vocab)
-        V, D = self.vocab.num_words(), self.layer_size
         rng = np.random.RandomState(self.seed)
-        # Reference init: syn0 ~ U(-0.5/D, 0.5/D), syn1 zeros.
-        syn0 = ((rng.rand(V, D) - 0.5) / D).astype(np.float32)
-        self.syn0 = jnp.asarray(syn0)
-        if self.negative > 0:
-            self.syn1neg = jnp.zeros((V, D), jnp.float32)
-            self._neg_table = make_unigram_table(self.vocab)
+        # RESUME path (reference `loadFullModel` + continued training): a
+        # model restored by `nlp/serializer.load_full_model` arrives with
+        # vocab + weights populated — keep them and train further on the
+        # new corpus (restricted to the existing vocab) instead of
+        # rebuilding/re-initializing.
+        resume = self.vocab is not None and self.syn0 is not None
+        if not resume:
+            self.vocab = VocabConstructor(self.min_word_frequency).build(corpus)
+            n_inner = build_huffman(self.vocab)
+            V, D = self.vocab.num_words(), self.layer_size
+            # Reference init: syn0 ~ U(-0.5/D, 0.5/D), syn1 zeros.
+            syn0 = ((rng.rand(V, D) - 0.5) / D).astype(np.float32)
+            self.syn0 = jnp.asarray(syn0)
+            if self.negative > 0:
+                self.syn1neg = jnp.zeros((V, D), jnp.float32)
+            else:
+                self.syn1 = jnp.zeros((max(n_inner, 1), D), jnp.float32)
         else:
-            self.syn1 = jnp.zeros((max(n_inner, 1), D), jnp.float32)
+            V, D = self.vocab.num_words(), self.layer_size
+            self.syn0 = jnp.asarray(np.asarray(self.syn0, np.float32))
+            if self.negative > 0:
+                if self.syn1neg is None:
+                    self.syn1neg = jnp.zeros_like(self.syn0)
+                else:
+                    self.syn1neg = jnp.asarray(
+                        np.asarray(self.syn1neg, np.float32))
+            else:
+                if self.syn1 is None:
+                    raise ValueError(
+                        "resumed HS model has no syn1 table (was it trained "
+                        "with negative sampling?)")
+                self.syn1 = jnp.asarray(np.asarray(self.syn1, np.float32))
+        if self.negative > 0:
+            self._neg_table = make_unigram_table(self.vocab)
 
         max_code = max((len(w.codes) for w in self.vocab._by_index), default=1) or 1
         seqs = [

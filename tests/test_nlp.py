@@ -158,3 +158,18 @@ class TestWordVectorSerializer:
         # Huffman codes survive (needed to continue training).
         w0, b0 = w.vocab._by_index[0], back.vocab._by_index[0]
         assert w0.codes == b0.codes and w0.points == b0.points
+
+    def test_full_model_resume_training(self, rng, tmp_path):
+        """The point of the full-model format: a loaded model can keep
+        training (vocab/weights reused, not rebuilt)."""
+        w = self._tiny_model(rng)
+        p = str(tmp_path / "model.zip")
+        serializer.write_full_model(w, p)
+        back = serializer.load_full_model(p)
+        with pytest.raises(ValueError, match="sentences"):
+            back.fit()
+        vocab_before = back.vocab
+        syn0_before = np.asarray(back.syn0).copy()
+        back.fit(_cluster_corpus(rng, n=20))
+        assert back.vocab is vocab_before          # not rebuilt
+        assert not np.allclose(syn0_before, np.asarray(back.syn0))
