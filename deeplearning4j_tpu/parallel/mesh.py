@@ -78,14 +78,23 @@ def param_shardings(params, mesh: Mesh, model_axis: Optional[str] = None,
     return jax.tree_util.tree_map(rule, params)
 
 
-def shard_params(net, mesh: Mesh, model_axis: Optional[str] = None):
-    """Place a network's params/opt_state/state on the mesh in-place."""
+def shard_params(net, mesh: Mesh, model_axis: Optional[str] = None, put=None):
+    """Place a network's params/opt_state/state on the mesh in-place.
+
+    `put(leaf, sharding)` is the placement primitive: `jax.device_put` by
+    default (single-process — all mesh devices addressable); multi-process
+    callers pass `parallel/distributed.py`'s global-array builder. One
+    routine, one set of sharding rules for both worlds."""
+    if put is None:
+        put = jax.device_put
     ps = param_shardings(net.params_tree, mesh, model_axis)
-    net.params_tree = jax.device_put(net.params_tree, ps)
+    net.params_tree = jax.tree_util.tree_map(put, net.params_tree, ps)
     if net.opt_state is not None:
         os_shard = param_shardings(net.opt_state, mesh, model_axis)
-        net.opt_state = jax.device_put(net.opt_state, os_shard)
+        net.opt_state = jax.tree_util.tree_map(
+            lambda a, s: put(a, s) if hasattr(a, "shape") else a,
+            net.opt_state, os_shard)
     if net.state:
-        net.state = jax.device_put(net.state, jax.tree_util.tree_map(
-            lambda a: NamedSharding(mesh, P()), net.state))
+        repl = NamedSharding(mesh, P())
+        net.state = jax.tree_util.tree_map(lambda a: put(a, repl), net.state)
     return net

@@ -1,0 +1,134 @@
+"""Multi-process distributed-training tests.
+
+Reference analog: `dl4j-spark`'s `BaseSparkTest.java:90` local-cluster
+pattern and `TestCompareParameterAveragingSparkVsSingleMachine.java` — the
+key equivalence: distributed training must produce the same parameters as
+single-machine training on the same data. Here two REAL OS processes join
+a `jax.distributed` cluster (Gloo-backed CPU collectives), each feeding
+its half of every global batch through `DistributedTrainer`; process 0
+saves the final params, compared against an in-process single-machine run.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.neural_net import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+STEPS = 5
+BATCH = 16  # global batch; each of 2 processes feeds 8 rows
+
+
+def _conf_code():
+    """The model/config/data, shared verbatim by the in-process single
+    machine run and the worker script (same seeds => same init)."""
+    return textwrap.dedent("""
+        import numpy as np
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.conf.neural_net import NeuralNetConfiguration
+
+        def make_conf():
+            return (NeuralNetConfiguration.builder()
+                    .seed(7).learning_rate(0.1).updater("sgd")
+                    .list()
+                    .layer(DenseLayer(n_out=16, activation="tanh"))
+                    .layer(OutputLayer(n_out=3, activation="softmax",
+                                       loss_function="mcxent"))
+                    .set_input_type(InputType.feed_forward(4))
+                    .build())
+
+        def make_data(step):
+            r = np.random.RandomState(100 + step)
+            X = r.randn(16, 4).astype("float32")
+            Y = np.eye(3)[r.randint(0, 3, 16)].astype("float32")
+            return X, Y
+    """)
+
+
+WORKER = """
+import os, sys
+pid = int(sys.argv[1]); port = sys.argv[2]; out = sys.argv[3]
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.extend
+jax.extend.backend.clear_backends()
+jax.config.update("jax_num_cpu_devices", 2)
+from deeplearning4j_tpu.parallel import distributed as dist
+dist.initialize(coordinator_address="127.0.0.1:" + port,
+                num_processes=2, process_id=pid)
+assert dist.process_count() == 2 and jax.device_count() == 4
+
+{conf_code}
+
+import numpy as np
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+net = MultiLayerNetwork(make_conf()).init()
+trainer = dist.DistributedTrainer(net)
+for step in range({steps}):
+    X, Y = make_data(step)
+    lo, hi = pid * 8, (pid + 1) * 8   # this process's slice of the batch
+    trainer.fit(DataSet(X[lo:hi], Y[lo:hi]))
+if pid == 0:
+    flat = {{f"{{k}}/{{p}}": np.asarray(v)
+            for k, layer in net.params_tree.items()
+            for p, v in layer.items()}}
+    np.savez(out, **flat)
+print("worker", pid, "done", flush=True)
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_training_matches_single_machine(tmp_path):
+    port = _free_port()
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER.format(conf_code=_conf_code(), steps=STEPS))
+    out = tmp_path / "params.npz"
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(pid), str(port), str(out)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, text=True)
+        for pid in (0, 1)]
+    try:
+        outputs = [p.communicate(timeout=240)[0] for p in procs]
+        for p, text in zip(procs, outputs):
+            assert p.returncode == 0, f"worker failed:\n{text[-3000:]}"
+    finally:
+        for p in procs:  # no orphaned workers stuck in a Gloo barrier
+            if p.poll() is None:
+                p.kill()
+
+    # Single-machine run on the SAME data stream.
+    ns = {}
+    exec(_conf_code(), ns)
+    net = MultiLayerNetwork(ns["make_conf"]()).init()
+    for step in range(STEPS):
+        X, Y = ns["make_data"](step)
+        net.fit(DataSet(X, Y))
+
+    got = np.load(str(out))
+    for lk, layer in net.params_tree.items():
+        for pk, v in layer.items():
+            np.testing.assert_allclose(
+                got[f"{lk}/{pk}"], np.asarray(v), rtol=2e-5, atol=2e-6,
+                err_msg=f"param {lk}/{pk} diverged from single-machine run")
