@@ -132,3 +132,102 @@ class FileStatsStorage(StatsStorage):
     def get_updates(self, session_id):
         return [r for r in self._iter_records()
                 if r.get("session_id") == session_id and not r.get("static")]
+
+
+class RemoteStatsStorageRouter(StatsStorageRouter):
+    """HTTP-POST routing to a remote `UIServer` (reference:
+    `api/storage/impl/RemoteUIStatsStorageRouter.java` — async posting with
+    bounded retries so a dead UI never stalls training). Records are queued
+    and shipped by a daemon thread to `<url>/remote`; after `retry_count`
+    consecutive failures a record is dropped (the reference's
+    `maxRetryCount` shutdown analog, minus killing the router).
+
+    The whole point on a pod: training runs in one process/host, the UI
+    watches from another — `UIServer(enable_remote=True)` is the receiver.
+    """
+
+    def __init__(self, url: str, retry_count: int = 5,
+                 retry_delay_seconds: float = 1.0, queue_size: int = 1000):
+        import queue
+
+        self.url = url.rstrip("/")
+        self.retry_count = int(retry_count)
+        self.retry_delay_seconds = float(retry_delay_seconds)
+        self._queue: "queue.Queue" = queue.Queue(maxsize=queue_size)
+        self._closed = False
+        self.dropped = 0
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _post(self, payload: Dict[str, Any]) -> None:
+        import urllib.request
+
+        body = json.dumps(payload).encode()
+        req = urllib.request.Request(
+            self.url + "/remote", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            resp.read()
+
+    def _worker(self) -> None:
+        while True:
+            item = self._queue.get()
+            try:
+                if item is None:
+                    return
+                for attempt in range(self.retry_count):
+                    try:
+                        self._post(item)
+                        break
+                    except Exception:
+                        time.sleep(self.retry_delay_seconds * (attempt + 1))
+                else:
+                    self.dropped += 1
+            finally:
+                self._queue.task_done()  # incl. the close sentinel
+
+    def _enqueue(self, payload: Dict[str, Any]) -> None:
+        if self._closed:
+            raise RuntimeError("router is closed")
+        try:
+            self._queue.put_nowait(payload)
+        except Exception:
+            self.dropped += 1  # bounded queue full: drop, never block training
+
+    def put_static_info(self, record):
+        self._enqueue({"type": "static", "record": _stamp(dict(record))})
+
+    def put_update(self, record):
+        self._enqueue({"type": "update", "record": _stamp(dict(record))})
+
+    def flush(self, timeout: Optional[float] = None) -> None:
+        """Block until everything queued so far has been shipped OR dropped
+        (tests / orderly shutdown). Honors `timeout` even while the worker
+        is mid-retry: join() runs on a side thread we wait on."""
+        done = threading.Event()
+
+        def join_then_set():
+            self._queue.join()
+            done.set()
+
+        t = threading.Thread(target=join_then_set, daemon=True)
+        t.start()
+        if not done.wait(timeout):
+            raise TimeoutError("remote stats queue did not drain")
+
+    def close(self) -> None:
+        """Never blocks on a full queue: queued-but-unsent records are
+        dropped in favor of a prompt shutdown (the class's contract is to
+        never stall training)."""
+        self._closed = True
+        while True:
+            try:
+                self._queue.put_nowait(None)
+                return
+            except Exception:
+                try:  # make room by dropping the oldest queued record
+                    self._queue.get_nowait()
+                    self._queue.task_done()
+                    self.dropped += 1
+                except Exception:
+                    time.sleep(0.01)

@@ -1,0 +1,191 @@
+"""Inference serving.
+
+Equivalent capability of the reference's streaming serving route
+(`dl4j-streaming/.../routes/DL4jServeRouteBuilder.java:1` — a Camel route
+that deserializes records from Kafka, calls `output()`, and publishes
+predictions). The TPU-era transport is a plain HTTP endpoint; Kafka/Camel
+plumbing is not reproduced (SURVEY.md §2.1 "Streaming"), the serving
+semantics are:
+
+- `POST /predict` `{"data": [[...], ...]}` -> `{"predictions": [[...]]}`
+- request MICRO-BATCHING: concurrent requests are coalesced and padded to
+  one fixed `max_batch_size` so the jitted forward compiles exactly once
+  and the MXU sees full batches (the TPU reason to batch at all);
+- `GET /health` liveness probe.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+class _Pending:
+    __slots__ = ("array", "event", "result", "error")
+
+    def __init__(self, array: np.ndarray):
+        self.array = array
+        self.event = threading.Event()
+        self.result: Optional[np.ndarray] = None
+        self.error: Optional[str] = None
+
+
+class InferenceServer:
+    """HTTP predict server over a trained engine (MultiLayerNetwork or
+    ComputationGraph — anything with `output(x)`).
+
+    `max_batch_size` bounds the padded compile shape; `max_delay_ms` is how
+    long the batcher waits to coalesce concurrent requests before running a
+    partial (still padded) batch.
+    """
+
+    def __init__(self, net, port: int = 0, host: str = "127.0.0.1",
+                 max_batch_size: int = 32, max_delay_ms: float = 5.0):
+        self.net = net
+        self.host = host
+        self.port = port
+        self.max_batch_size = int(max_batch_size)
+        self.max_delay_s = float(max_delay_ms) / 1000.0
+        self._queue: "queue.Queue[Optional[_Pending]]" = queue.Queue()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._batcher: Optional[threading.Thread] = None
+        self._serve_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- batching
+
+    def _run_batch(self, pending: List[_Pending]) -> None:
+        rows = [p.array for p in pending]
+        counts = [r.shape[0] for r in rows]
+        x = np.concatenate(rows, axis=0)
+        n = x.shape[0]
+        if n < self.max_batch_size:
+            # Pad to the fixed compile shape; padded rows are discarded.
+            pad = np.zeros((self.max_batch_size - n,) + x.shape[1:], x.dtype)
+            x = np.concatenate([x, pad], axis=0)
+        try:
+            preds = np.asarray(self.net.output(x))[:n]
+            off = 0
+            for p, c in zip(pending, counts):
+                p.result = preds[off:off + c]
+                off += c
+        except Exception as e:  # surface the failure to every caller
+            for p in pending:
+                p.error = f"{type(e).__name__}: {e}"
+        for p in pending:
+            p.event.set()
+
+    def _batch_loop(self) -> None:
+        holdover: Optional[_Pending] = None
+        while True:
+            first = holdover if holdover is not None else self._queue.get()
+            holdover = None
+            if first is None:
+                return
+            batch = [first]
+            total = first.array.shape[0]
+            # Coalesce whatever arrives within the delay window, up to the
+            # fixed batch size. A request that would overflow the fixed
+            # compile shape is held for the NEXT batch — the padded shape
+            # is the whole point (one jit compile, ever).
+            import time as _time
+            end = _time.monotonic() + self.max_delay_s
+            while total < self.max_batch_size:
+                remaining = end - _time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    item = self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if item is None:
+                    self._run_batch(batch)
+                    return
+                if total + item.array.shape[0] > self.max_batch_size:
+                    holdover = item
+                    break
+                batch.append(item)
+                total += item.array.shape[0]
+            self._run_batch(batch)
+
+    def predict(self, data) -> np.ndarray:
+        """In-process entry (the HTTP handler calls this too)."""
+        arr = np.asarray(data, np.float32)
+        if arr.shape[0] > self.max_batch_size:
+            # Split oversized requests into server-sized chunks.
+            return np.concatenate([
+                self.predict(arr[i:i + self.max_batch_size])
+                for i in range(0, arr.shape[0], self.max_batch_size)])
+        p = _Pending(arr)
+        self._queue.put(p)
+        p.event.wait(timeout=60)
+        if p.error is not None:
+            raise RuntimeError(p.error)
+        if p.result is None:
+            raise TimeoutError("prediction timed out")
+        return p.result
+
+    # --------------------------------------------------------------- http
+
+    def _make_handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _json(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/health":
+                    self._json({"status": "ok",
+                                "model": type(server.net).__name__})
+                else:
+                    self._json({"error": "not found"}, 404)
+
+            def do_POST(self):
+                if self.path != "/predict":
+                    return self._json({"error": "not found"}, 404)
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(length))
+                    preds = server.predict(payload["data"])
+                except (KeyError, ValueError, json.JSONDecodeError) as e:
+                    return self._json({"error": f"bad request: {e}"}, 400)
+                except Exception as e:
+                    return self._json({"error": str(e)}, 500)
+                self._json({"predictions": preds.tolist()})
+
+        return Handler
+
+    def start(self) -> "InferenceServer":
+        self._batcher = threading.Thread(target=self._batch_loop, daemon=True)
+        self._batcher.start()
+        self._httpd = ThreadingHTTPServer((self.host, self.port),
+                                          self._make_handler())
+        self.port = self._httpd.server_address[1]
+        self._serve_thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._serve_thread.start()
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        self._queue.put(None)

@@ -6,9 +6,19 @@ TPU-lite equivalent of the reference's Play-framework UI
 attaches to a `StatsStorage` and serves
 - `/`                    — overview page (score curve, throughput, per-layer
                            mean magnitudes, memory) rendered with inline JS
+- `/histogram`           — per-parameter distribution bars from the latest
+                           sampled update (reference histogram module,
+                           `HistogramModule`)
+- `/model`               — model overview table: layers, types, hyperparams
+                           from the static-info config JSON (reference
+                           `TrainModule.java:92-99` model route)
 - `/api/sessions`        — session ids
 - `/api/static?sid=`     — model static info
 - `/api/updates?sid=`    — the full update stream as JSON
+- `POST /remote`         — remote-receiver endpoint for
+                           `RemoteStatsStorageRouter` (reference
+                           `RemoteReceiverModule`); enable with
+                           `UIServer(enable_remote=True)`
 
 Usage (mirrors `UIServer.getInstance().attach(statsStorage)`):
 
@@ -102,8 +112,101 @@ refresh(); setInterval(refresh, 3000);
 """
 
 
+_HISTOGRAM_PAGE = """<!doctype html>
+<html><head><title>parameter histograms</title>
+<style>
+ body { font-family: sans-serif; margin: 2em; background: #fafafa; }
+ h1 { font-size: 1.3em; } h2 { font-size: 0.95em; margin: 1.2em 0 0.2em; }
+ .chart { border: 1px solid #ccc; background: #fff; }
+ a { color: #1565c0; }
+</style></head>
+<body>
+<h1>Parameter histograms <a href="/">overview</a> <a href="/model">model</a></h1>
+<div id="charts">loading…</div>
+<script>
+function drawHist(canvas, hist) {
+  const ctx = canvas.getContext('2d');
+  const n = hist.counts.length, peak = Math.max(...hist.counts, 1);
+  const w = (canvas.width - 60) / n;
+  ctx.fillStyle = '#1565c0';
+  hist.counts.forEach((c, i) => {
+    const h = (canvas.height - 24) * c / peak;
+    ctx.fillRect(30 + i * w, canvas.height - 12 - h, w - 1, h);
+  });
+  ctx.fillStyle = '#333';
+  ctx.fillText(hist.min.toPrecision(3), 2, canvas.height - 2);
+  ctx.fillText(hist.max.toPrecision(3), canvas.width - 55, canvas.height - 2);
+}
+async function refresh() {
+  const sessions = await (await fetch('api/sessions')).json();
+  if (!sessions.length) return;
+  const updates = await (await fetch('api/updates?sid=' +
+      sessions[sessions.length - 1])).json();
+  const last = [...updates].reverse().find(u => u.param_histograms);
+  if (!last) return;
+  const div = document.getElementById('charts');
+  div.textContent = '';
+  Object.entries(last.param_histograms).forEach(([name, hist]) => {
+    const h2 = document.createElement('h2');
+    h2.textContent = name + ' (iteration ' + last.iteration + ')';
+    const c = document.createElement('canvas');
+    c.className = 'chart'; c.width = 420; c.height = 110;
+    div.appendChild(h2); div.appendChild(c);
+    drawHist(c, hist);
+  });
+}
+refresh(); setInterval(refresh, 5000);
+</script></body></html>
+"""
+
+_MODEL_PAGE = """<!doctype html>
+<html><head><title>model overview</title>
+<style>
+ body { font-family: sans-serif; margin: 2em; background: #fafafa; }
+ h1 { font-size: 1.3em; } a { color: #1565c0; }
+ table { border-collapse: collapse; background: #fff; }
+ td, th { border: 1px solid #ccc; padding: 4px 10px; font-size: 0.9em; }
+ th { background: #eee; }
+ pre { background: #fff; border: 1px solid #ccc; padding: 8px;
+       max-width: 900px; overflow: auto; font-size: 0.8em; }
+</style></head>
+<body>
+<h1>Model <a href="/">overview</a> <a href="/histogram">histograms</a></h1>
+<div id="meta"></div>
+<table id="layers"><tr><th>#</th><th>layer</th><th>type</th>
+<th>n_in</th><th>n_out</th><th>activation</th></tr></table>
+<h2>Config JSON</h2><pre id="json"></pre>
+<script>
+async function refresh() {
+  const sessions = await (await fetch('api/sessions')).json();
+  if (!sessions.length) return;
+  const info = await (await fetch('api/static?sid=' +
+      sessions[sessions.length - 1])).json();
+  document.getElementById('meta').textContent =
+    (info.model_class || '?') + ' — ' + (info.num_params || '?') + ' params';
+  if (!info.model_config_json) return;
+  const conf = JSON.parse(info.model_config_json);
+  document.getElementById('json').textContent =
+    JSON.stringify(conf, null, 1);
+  const layers = conf.layers ||
+    Object.entries(conf.vertices || {}).map(([k, v]) => v.layer ?
+      Object.assign({name: k}, v.layer) : {name: k, '@class': v['@class']});
+  const table = document.getElementById('layers');
+  while (table.rows.length > 1) table.deleteRow(1);
+  (layers || []).forEach((l, i) => {
+    const r = table.insertRow();
+    [i, l.name || '', l['@class'] || '?', l.n_in || '', l.n_out || '',
+     l.activation || ''].forEach(v => r.insertCell().textContent = v);
+  });
+}
+refresh();
+</script></body></html>
+"""
+
+
 class _Handler(BaseHTTPRequestHandler):
     storage: Optional[StatsStorage] = None
+    enable_remote: bool = False
 
     def log_message(self, *args):  # quiet
         pass
@@ -116,18 +219,47 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _html(self, page: str) -> None:
+        body = page.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/html")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        # Remote-receiver endpoint (reference: `RemoteReceiverModule` —
+        # must be explicitly enabled, like the reference's enable flag).
+        storage = type(self).storage
+        if urlparse(self.path).path != "/remote":
+            return self._json({"error": "not found"}, 404)
+        if not type(self).enable_remote:
+            return self._json({"error": "remote receiver disabled"}, 403)
+        if storage is None:
+            return self._json({"error": "no storage attached"}, 503)
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length))
+            record = payload["record"]
+            if payload.get("type") == "static":
+                storage.put_static_info(record)
+            else:
+                storage.put_update(record)
+        except Exception as e:
+            return self._json({"error": str(e)}, 400)
+        self._json({"ok": True})
+
     def do_GET(self):
         url = urlparse(self.path)
         q = parse_qs(url.query)
         sid = (q.get("sid") or [None])[0]
         storage = type(self).storage
         if url.path in ("/", "/train", "/index.html"):
-            body = _PAGE.encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "text/html")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            self._html(_PAGE)
+        elif url.path == "/histogram":
+            self._html(_HISTOGRAM_PAGE)
+        elif url.path == "/model":
+            self._html(_MODEL_PAGE)
         elif url.path == "/api/sessions":
             self._json(storage.list_session_ids() if storage else [])
         elif url.path == "/api/static":
@@ -143,12 +275,14 @@ class _Handler(BaseHTTPRequestHandler):
 class UIServer:
     """Reference: `PlayUIServer` / `UIServer.getInstance()`."""
 
-    def __init__(self, port: int = 9000, host: str = "127.0.0.1"):
+    def __init__(self, port: int = 9000, host: str = "127.0.0.1",
+                 enable_remote: bool = False):
         self.host = host
         self.port = port
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
-        self._handler = type("BoundHandler", (_Handler,), {})
+        self._handler = type("BoundHandler", (_Handler,),
+                             {"enable_remote": bool(enable_remote)})
 
     def attach(self, storage: StatsStorage) -> "UIServer":
         self._handler.storage = storage
