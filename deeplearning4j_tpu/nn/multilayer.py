@@ -280,6 +280,85 @@ class MultiLayerNetwork:
                 new_step = step + 1.0 if advance else step
                 return out + ((new_step, key),)
             return jax.jit(step_tbptt, donate_argnums=(0, 2))
+        if kind == "train_step_tbptt_scan":
+            # The WHOLE tBPTT pass as ONE jitted program: chunk 0 unrolled
+            # (it CREATES the rnn-carry entries in `state`, so the carry
+            # structure is only scan-stable from chunk 1 on), the full-length
+            # middle chunks as a `lax.scan`, and any short remainder chunk
+            # unrolled at its TRUE length — no padding, so BatchNorm batch
+            # stats and masked losses see exactly the data the per-chunk
+            # host loop saw. The host loop it replaces pays one dispatch
+            # round-trip per chunk, which over a high-latency transport
+            # dominates the compute (measured ~13 ms per extra dispatch on
+            # the tunneled v5e vs 5.6 ms for the entire 100-step scan —
+            # PERF.md §4). Note each distinct sequence length t compiles its
+            # own program (the old loop reused [B, fwd] chunk programs
+            # across t); bucket/pad sequence lengths host-side if feeding
+            # many distinct lengths.
+            fwd = int(self.conf.tbptt_fwd_length)
+
+            def chunked(a, n):
+                if a is None:
+                    return None
+                # [B, n*fwd, ...] -> [n, B, fwd, ...] (scan axis leading)
+                b = a.shape[0]
+                a = a.reshape((b, n, fwd) + a.shape[2:])
+                return jnp.moveaxis(a, 1, 0)
+
+            def at(a, i):
+                return None if a is None else a[i]
+
+            def tslice(a, sl):
+                return None if a is None else a[:, sl]
+
+            def step_scan(params, state, opt_state, x, y, fmask, lmask,
+                          clock, eb):
+                step, key = clock
+                t = x.shape[1]
+                n_full = t // fwd  # >= 1: _fit_dispatch requires t > fwd
+                rem = t - n_full * fwd
+                # Same RNG chain as the per-chunk stats path (`step_tbptt`
+                # does `key, sub = split(key)` per chunk), so attaching a
+                # StatsListener never changes training numerics.
+                subs = []
+                for _ in range(n_full + (1 if rem else 0)):
+                    key, sub = jax.random.split(key)
+                    subs.append(sub)
+
+                full = slice(0, n_full * fwd)
+                xs, ys = chunked(tslice(x, full), n_full), chunked(tslice(y, full), n_full)
+                fs, ls = (chunked(tslice(fmask, full), n_full),
+                          chunked(tslice(lmask, full), n_full))
+
+                params, state, opt_state, loss = self._train_step(
+                    params, state, opt_state, xs[0], ys[0], at(fs, 0),
+                    at(ls, 0), step, subs[0], carry_rnn=True, eb=eb)
+
+                if n_full > 1:
+                    def body(carry, inp):
+                        params, state, opt_state = carry
+                        cx, cy, cf, cl, sub = inp
+                        params, state, opt_state, closs = self._train_step(
+                            params, state, opt_state, cx, cy, cf, cl, step,
+                            sub, carry_rnn=True, eb=eb)
+                        return (params, state, opt_state), closs
+
+                    (params, state, opt_state), losses = jax.lax.scan(
+                        body, (params, state, opt_state),
+                        (at(xs, slice(1, None)), at(ys, slice(1, None)),
+                         at(fs, slice(1, None)), at(ls, slice(1, None)),
+                         jnp.stack(subs[1:n_full])))
+                    loss = losses[-1]
+                if rem:
+                    tail = slice(n_full * fwd, t)
+                    params, state, opt_state, loss = self._train_step(
+                        params, state, opt_state, tslice(x, tail),
+                        tslice(y, tail), tslice(fmask, tail),
+                        tslice(lmask, tail), step, subs[-1],
+                        carry_rnn=True, eb=eb)
+                return (params, state, opt_state, loss,
+                        (step + 1.0, key))
+            return jax.jit(step_scan, donate_argnums=(0, 2))
         if kind == "feedforward":
             def ff_fn(params, state, x, fmask, rng):
                 _, new_state, acts, _ = self._forward_fn(
@@ -622,13 +701,30 @@ class MultiLayerNetwork:
         eb = jax.device_put(np.float32(
             losses_mod.effective_batch_size(ds.features, ds.labels_mask)
         ))
+        if ds.labels is None or np.ndim(ds.labels) != 3:
+            raise ValueError(
+                "Truncated BPTT requires 3-D per-timestep labels [b, t, c] "
+                "(reference doTruncatedBPTT semantics)"
+            )
+        if not self._collect_stats:
+            # Fast path: the entire chunk loop is one jitted scan — ONE
+            # dispatch per sequence instead of one per chunk (PERF.md §4).
+            step_fn = self._get_jit("train_step_tbptt_scan")
+            (self.params_tree, self.state, self.opt_state, loss,
+             self._clock) = step_fn(
+                self.params_tree, self.state, self.opt_state,
+                jnp.asarray(ds.features), jnp.asarray(ds.labels),
+                None if ds.features_mask is None else jnp.asarray(ds.features_mask),
+                None if ds.labels_mask is None else jnp.asarray(ds.labels_mask),
+                self._device_clock(), eb,
+            )
+            self._score = loss
+            self._finish_tbptt(saved_state)
+            return
+        # Stats path: per-chunk dispatch (keeps the last chunk's per-layer
+        # stats observable, matching the pre-scan behavior).
         for ci in range(n_chunks):
             sl = slice(ci * fwd, min((ci + 1) * fwd, t))
-            if ds.labels is None or ds.labels.ndim != 3:
-                raise ValueError(
-                    "Truncated BPTT requires 3-D per-timestep labels [b, t, c] "
-                    "(reference doTruncatedBPTT semantics)"
-                )
             chunk = DataSet(
                 ds.features[:, sl],
                 ds.labels[:, sl],
@@ -653,6 +749,9 @@ class MultiLayerNetwork:
             else:
                 self.params_tree, self.state, self.opt_state, loss, self._clock = out
             self._score = loss  # device scalar; sync deferred to score_value
+        self._finish_tbptt(saved_state)
+
+    def _finish_tbptt(self, saved_state):
         # Reset rnn carries after the sequence; keep persistent (BN) state.
         self.state = {
             lk: {k: v for k, v in s.items() if k in dict(self._declared_state()).get(lk, ())}

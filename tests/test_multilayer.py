@@ -226,3 +226,45 @@ class TestEmbedding:
         for _ in range(100):
             net.fit(idx.astype("int32"), Y)
         assert net.evaluate(DataSet(idx.astype("int32"), Y)).accuracy() > 0.9
+
+
+class TestTbpttScanPath:
+    def test_scan_path_matches_per_chunk_path(self, rng):
+        """The fused one-dispatch tBPTT scan (default) and the per-chunk
+        stats path must produce identical training numerics — same chunk
+        boundaries, same RNG split chain — including a NON-multiple sequence
+        length (t=25, fwd=10 → remainder chunk of 5 at its true length, no
+        padding) with dropout active and a label mask."""
+        from deeplearning4j_tpu.nn.conf.layers import GravesLSTM, RnnOutputLayer
+
+        b, t, f, c = 6, 25, 4, 3
+        X = rng.randn(b, t, f).astype("float32")
+        Y = np.eye(c)[rng.randint(0, c, (b, t))].astype("float32")
+        lmask = np.ones((b, t), "float32")
+        lmask[0, 7:] = 0.0
+
+        def conf_fn():
+            return (NeuralNetConfiguration.builder()
+                    .seed(7).learning_rate(0.05).updater("sgd")
+                    .weight_init("xavier")
+                    .list()
+                    .layer(GravesLSTM(n_out=6, activation="tanh", dropout=0.3))
+                    .layer(RnnOutputLayer(n_out=c, activation="softmax",
+                                          loss_function="mcxent"))
+                    .set_input_type(InputType.recurrent(f))
+                    .backprop_type("truncatedbptt")
+                    .t_bptt_forward_length(10)
+                    .build())
+
+        ds = DataSet(X, Y, None, lmask)
+        fast = MultiLayerNetwork(conf_fn()).init()
+        fast.fit(ds)
+        assert fast.iteration == 1
+        slow = MultiLayerNetwork(conf_fn()).init()
+        slow._collect_stats = True  # forces the per-chunk dispatch path
+        slow.fit(ds)
+        assert slow.iteration == 1
+        np.testing.assert_allclose(fast.params(), slow.params(),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(float(fast.score_value),
+                                   float(slow.score_value), rtol=1e-5)
