@@ -170,3 +170,25 @@ def hs_cbow_step(syn0, syn1, context, context_mask, codes, points, code_mask,
     syn0 = syn0 + _clip_rows(jax.ops.segment_sum(
         per_word.reshape(B * W, D), context.reshape(-1), num_segments=V))
     return syn0, syn1
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def hs_skipgram_step_tbl(syn0, syn1, centers, words, codes_tbl, points_tbl,
+                         cmask_tbl, pair_mask, lr):
+    """HS skip-gram with device-resident Huffman tables: gathers the [B, L]
+    paths from the [V, L] tables ON DEVICE, so each flush ships only [B]
+    int32 indices over the host link. (The host-side `codes_tbl[words]`
+    gather + its [B, L] transfer per flush dominated training time over a
+    high-latency transport — PERF.md §5.)"""
+    return hs_skipgram_step.__wrapped__(
+        syn0, syn1, centers, codes_tbl[words], points_tbl[words],
+        cmask_tbl[words], pair_mask, lr)
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def hs_cbow_step_tbl(syn0, syn1, context, context_mask, words, codes_tbl,
+                     points_tbl, cmask_tbl, pair_mask, lr):
+    """HS CBOW with device-resident Huffman tables (see hs_skipgram_step_tbl)."""
+    return hs_cbow_step.__wrapped__(
+        syn0, syn1, context, context_mask, codes_tbl[words],
+        points_tbl[words], cmask_tbl[words], pair_mask, lr)
