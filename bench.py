@@ -48,10 +48,22 @@ def _iter_bench_records():
             yield n, parsed
 
 
+# Metrics whose round-1/2 records were sync artifacts: the old timing method
+# didn't actually wait for device execution over the tunneled transport, so
+# those numbers were up to ~4x optimistic (PERF.md §1.4). Their baseline
+# anchors at round 3, the first honest measurement.
+_REANCHORED_AT_R3 = {
+    "lenet_mnist_fit_samples_per_sec",
+    "lenet_mnist_pipeline_samples_per_sec",
+}
+
+
 def _baseline_value(metric: str):
     """Earliest prior BENCH_r{N}.json value for `metric` (headline or extra)."""
     best = None
     for n, parsed in _iter_bench_records():
+        if metric in _REANCHORED_AT_R3 and n < 3:
+            continue
         value = None
         if parsed.get("metric") == metric and parsed.get("value"):
             value = float(parsed["value"])
@@ -65,14 +77,24 @@ def _baseline_value(metric: str):
     return best[1] if best else None
 
 
-def _entry(metric, value, unit):
+def _entry(metric, value, unit, note=None):
     base = _baseline_value(metric)
-    return {
+    out = {
         "metric": metric,
         "value": round(value, 3 if value < 100 else 1),
         "unit": unit,
         "vs_baseline": round(value / base, 3) if base else 1.0,
     }
+    if note:
+        out["note"] = note
+    return out
+
+
+# Streaming configs time the host->device link of a SHARED tunneled chip;
+# the link's throughput swings ~4x between runs with other tenants' load
+# (PERF.md §1.4), so their vs_baseline tracks congestion, not the framework.
+_LINK_NOTE = ("streams every batch over the shared tunnel; value tracks link "
+              "congestion at run time, not framework speed (PERF.md)")
 
 
 # ------------------------------------------------------------------ timing
@@ -190,7 +212,8 @@ def bench_lenet(steps, warmup):
     stream_sps, _ = _timed_fit(net2, mk, batch, steps, warmup)
     return (
         _entry("lenet_mnist_cached_samples_per_sec", cached_sps, "samples/sec"),
-        _entry("lenet_mnist_pipeline_samples_per_sec", stream_sps, "samples/sec"),
+        _entry("lenet_mnist_pipeline_samples_per_sec", stream_sps,
+               "samples/sec", note=_LINK_NOTE),
     )
 
 
@@ -236,6 +259,72 @@ def bench_char_rnn(steps, warmup):
     return _entry("char_rnn_fit_samples_per_sec", sps, "samples/sec")
 
 
+def bench_word2vec(steps, warmup):
+    """BASELINE.md config 4: Word2Vec skip-gram-HS on a synthetic
+    text8-scale corpus (Zipf unigram distribution), words/sec through the
+    public `Word2Vec.fit` — vocab build + Huffman coding + example assembly
+    + jitted kernel flushes all included, matching how the reference's
+    wall-clock on text8 is counted."""
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+    n_words = int(os.environ.get("BENCH_W2V_WORDS", "2000000"))
+    V, sent_len = 10000, 1000
+    rng = np.random.RandomState(0)
+    p = 1.0 / np.arange(1, V + 1)
+    p /= p.sum()
+    words = [f"w{i}" for i in range(V)]
+    idx = rng.choice(V, size=n_words, p=p)
+    sents = [[words[j] for j in idx[i:i + sent_len]]
+             for i in range(0, n_words, sent_len)]
+    w2v = Word2Vec(layer_size=100, window_size=5, min_word_frequency=1,
+                   sample=1e-3, negative=0, seed=1, batch_size=16384)
+    t0 = time.perf_counter()
+    w2v.fit(sents)
+    dt = time.perf_counter() - t0
+    return _entry("word2vec_skipgram_words_per_sec", n_words / dt, "words/sec")
+
+
+def bench_vgg16_dp(steps, warmup):
+    """BASELINE.md config 5: VGG-16 (Keras-zoo topology) through
+    ParallelWrapper over every visible device — samples/sec/chip. On the
+    single tunneled chip this measures the wrapper's sharded path at mesh
+    size 1; multi-chip scaling efficiency is exercised (not timed) by the
+    driver's dryrun_multichip on the virtual CPU mesh."""
+    import jax
+    import ml_dtypes
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.keras.trained_models import vgg16_config
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+    batch = int(os.environ.get("BENCH_BATCH_VGG16", "128"))
+    n_dev = len(jax.devices())
+    net = MultiLayerNetwork(vgg16_config(n_classes=1000, dtype="bfloat16"))
+    pw = ParallelWrapper(net)
+    rng = np.random.RandomState(0)
+
+    def mk_ds():
+        x = rng.rand(batch, 224, 224, 3).astype("float32")
+        return DataSet(
+            x.astype(ml_dtypes.bfloat16),
+            np.eye(1000, dtype="float32")[rng.randint(0, 1000, batch)])
+
+    pool = [mk_ds() for _ in range(2)]
+    for _ in range(max(2, warmup // 2)):
+        pw.fit(pool[0])
+    _ = net.score_value
+    n = max(8, steps)
+    t0 = time.perf_counter()
+    for i in range(n):
+        pw.fit(pool[i % 2])
+    _ = net.score_value
+    dt = time.perf_counter() - t0
+    return _entry("vgg16_dp_samples_per_sec_per_chip",
+                  batch * n / dt / max(n_dev, 1), "samples/sec/chip",
+                  note=_LINK_NOTE)
+
+
 def bench_resnet50(steps, warmup):
     import ml_dtypes
 
@@ -277,7 +366,8 @@ def bench_resnet50(steps, warmup):
     # orders of magnitude between runs (PERF.md), so this is a spot check.
     stream_sps, _ = _timed_fit(net, mk, batch, 4, warmup=1, distinct=2)
     extra_metrics["resnet50_stream_samples_per_sec"] = _entry(
-        "resnet50_stream_samples_per_sec", stream_sps, "samples/sec/chip")
+        "resnet50_stream_samples_per_sec", stream_sps, "samples/sec/chip",
+        note=_LINK_NOTE)
     return head, extra_metrics
 
 
@@ -285,7 +375,8 @@ def main():
     steps = int(os.environ.get("BENCH_STEPS", "30"))
     warmup = int(os.environ.get("BENCH_WARMUP", "5"))
     configs = os.environ.get(
-        "BENCH_CONFIGS", "resnet50,lenet,char_rnn,lenet_step").split(",")
+        "BENCH_CONFIGS",
+        "resnet50,lenet,char_rnn,lenet_step,word2vec,vgg16").split(",")
 
     head, extra = None, {}
     if "resnet50" in configs:
@@ -294,10 +385,18 @@ def main():
         for e in bench_lenet(steps, warmup):
             extra[e["metric"]] = e
     if "char_rnn" in configs:
-        e = bench_char_rnn(max(10, steps // 3), warmup)
+        # >= 80 timed batches: at ~4.4 ms/batch a short run can't amortize
+        # the tail sync RTT over the tunneled transport (PERF.md §4).
+        e = bench_char_rnn(max(80, steps), warmup)
         extra[e["metric"]] = e
     if "lenet_step" in configs:
         e = bench_lenet_step(steps, warmup)
+        extra[e["metric"]] = e
+    if "word2vec" in configs:
+        e = bench_word2vec(steps, warmup)
+        extra[e["metric"]] = e
+    if "vgg16" in configs:
+        e = bench_vgg16_dp(max(8, steps // 3), warmup)
         extra[e["metric"]] = e
     if head is None:  # resnet50 excluded: promote the first extra metric
         if not extra:
