@@ -118,6 +118,17 @@ class Evaluation:
         denom = self._fp(cls) + tn
         return self._fp(cls) / denom if denom else 0.0
 
+    def add_counts(self, conf_matrix, top_n_correct: float, total: float):
+        """Accumulate pre-computed batch counts (the device-side sharded
+        evaluation path, `parallel/evaluation.py`): conf_matrix [C, C]
+        rows=actual, cols=predicted."""
+        conf_matrix = np.asarray(conf_matrix)
+        self._ensure(conf_matrix.shape[0])
+        self.confusion.matrix += conf_matrix.astype(np.int64)
+        self.top_n_correct += int(round(top_n_correct))
+        self.total += int(round(total))
+        return self
+
     def merge(self, other: "Evaluation"):
         """Merge another evaluation (distributed eval, reference `IEvaluation.merge`)."""
         if other.confusion is None:

@@ -140,6 +140,14 @@ class ParallelWrapper:
             net._fit_dispatch(sharded)
         return net
 
+    def evaluate(self, iterator, top_n: int = 1):
+        """Mesh-sharded evaluation (reference: the Spark module's
+        distributed `evaluate`); see `parallel/evaluation.py`."""
+        from deeplearning4j_tpu.parallel.evaluation import sharded_evaluate
+
+        return sharded_evaluate(self.net, iterator, mesh=self.mesh,
+                                top_n=top_n)
+
 
 def _pad_rows(a, pad: int, fill_last: bool = True):
     """Append `pad` rows: copies of the last row (features/labels — keeps

@@ -256,3 +256,81 @@ class TestParallelComputationGraph:
 
         np.testing.assert_allclose(net.params(), ref.params(), rtol=1e-5,
                                    atol=1e-6)
+
+
+class TestShardedEvaluation:
+    """`parallel/evaluation.py` must agree exactly with the host-side
+    `net.evaluate` (reference: Spark distributed evaluation merges to the
+    same numbers as local eval)."""
+
+    def _trained_net(self, rng, n=48, f=4, c=3):
+        X = rng.randn(n, f).astype("float64")
+        Y = np.eye(c)[rng.randint(0, c, n)].astype("float64")
+        net = MultiLayerNetwork(
+            NeuralNetConfiguration.builder()
+            .seed(3).learning_rate(0.1).updater("sgd").weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=c, activation="softmax",
+                               loss_function="mcxent"))
+            .set_input_type(InputType.feed_forward(f))
+            .build()).init()
+        net.fit(DataSet(X, Y))
+        return net, X, Y
+
+    def test_matches_host_eval(self, rng):
+        from deeplearning4j_tpu.parallel.evaluation import sharded_evaluate
+
+        net, X, Y = self._trained_net(rng)
+        ref = net.evaluate(DataSet(X, Y))
+        ev = sharded_evaluate(net, DataSet(X, Y))
+        np.testing.assert_array_equal(ev.confusion.matrix, ref.confusion.matrix)
+        assert ev.total == ref.total
+        assert ev.accuracy() == ref.accuracy()
+
+    def test_ragged_batch_and_topn(self, rng):
+        # 45 rows on 8 devices forces padding; padded rows must not count.
+        from deeplearning4j_tpu.parallel.evaluation import sharded_evaluate
+
+        net, X, Y = self._trained_net(rng, n=45)
+        ref = net.evaluate(DataSet(X, Y), top_n=2)
+        ev = sharded_evaluate(net, DataSet(X, Y), top_n=2)
+        assert ev.total == 45 == ref.total
+        np.testing.assert_array_equal(ev.confusion.matrix, ref.confusion.matrix)
+        assert ev.top_n_accuracy() == ref.top_n_accuracy()
+
+    def test_time_series_with_mask(self, rng):
+        from deeplearning4j_tpu.parallel.evaluation import sharded_evaluate
+
+        b, t, f, c = 8, 6, 4, 3
+        X = rng.randn(b, t, f).astype("float64")
+        Y = np.eye(c)[rng.randint(0, c, (b, t))].astype("float64")
+        lmask = (rng.rand(b, t) > 0.3).astype("float64")
+        net = MultiLayerNetwork(
+            NeuralNetConfiguration.builder()
+            .seed(3).learning_rate(0.1).updater("sgd").weight_init("xavier")
+            .list()
+            .layer(GravesLSTM(n_out=6, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=c, activation="softmax",
+                                  loss_function="mcxent"))
+            .set_input_type(InputType.recurrent(f))
+            .build()).init()
+        ds = DataSet(X, Y, None, lmask)
+        ref = net.evaluate(ds)
+        ev = sharded_evaluate(net, ds)
+        np.testing.assert_array_equal(ev.confusion.matrix, ref.confusion.matrix)
+        assert ev.total == ref.total
+
+    def test_wrapper_entry_and_merge(self, rng):
+        from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+        net, X, Y = self._trained_net(rng)
+        ref = net.evaluate(DataSet(X, Y))
+        # Two halves evaluated separately then merged == whole.
+        pw = ParallelWrapper(net)
+        e1 = pw.evaluate(DataSet(X[:24], Y[:24]))
+        e2 = pw.evaluate(DataSet(X[24:], Y[24:]))
+        merged = e1.merge(e2)
+        np.testing.assert_array_equal(merged.confusion.matrix,
+                                      ref.confusion.matrix)
+        assert merged.accuracy() == ref.accuracy()
