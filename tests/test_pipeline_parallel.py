@@ -1,0 +1,151 @@
+"""Pipeline-parallel tests (8-device virtual CPU mesh).
+
+The reference has no pipeline parallelism (SURVEY.md §2.3); these cover the
+TPU-native extension: the GPipe microbatch schedule in
+`parallel/pipeline.py` must be exactly a sequential composition of its
+stages — values AND gradients — and must train.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.parallel import mesh as mesh_mod
+from deeplearning4j_tpu.parallel.pipeline import (
+    pipeline_apply,
+    stack_stage_params,
+    unstack_stage_params,
+)
+
+
+def dense_stage(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def make_stages(rng, s, f):
+    return [{"w": jnp.asarray(rng.randn(f, f) * 0.3),
+             "b": jnp.asarray(rng.randn(f) * 0.1)} for _ in range(s)]
+
+
+def sequential(stages, x):
+    for p in stages:
+        x = dense_stage(p, x)
+    return x
+
+
+@pytest.fixture(params=[(1, 8), (2, 4)], ids=["pipe8", "data2xpipe4"])
+def mesh(request):
+    dp, pp = request.param
+    return mesh_mod.create_mesh((dp, pp), axis_names=("data", "pipe"))
+
+
+class TestPipelineApply:
+    @pytest.mark.parametrize("n_micro", [4, 8])
+    def test_matches_sequential(self, rng, mesh, n_micro):
+        s = mesh.shape["pipe"]
+        f, b = 6, 16
+        stages = make_stages(rng, s, f)
+        x = jnp.asarray(rng.randn(b, f))
+        got = pipeline_apply(dense_stage, stack_stage_params(stages), x,
+                             mesh, n_microbatches=n_micro)
+        np.testing.assert_allclose(np.asarray(got),
+                                   np.asarray(sequential(stages, x)),
+                                   rtol=1e-10, atol=1e-12)
+
+    def test_grads_match_sequential(self, rng, mesh):
+        s = mesh.shape["pipe"]
+        f, b = 5, 8
+        stages = make_stages(rng, s, f)
+        stacked = stack_stage_params(stages)
+        x = jnp.asarray(rng.randn(b, f))
+        tgt = jnp.asarray(rng.randn(b, f))
+
+        def loss_pipe(p, x):
+            return jnp.mean(
+                (pipeline_apply(dense_stage, p, x, mesh,
+                                n_microbatches=4) - tgt) ** 2)
+
+        def loss_seq(stages, x):
+            return jnp.mean((sequential(stages, x) - tgt) ** 2)
+
+        gp, gx = jax.grad(loss_pipe, argnums=(0, 1))(stacked, x)
+        gs, gx_ref = jax.grad(loss_seq, argnums=(0, 1))(stages, x)
+        for i, ref in enumerate(gs):
+            got = jax.tree.map(lambda a, i=i: a[i], gp)
+            for k in ref:
+                np.testing.assert_allclose(np.asarray(got[k]),
+                                           np.asarray(ref[k]),
+                                           rtol=1e-8, atol=1e-10)
+        np.testing.assert_allclose(np.asarray(gx), np.asarray(gx_ref),
+                                   rtol=1e-8, atol=1e-10)
+
+    def test_trains(self, rng, mesh):
+        # A pipelined 4-8 stage tanh MLP fits a random-projection target.
+        s = mesh.shape["pipe"]
+        f, b = 6, 32
+        stacked = stack_stage_params(make_stages(rng, s, f))
+        x = jnp.asarray(rng.randn(b, f))
+        w_true = jnp.asarray(rng.randn(f, f) * 0.5)
+        tgt = jnp.tanh(x @ w_true)
+
+        @jax.jit
+        def step(p):
+            def loss(p):
+                out = pipeline_apply(dense_stage, p, x, mesh,
+                                     n_microbatches=4)
+                return jnp.mean((out - tgt) ** 2)
+            l, g = jax.value_and_grad(loss)(p)
+            return jax.tree.map(lambda a, ga: a - 0.5 * ga, p, g), l
+
+        p = stacked
+        l0 = None
+        for i in range(150):
+            p, l = step(p)
+            # Sync each iteration: unbounded queuing of collective programs
+            # aborts the virtual-CPU backend.
+            l = float(l)
+            l0 = l if l0 is None else l0
+        assert l < 0.5 * l0, (l0, l)
+
+    def test_round_trip_stack(self, rng, mesh):
+        s = mesh.shape["pipe"]
+        stages = make_stages(rng, s, 4)
+        back = unstack_stage_params(stack_stage_params(stages), s)
+        for a, b_ in zip(stages, back):
+            np.testing.assert_array_equal(np.asarray(a["w"]),
+                                          np.asarray(b_["w"]))
+
+    def test_rejects_indivisible_microbatch(self, rng, mesh):
+        s = mesh.shape["pipe"]
+        stages = make_stages(rng, s, 4)
+        x = jnp.asarray(rng.randn(10, 4))
+        with pytest.raises(ValueError, match="divisible"):
+            pipeline_apply(dense_stage, stack_stage_params(stages), x, mesh,
+                           n_microbatches=3)
+
+    def test_rejects_stage_count_mesh_mismatch(self, rng, mesh):
+        s = mesh.shape["pipe"]
+        stages = make_stages(rng, 2 * s, 4)  # would silently drop stages
+        x = jnp.asarray(rng.randn(8, 4))
+        with pytest.raises(ValueError, match="one stage per device"):
+            pipeline_apply(dense_stage, stack_stage_params(stages), x, mesh,
+                           n_microbatches=4)
+
+    def test_no_nan_grads_from_bubble(self, rng, mesh):
+        # A stage_fn with a non-finite derivative at garbage inputs must not
+        # poison gradients via the warm-up/drain bubble (where-grad trap).
+        s = mesh.shape["pipe"]
+        f, b = 4, 8
+        stages = [{"w": jnp.asarray(rng.randn(f, f) * 0.3),
+                   "b": jnp.zeros(f)} for _ in range(s)]
+
+        def sqrt_stage(params, x):
+            return jnp.sqrt(jnp.abs(x @ params["w"] + params["b"])) + 1e-3
+
+        x = jnp.asarray(np.abs(rng.randn(b, f)) + 0.5)
+        g = jax.grad(lambda p: jnp.sum(pipeline_apply(
+            sqrt_stage, p, x, mesh, n_microbatches=4)))(
+                stack_stage_params(stages))
+        assert all(np.all(np.isfinite(np.asarray(v)))
+                   for v in jax.tree.leaves(g))
