@@ -91,54 +91,73 @@ class ParagraphVectors:
         # combined embedding table [L + V, D]; doc rows use DBOW/DM pairing.
         combined = jnp.concatenate([self.doc_vectors, self.syn0], axis=0)
         B = self.batch_size
-        buf_center = np.zeros(B, np.int32)
-        buf_word = np.zeros(B, np.int32)
-        fill = 0
+        # Device-resident Huffman tables + vectorized example assembly —
+        # same host-bottleneck fixes as Word2Vec.fit (PERF.md §5).
+        codes_dev = jnp.asarray(self._codes_tbl)
+        points_dev = jnp.asarray(self._points_tbl)
+        cmask_dev = jnp.asarray(self._cmask_tbl)
         total = sum(len(s) for s, _ in seqs) * self.epochs
         done = 0
 
-        def flush(fill, lr):
+        def flush(centers, words, count, lr):
             nonlocal combined
-            if not fill:
-                return
+            buf_center = np.zeros(B, np.int32)
+            buf_word = np.zeros(B, np.int32)
             pm = np.zeros(B, np.float32)
-            pm[:fill] = 1.0
-            combined_new, self.syn1 = kernels.hs_skipgram_step(
+            buf_center[:count] = centers
+            buf_word[:count] = words
+            pm[:count] = 1.0
+            combined, self.syn1 = kernels.hs_skipgram_step_tbl(
                 combined, self.syn1, jnp.asarray(buf_center),
-                jnp.asarray(self._codes_tbl[buf_word]),
-                jnp.asarray(self._points_tbl[buf_word]),
-                jnp.asarray(self._cmask_tbl[buf_word]), jnp.asarray(pm),
-                jnp.float32(lr))
-            combined = combined_new
+                jnp.asarray(buf_word), codes_dev, points_dev, cmask_dev,
+                jnp.asarray(pm), jnp.float32(lr))
 
+        pend: List = []
+        n_pend = 0
+
+        def drain(final=False):
+            nonlocal pend, n_pend
+            if not pend or (not final and n_pend < B):
+                return
+            c = np.concatenate([p[0] for p in pend])
+            w = np.concatenate([p[1] for p in pend])
+            k = 0
+            while n_pend - k >= B:
+                flush(c[k:k + B], w[k:k + B], B, self._lr(done, total))
+                k += B
+            if final and n_pend > k:
+                flush(c[k:], w[k:], n_pend - k, self._lr(done, total))
+                k = n_pend
+            pend = [(c[k:], w[k:])] if n_pend > k else []
+            n_pend -= k
+
+        W = self.window_size
+        offsets = np.concatenate([np.arange(-W, 0), np.arange(1, W + 1)])
         for _ in range(self.epochs):
             for seq, label_ids in seqs:
                 n = len(seq)
-                for pos in range(n):
-                    # DBOW: doc vector predicts each word.
-                    for lid in label_ids:
-                        buf_center[fill] = lid  # doc row in combined table
-                        buf_word[fill] = seq[pos]
-                        fill += 1
-                        if fill == B:
-                            flush(fill, self._lr(done, total))
-                            fill = 0
-                    if self.dm:
-                        # DM-ish: context words predict the word too.
-                        lo = max(0, pos - self.window_size)
-                        hi = min(n, pos + 1 + self.window_size)
-                        for j in range(lo, hi):
-                            if j == pos:
-                                continue
-                            buf_center[fill] = L + seq[j]
-                            buf_word[fill] = seq[pos]
-                            fill += 1
-                            if fill == B:
-                                flush(fill, self._lr(done, total))
-                                fill = 0
+                if n == 0 or not label_ids:
+                    done += n
+                    continue
+                lids = np.asarray(label_ids, np.int32)
+                # DBOW: every doc label predicts every word (pos-major, as
+                # the reference's per-position loop visits them).
+                pend.append((np.tile(lids, n),
+                             np.repeat(seq, len(lids)).astype(np.int32)))
+                n_pend += n * len(lids)
+                if self.dm:
+                    # DM-ish: context words (offset rows into the combined
+                    # table) predict the word too.
+                    ctx_pos = np.arange(n)[:, None] + offsets[None, :]
+                    valid = (ctx_pos >= 0) & (ctx_pos < n)
+                    centers = (L + seq[np.clip(ctx_pos, 0, n - 1)])[valid]
+                    words = np.broadcast_to(seq[:, None], valid.shape)[valid]
+                    pend.append((centers.astype(np.int32),
+                                 words.astype(np.int32)))
+                    n_pend += int(valid.sum())
+                drain()
                 done += n
-        if fill:
-            flush(fill, self._lr(done, total))
+        drain(final=True)
         self.doc_vectors = combined[:L]
         self.syn0 = combined[L:]
         dv = np.asarray(self.doc_vectors)

@@ -334,6 +334,19 @@ class MultiLayerConfiguration:
     def from_json(s: str) -> "MultiLayerConfiguration":
         return MultiLayerConfiguration.from_dict(json.loads(s))
 
+    def to_yaml(self) -> str:
+        """YAML form (reference: `MultiLayerConfiguration.toYaml()`
+        `NeuralNetConfiguration.java:295-340` — same payload as JSON)."""
+        import yaml
+
+        return yaml.safe_dump(self.to_dict(), sort_keys=False)
+
+    @staticmethod
+    def from_yaml(s: str) -> "MultiLayerConfiguration":
+        import yaml
+
+        return MultiLayerConfiguration.from_dict(yaml.safe_load(s))
+
 
 class GraphBuilder:
     """DAG builder (reference: `ComputationGraphConfiguration.GraphBuilder`)."""
@@ -535,3 +548,15 @@ class ComputationGraphConfiguration:
     @staticmethod
     def from_json(s: str) -> "ComputationGraphConfiguration":
         return ComputationGraphConfiguration.from_dict(json.loads(s))
+
+    def to_yaml(self) -> str:
+        """YAML form (reference: `ComputationGraphConfiguration.toYaml()`)."""
+        import yaml
+
+        return yaml.safe_dump(self.to_dict(), sort_keys=False)
+
+    @staticmethod
+    def from_yaml(s: str) -> "ComputationGraphConfiguration":
+        import yaml
+
+        return ComputationGraphConfiguration.from_dict(yaml.safe_load(s))

@@ -58,6 +58,16 @@ class TestBuilder:
         assert conf2.layers[0].n_in == 4
         assert type(conf2.layers[0]).__name__ == "DenseLayer"
 
+    def test_yaml_roundtrip(self):
+        """Reference parity: `MultiLayerConfiguration.toYaml/fromYaml`
+        (`NeuralNetConfiguration.java:295-340`) — same payload as JSON, and
+        a YAML-restored config must train-compatibly equal the original."""
+        conf = mlp_conf(updater="adam")
+        conf2 = MultiLayerConfiguration.from_yaml(conf.to_yaml())
+        assert conf2.to_json() == conf.to_json()
+        net = MultiLayerNetwork(conf2).init()
+        assert net.num_params() > 0
+
     def test_layer_indexing_styles(self):
         c1 = (NeuralNetConfiguration.builder().list()
               .layer(0, DenseLayer(n_in=4, n_out=8))

@@ -173,3 +173,100 @@ class TestWordVectorSerializer:
         back.fit(_cluster_corpus(rng, n=20))
         assert back.vocab is vocab_before          # not rebuilt
         assert not np.allclose(syn0_before, np.asarray(back.syn0))
+
+
+class TestVectorizers:
+    """Reference: `BagOfWordsVectorizerTest.java` / `TfidfVectorizerTest.java`
+    — counts, tf*log10(N/df) weighting, vectorize() DataSet shape."""
+
+    DOCS = ["the cat sat on the mat",
+            "the dog sat on the log",
+            "cats and dogs"]
+
+    def test_bag_of_words_counts(self):
+        from deeplearning4j_tpu.nlp.vectorizer import BagOfWordsVectorizer
+
+        v = BagOfWordsVectorizer().fit(self.DOCS)
+        vec = v.transform("the cat and the cat")
+        assert vec[v.vocab.index("cat")] == 2
+        assert vec[v.vocab.index("the")] == 2
+        assert vec[v.vocab.index("and")] == 1
+        assert vec.sum() == 5
+
+    def test_tfidf_weighting(self):
+        from deeplearning4j_tpu.nlp.vectorizer import TfidfVectorizer
+
+        v = TfidfVectorizer().fit(self.DOCS)
+        vec = v.transform("cat cat dog")
+        # tf("cat")=2/3; df("cat")=1 of 3 docs -> idf=log10(3)
+        np.testing.assert_allclose(vec[v.vocab.index("cat")],
+                                   (2 / 3) * np.log10(3))
+        # "the" appears in 2 of 3 docs
+        v2 = v.transform("the")
+        np.testing.assert_allclose(v2[v.vocab.index("the")],
+                                   1.0 * np.log10(3 / 2))
+
+    def test_vectorize_dataset(self):
+        from deeplearning4j_tpu.nlp.vectorizer import TfidfVectorizer
+
+        v = TfidfVectorizer(labels=["pets", "other"]).fit(self.DOCS)
+        ds = v.vectorize("the cat sat", "pets")
+        assert ds.features.shape == (1, len(v.vocab))
+        np.testing.assert_array_equal(ds.labels, [[1.0, 0.0]])
+        with pytest.raises(ValueError):
+            v.vectorize("x", "nope")
+
+    def test_min_word_frequency(self):
+        from deeplearning4j_tpu.nlp.vectorizer import BagOfWordsVectorizer
+
+        v = BagOfWordsVectorizer(min_word_frequency=2).fit(self.DOCS)
+        assert "cat" not in v.vocab  # appears once
+        assert "the" in v.vocab and "sat" in v.vocab
+
+
+class TestParagraphVectors:
+    """Covers the batched fit path (DBOW and DM) + infer_vector
+    (reference: `ParagraphVectorsTest.java` — doc vectors of same-topic
+    documents end up closer than cross-topic)."""
+
+    def _docs(self):
+        from deeplearning4j_tpu.nlp.sentence_iterator import LabelledDocument
+
+        rng = np.random.RandomState(7)
+        docs = []
+        for i in range(30):
+            animal = ["cat", "dog", "pet", "fur", "paw"]
+            vehicle = ["car", "bus", "road", "wheel", "engine"]
+            pool = animal if i % 2 == 0 else vehicle
+            words = [pool[rng.randint(len(pool))] for _ in range(40)]
+            docs.append(LabelledDocument(" ".join(words),
+                                         [f"doc_{i}"]))
+        return docs
+
+    @pytest.mark.parametrize("dm", [False, True], ids=["dbow", "dm"])
+    def test_same_topic_docs_closer(self, dm):
+        from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+
+        pv = ParagraphVectors(self._docs(), dm=dm, layer_size=24,
+                              window_size=3, epochs=12, seed=3,
+                              batch_size=512).fit()
+        v0 = pv.get_doc_vector("doc_0")   # animal
+        v2 = pv.get_doc_vector("doc_2")   # animal
+        v1 = pv.get_doc_vector("doc_1")   # vehicle
+
+        def cos(a, b):
+            return float(np.dot(a, b) /
+                         (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12))
+
+        assert cos(v0, v2) > cos(v0, v1), (cos(v0, v2), cos(v0, v1))
+
+    def test_infer_vector_lands_near_topic(self):
+        from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+
+        pv = ParagraphVectors(self._docs(), layer_size=24, window_size=3,
+                              epochs=12, seed=3, batch_size=512).fit()
+        inferred = pv.infer_vector("cat dog pet fur paw cat dog pet")
+        near = pv.nearest_labels(inferred, 4)
+        # Majority of nearest docs should be animal-topic (even doc ids).
+        even = sum(1 for d in near if int(d.split("_")[1]) % 2 == 0)
+        assert even >= 3, near
