@@ -168,6 +168,12 @@ class CheckpointListener(IterationListener):
             # Record + prune only AFTER the new file is durably in place: a
             # crash mid-write must never have already deleted the previous
             # good checkpoint (keep_last=1 would otherwise leave nothing).
+            # Re-checkpointing an iteration (e.g. after a failure-recovery
+            # rollback replays it) must MOVE the entry, not duplicate it —
+            # a duplicate would later make _prune delete a file a newer
+            # entry still references.
+            if path in self.saved_paths:
+                self.saved_paths.remove(path)
             self.saved_paths.append(path)
             self._prune()
 

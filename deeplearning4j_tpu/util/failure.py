@@ -1,0 +1,156 @@
+"""Failure detection and in-place recovery.
+
+The reference has essentially no failure-detection story (SURVEY.md §5
+names this a gap the TPU build should EXCEED: its Spark layer retries
+partitions, nothing watches training health). This module adds the
+TPU-native version: a listener that watches the training score for
+divergence (NaN/inf) and, when it fires, rolls the LIVE network back to
+the newest HEALTHY checkpoint written by `CheckpointListener` — params,
+updater state, iteration/epoch counters, and the RNG continuation — so the
+training loop keeps running without re-construction or host restart.
+
+Composes with `util/checkpoint.py`'s async checkpointing: the
+CheckpointListener provides the rollback targets; this listener validates
+a candidate's params AND updater state are finite before restoring (with
+momentum-family updaters the optimizer state typically goes non-finite a
+step before the params do, so a params-only check would pick a checkpoint
+that re-diverges immediately).
+"""
+
+from __future__ import annotations
+
+import zipfile
+from typing import List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.optimize.listeners import IterationListener
+from deeplearning4j_tpu.util import checkpoint as ckpt_mod
+from deeplearning4j_tpu.util import model_serializer
+
+
+class TrainingDivergedError(RuntimeError):
+    """Raised when divergence persists past `max_recoveries` rollbacks."""
+
+
+def restore_in_place(net, path: str) -> None:
+    """Load a checkpoint INTO an existing network object (same config):
+    params, updater state, iteration/epoch, RNG continuation. Keeps every
+    external reference to `net` (listeners, wrappers, user code) valid —
+    the recovery path must not swap object identity."""
+    fresh = ckpt_mod.load_checkpoint(path)
+    net.params_tree = fresh.params_tree
+    net.state = fresh.state
+    net.opt_state = fresh.opt_state
+    net.iteration = fresh.iteration
+    net.epoch = fresh.epoch
+    net._train_rng = fresh._train_rng
+    net._clock = None
+    net._score = None  # score_value reads nan until the next step reports
+
+
+def _checkpoint_healthy(path: str) -> bool:
+    """True if every parameter AND updater-state value in the checkpoint
+    zip is finite (format: `model_serializer` float64 raw bytes)."""
+    try:
+        with zipfile.ZipFile(path) as z:
+            names = set(z.namelist())
+            params = np.frombuffer(
+                z.read(model_serializer.COEFFICIENTS), np.float64)
+            if not np.all(np.isfinite(params)):
+                return False
+            if model_serializer.UPDATER_STATE in names:
+                upd = np.frombuffer(
+                    z.read(model_serializer.UPDATER_STATE), np.float64)
+                if not np.all(np.isfinite(upd)):
+                    return False
+        return True
+    except Exception:
+        return False
+
+
+class FailureDetectionListener(IterationListener):
+    """Watchdog: every `check_frequency` iterations inspect the score; on
+    NaN/inf, roll back to the newest healthy checkpoint and keep training.
+
+    The score inspected is the one from the PREVIOUS check interval — by
+    the time the next check fires it has long since materialized, so the
+    watchdog never blocks the dispatch pipeline the way an immediate
+    `float(score)` would (the train loop deliberately defers all syncs;
+    `nn/multilayer.py::score_value`). Detection therefore lags one
+    interval; the healthy-checkpoint walk absorbs any checkpoint written
+    inside that lag.
+
+    `checkpoints`: the CheckpointListener supplying rollback targets
+    (attach it BEFORE this listener so snapshots precede checks).
+    """
+
+    def __init__(self, checkpoints: ckpt_mod.CheckpointListener, *,
+                 check_frequency: int = 10, max_recoveries: int = 3):
+        self.checkpoints = checkpoints
+        self.check_frequency = max(1, int(check_frequency))
+        self.max_recoveries = int(max_recoveries)
+        self.recoveries = 0
+        self.recovery_log: List[dict] = []
+        self._pending = None  # (iteration, device score) from last check
+
+    def iteration_done(self, model, iteration: int) -> None:
+        if iteration % self.check_frequency:
+            return
+        previous, self._pending = self._pending, (iteration, model._score)
+        if previous is None:
+            return
+        prev_iter, prev_score = previous
+        score = float("nan") if prev_score is None else float(prev_score)
+        if np.isfinite(score):
+            return
+        self._recover(model, prev_iter, score)
+
+    # ------------------------------------------------------------- recovery
+
+    def _recover(self, model, iteration: int, score: float) -> None:
+        if self.recoveries >= self.max_recoveries:
+            raise TrainingDivergedError(
+                f"score {score} at iteration {iteration} after "
+                f"{self.recoveries} recoveries — giving up")
+        self.checkpoints.flush()  # drain any in-flight write first
+        target = self._newest_healthy()
+        if target is None:
+            raise TrainingDivergedError(
+                f"score {score} at iteration {iteration} and no healthy "
+                "checkpoint to roll back to")
+        restore_in_place(model, target)
+        self._pending = None
+        # Checkpoints newer than the restore point capture diverged (or
+        # soon-to-diverge) state; drop them so a second recovery doesn't
+        # land on one, and so the replayed iterations re-checkpoint.
+        keep, drop = [], []
+        for p in self.checkpoints.saved_paths:
+            (keep if p == target or not self._newer_than(p, model.iteration)
+             else drop).append(p)
+        self.checkpoints.saved_paths[:] = keep
+        self.recoveries += 1
+        self.recovery_log.append({
+            "detected_at_iteration": iteration,
+            "restored_from": target,
+            "restored_iteration": model.iteration,
+            "bad_score": score,
+            "dropped_checkpoints": drop,
+        })
+
+    @staticmethod
+    def _newer_than(path: str, iteration: int) -> bool:
+        try:
+            import json
+
+            with zipfile.ZipFile(path) as z:
+                manifest = json.loads(z.read(model_serializer.MANIFEST))
+            return int(manifest.get("iteration", -1)) > iteration
+        except Exception:
+            return True  # unreadable: treat as stale and drop
+
+    def _newest_healthy(self) -> Optional[str]:
+        for path in reversed(self.checkpoints.saved_paths):
+            if _checkpoint_healthy(path):
+                return path
+        return None

@@ -270,3 +270,41 @@ class TestParagraphVectors:
         # Majority of nearest docs should be animal-topic (even doc ids).
         even = sum(1 for d in near if int(d.split("_")[1]) % 2 == 0)
         assert even >= 3, near
+
+
+class TestDistributedWord2Vec:
+    """Distributed embedding training (reference: the Spark NLP module's
+    Word2Vec): flush batches shard over the mesh's data axis, GSPMD
+    all-reduces the scatter-added updates — results must match the
+    single-device run exactly (same batches, same order, float-assoc only)."""
+
+    def _corpus(self):
+        rng = np.random.RandomState(4)
+        words = [f"w{i}" for i in range(50)]
+        return [[words[rng.randint(50)] for _ in range(60)]
+                for _ in range(30)]
+
+    @pytest.mark.parametrize("mode", ["hs_sg", "hs_cbow", "ns_sg", "ns_cbow"])
+    def test_mesh_matches_single_device(self, mode):
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+        from deeplearning4j_tpu.parallel import mesh as mesh_mod
+
+        kw = dict(layer_size=16, window_size=3, min_word_frequency=1,
+                  seed=5, epochs=2, batch_size=256,
+                  cbow="cbow" in mode,
+                  negative=5 if mode.startswith("ns") else 0)
+        corpus = self._corpus()
+        ref = Word2Vec(**kw).fit(corpus)
+        mesh = mesh_mod.create_mesh((8,), axis_names=("data",))
+        dist = Word2Vec(mesh=mesh, **kw).fit(corpus)
+        np.testing.assert_allclose(np.asarray(dist.syn0),
+                                   np.asarray(ref.syn0),
+                                   rtol=2e-4, atol=1e-6)
+
+    def test_rejects_indivisible_batch(self):
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+        from deeplearning4j_tpu.parallel import mesh as mesh_mod
+
+        mesh = mesh_mod.create_mesh((8,), axis_names=("data",))
+        with pytest.raises(ValueError, match="divisible"):
+            Word2Vec(batch_size=100, mesh=mesh).fit([["a", "b", "c"]])

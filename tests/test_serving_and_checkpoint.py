@@ -219,3 +219,85 @@ class TestCheckpointResume:
         # The restored net predicts identically.
         np.testing.assert_allclose(np.asarray(back.output(X)),
                                    np.asarray(net.output(X)), rtol=1e-6)
+
+
+class TestFailureDetection:
+    """SURVEY §5 exceed-goal (row: failure detection / elastic recovery):
+    NaN/inf divergence detected mid-training and rolled back in place to
+    the newest healthy checkpoint — the loop keeps running."""
+
+    def _poison(self, net):
+        import jax.numpy as jnp
+
+        lk = net.layer_keys[0]
+        pname = next(iter(net.params_tree[lk]))
+        net.params_tree[lk][pname] = (
+            net.params_tree[lk][pname] * jnp.asarray(np.nan))
+
+    def test_detects_and_rolls_back(self, tmp_path):
+        from deeplearning4j_tpu.util.failure import FailureDetectionListener
+
+        net = _net()
+        ckpts = CheckpointListener(str(tmp_path / "c"), frequency=2,
+                                   keep_last=3)
+        watchdog = FailureDetectionListener(ckpts, check_frequency=1)
+        net.set_listeners(ckpts, watchdog)
+        for step in range(6):
+            net.fit(*_batch(step))
+        good_iter = net.iteration
+        assert good_iter == 6
+        self._poison(net)
+        # Detection lags one check interval (the watchdog inspects the
+        # PREVIOUS interval's score so it never blocks the pipeline).
+        net.fit(*_batch(98))
+        net.fit(*_batch(99))
+        assert watchdog.recoveries == 1
+        assert net.iteration <= good_iter  # rolled back to a checkpoint
+        assert np.all(np.isfinite(np.asarray(net.params())))
+        # Training continues and reports finite scores again.
+        for step in range(6, 10):
+            net.fit(*_batch(step))
+        assert np.isfinite(net.score_value)
+        assert watchdog.recovery_log[0]["restored_iteration"] <= good_iter
+
+    def test_skips_poisoned_checkpoint(self, tmp_path):
+        from deeplearning4j_tpu.util.failure import (
+            FailureDetectionListener, _checkpoint_healthy,
+        )
+
+        net = _net()
+        ckpts = CheckpointListener(str(tmp_path / "c"), frequency=2,
+                                   keep_last=4)
+        net.set_listeners(ckpts)
+        for step in range(4):
+            net.fit(*_batch(step))
+        ckpts.flush()
+        healthy = list(ckpts.saved_paths)
+        # A checkpoint written AFTER divergence began must be skipped.
+        self._poison(net)
+        net.fit(*_batch(98))
+        net.fit(*_batch(99))
+        ckpts.flush()
+        assert len(ckpts.saved_paths) > len(healthy)
+        bad = [p for p in ckpts.saved_paths if p not in healthy]
+        assert any(not _checkpoint_healthy(p) for p in bad)
+        watchdog = FailureDetectionListener(ckpts, check_frequency=1)
+        watchdog._recover(net, net.iteration, float("nan"))
+        assert watchdog.recovery_log[0]["restored_from"] in healthy
+        assert np.all(np.isfinite(np.asarray(net.params())))
+
+    def test_gives_up_after_max_recoveries(self, tmp_path):
+        from deeplearning4j_tpu.util.failure import (
+            FailureDetectionListener, TrainingDivergedError,
+        )
+
+        net = _net()
+        ckpts = CheckpointListener(str(tmp_path / "c"), frequency=1)
+        watchdog = FailureDetectionListener(ckpts, check_frequency=1,
+                                            max_recoveries=0)
+        net.set_listeners(ckpts, watchdog)
+        net.fit(*_batch(0))
+        self._poison(net)
+        with pytest.raises(TrainingDivergedError):
+            net.fit(*_batch(1))
+            net.fit(*_batch(2))
