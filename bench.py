@@ -325,6 +325,45 @@ def bench_vgg16_dp(steps, warmup):
                   note=_LINK_NOTE)
 
 
+def bench_flash_attention(steps, warmup):
+    """Pallas flash-attention forward vs XLA dense attention (bf16,
+    T=8192, BH=8, D=64 — PERF.md §6). Reports the speedup ratio; device
+    memory is the bigger win (no [T, T] buffer)."""
+    import jax
+    import jax.numpy as jnp
+    import ml_dtypes
+
+    from deeplearning4j_tpu.ops.flash_attention import (
+        _dense_ref, flash_attention,
+    )
+
+    B, T, H, D = 2, 8192, 4, 64
+    rng = np.random.RandomState(0)
+    mk = lambda: jnp.asarray(
+        rng.randn(B, T, H, D).astype("float32").astype(ml_dtypes.bfloat16))
+    q, k, v = mk(), mk(), mk()
+    flash = jax.jit(lambda q, k, v: flash_attention(q, k, v, True, None,
+                                                    256, 256))
+    dense = jax.jit(lambda q, k, v: _dense_ref(q, k, v, True, D ** -0.5))
+
+    def timed(f, n):
+        for _i in range(max(1, warmup)):
+            o = f(q, k, v)
+        _ = float(o[0, 0, 0, 0].astype(jnp.float32))  # sync
+        t0 = time.perf_counter()
+        for _i in range(n):
+            o = f(q, k, v)
+        _ = float(o[0, 0, 0, 0].astype(jnp.float32))
+        return (time.perf_counter() - t0) / n
+
+    n = max(10, steps)
+    tf, td = timed(flash, n), timed(dense, n)
+    e = _entry("flash_attention_speedup_vs_xla", td / tf, "ratio")
+    e["flash_ms"] = round(tf * 1e3, 2)
+    e["xla_dense_ms"] = round(td * 1e3, 2)
+    return e
+
+
 def bench_resnet50(steps, warmup):
     import ml_dtypes
 
@@ -376,7 +415,8 @@ def main():
     warmup = int(os.environ.get("BENCH_WARMUP", "5"))
     configs = os.environ.get(
         "BENCH_CONFIGS",
-        "resnet50,lenet,char_rnn,lenet_step,word2vec,vgg16").split(",")
+        "resnet50,lenet,char_rnn,lenet_step,word2vec,vgg16,flash_attn"
+    ).split(",")
 
     head, extra = None, {}
     if "resnet50" in configs:
@@ -397,6 +437,9 @@ def main():
         extra[e["metric"]] = e
     if "vgg16" in configs:
         e = bench_vgg16_dp(max(8, steps // 3), warmup)
+        extra[e["metric"]] = e
+    if "flash_attn" in configs:
+        e = bench_flash_attention(steps, warmup)
         extra[e["metric"]] = e
     if head is None:  # resnet50 excluded: promote the first extra metric
         if not extra:

@@ -170,6 +170,23 @@ def ulysses_attention(q, k, v, mesh: Mesh, *, seq_axis: str = "seq",
     return fn(q, k, v)
 
 
+def attention(q, k, v, *, causal: bool = True,
+              scale: Optional[float] = None, impl: str = "auto"):
+    """Single-device multi-head attention, q/k/v [B, T, H, Dh] — the
+    framework's default attention entry point.
+
+    impl="auto" uses the Pallas flash kernel (`ops/flash_attention.py`:
+    1.2-3.1x XLA dense on a v5e, O(T·D) memory; falls back to dense
+    internally when T isn't a block multiple); impl="dense" forces the XLA
+    path (also the test oracle). For sequence-sharded attention use
+    `ring_attention` / `ulysses_attention`."""
+    if impl == "dense":
+        return dense_attention(q, k, v, causal=causal, scale=scale)
+    from deeplearning4j_tpu.ops.flash_attention import flash_attention
+
+    return flash_attention(q, k, v, causal, scale)
+
+
 def dense_attention(q, k, v, *, causal: bool = True,
                     scale: Optional[float] = None):
     """Single-device reference: q/k/v [B, T, H, Dh] -> [B, T, H, Dh]."""

@@ -1,0 +1,82 @@
+"""Flash-attention kernel tests (Pallas interpret mode on the CPU mesh —
+same kernel code the TPU runs compiled; PERF.md §6 has the on-chip
+numbers)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops.flash_attention import flash_attention
+from deeplearning4j_tpu.parallel.sequence import dense_attention
+
+
+def qkv(rng, b=2, t=128, h=2, d=8, dtype="float32"):
+    mk = lambda: rng.randn(b, t, h, d).astype(dtype)
+    return jnp.asarray(mk()), jnp.asarray(mk()), jnp.asarray(mk())
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [True, False], ids=["causal", "full"])
+    def test_matches_dense(self, rng, causal):
+        q, k, v = qkv(rng)
+        got = flash_attention(q, k, v, causal, None, 64, 64)
+        want = dense_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-6)
+
+    def test_uneven_length_falls_back(self, rng):
+        q, k, v = qkv(rng, t=100)  # not a block multiple
+        got = flash_attention(q, k, v, True, None, 64, 64)
+        want = dense_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-6)
+
+    def test_grads_match_dense(self, rng):
+        q, k, v = qkv(rng, t=64)
+        w = jnp.asarray(rng.randn(*q.shape).astype("float32"))
+        g_f = jax.grad(lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, True, None, 64, 64) * w),
+            argnums=(0, 1, 2))(q, k, v)
+        g_d = jax.grad(lambda q, k, v: jnp.sum(
+            dense_attention(q, k, v, causal=True) * w),
+            argnums=(0, 1, 2))(q, k, v)
+        for gf, gd in zip(g_f, g_d):
+            np.testing.assert_allclose(np.asarray(gf), np.asarray(gd),
+                                       rtol=2e-4, atol=2e-5)
+
+    def test_jit_composes(self, rng):
+        q, k, v = qkv(rng, t=64)
+        f = jax.jit(lambda q, k, v: flash_attention(q, k, v, True, None,
+                                                    64, 64))
+        np.testing.assert_allclose(
+            np.asarray(f(q, k, v)),
+            np.asarray(dense_attention(q, k, v, causal=True)),
+            rtol=2e-5, atol=2e-6)
+
+    def test_streaming_path_matches_dense(self, rng, monkeypatch):
+        # Force the long-T streaming kernel (k-blocks innermost, scratch
+        # accumulators) even at test size.
+        from deeplearning4j_tpu.ops import flash_attention as fa
+
+        monkeypatch.setattr(fa, "_RESIDENT_KV_LIMIT", 0)
+        # t=192 is used by no other test: the jitted wrapper reads the
+        # limit at TRACE time, so a shape another test already compiled
+        # would silently reuse the resident-path executable.
+        q, k, v = qkv(rng, t=192)
+        for causal in (True, False):
+            got = fa.flash_attention(q, k, v, causal, None, 64, 64)
+            want = dense_attention(q, k, v, causal=causal)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=2e-5, atol=2e-6)
+
+    def test_framework_attention_entry(self, rng):
+        # parallel.sequence.attention is the public entry; impl="auto"
+        # routes to the Pallas kernel, impl="dense" to the XLA oracle.
+        from deeplearning4j_tpu.parallel.sequence import attention
+
+        q, k, v = qkv(rng, t=64)
+        np.testing.assert_allclose(
+            np.asarray(attention(q, k, v)),
+            np.asarray(attention(q, k, v, impl="dense")),
+            rtol=2e-5, atol=2e-6)
