@@ -46,6 +46,14 @@ def _as_mds(data, labels=None) -> MultiDataSet:
     return MultiDataSet(features=[np.asarray(data)], labels=[np.asarray(labels)])
 
 
+def _as_mask_list(masks):
+    """Normalize a MultiDataSet mask list for the jitted fns: None when no
+    entry is present, else per-entry jnp arrays (None entries preserved)."""
+    if masks is None or not any(m is not None for m in masks):
+        return None
+    return [None if m is None else jnp.asarray(m) for m in masks]
+
+
 class ComputationGraph:
     """DAG network engine (see module docstring)."""
 
@@ -292,6 +300,80 @@ class ComputationGraph:
                 new_step = step + 1.0 if advance else step
                 return out + ((new_step, key),)
             return jax.jit(step_fn2, donate_argnums=(0, 2))
+        if kind == "train_step_tbptt_scan":
+            # Whole tBPTT pass as ONE jitted program, mirroring
+            # `MultiLayerNetwork`'s `train_step_tbptt_scan` (PERF.md §4):
+            # chunk 0 unrolled (creates the rnn carries), middle chunks as a
+            # `lax.scan` whose body time-slices the closed-over full
+            # sequences with `dynamic_slice` (static 2-D inputs pass
+            # through untouched), remainder chunk unrolled at its true
+            # length. RNG split chain matches the per-chunk path exactly.
+            fwd = int(self.conf.tbptt_fwd_length)
+
+            def step_scan(params, state, opt_state, inputs, labels, fmasks,
+                          lmasks, clock, ebs):
+                step, key = clock
+                t = max(f.shape[1] for f in inputs if f.ndim == 3)
+                n_full = t // fwd
+                rem = t - n_full * fwd
+                subs = []
+                for _ in range(n_full + (1 if rem else 0)):
+                    key, sub = jax.random.split(key)
+                    subs.append(sub)
+
+                def sliced(lst, slicer, is_mask=False):
+                    if lst is None:
+                        return None
+                    out = []
+                    for a in lst:
+                        seq = a is not None and a.shape[1:2] == (t,) and (
+                            a.ndim == 3 or (is_mask and a.ndim == 2))
+                        out.append(slicer(a) if seq else a)
+                    return out
+
+                def static_chunk(args, sl):
+                    inputs_c = sliced(args[0], lambda a: a[:, sl])
+                    labels_c = sliced(args[1], lambda a: a[:, sl])
+                    fm_c = sliced(args[2], lambda a: a[:, sl], True)
+                    lm_c = sliced(args[3], lambda a: a[:, sl], True)
+                    return inputs_c, labels_c, fm_c, lm_c
+
+                c0 = static_chunk((inputs, labels, fmasks, lmasks),
+                                  slice(0, fwd))
+                params, state, opt_state, loss = self._train_step(
+                    params, state, opt_state, *c0, step, subs[0],
+                    carry_rnn=True, ebs=ebs)
+
+                if n_full > 1:
+                    def body(carry, inp):
+                        params, state, opt_state = carry
+                        c, sub = inp
+                        off = c * fwd
+
+                        def dyn(a):
+                            return jax.lax.dynamic_slice_in_dim(a, off, fwd, 1)
+
+                        inputs_c = sliced(inputs, dyn)
+                        labels_c = sliced(labels, dyn)
+                        fm_c = sliced(fmasks, dyn, True)
+                        lm_c = sliced(lmasks, dyn, True)
+                        params, state, opt_state, closs = self._train_step(
+                            params, state, opt_state, inputs_c, labels_c,
+                            fm_c, lm_c, step, sub, carry_rnn=True, ebs=ebs)
+                        return (params, state, opt_state), closs
+
+                    (params, state, opt_state), losses = jax.lax.scan(
+                        body, (params, state, opt_state),
+                        (jnp.arange(1, n_full), jnp.stack(subs[1:n_full])))
+                    loss = losses[-1]
+                if rem:
+                    cr = static_chunk((inputs, labels, fmasks, lmasks),
+                                      slice(n_full * fwd, t))
+                    params, state, opt_state, loss = self._train_step(
+                        params, state, opt_state, *cr, step, subs[-1],
+                        carry_rnn=True, ebs=ebs)
+                return (params, state, opt_state, loss, (step + 1.0, key))
+            return jax.jit(step_scan, donate_argnums=(0, 2))
         raise ValueError(kind)
 
     # ----------------------------------------------------------------- loss
@@ -465,16 +547,8 @@ class ComputationGraph:
         `Solver.java:41-110`); see `MultiLayerNetwork._fit_solver`."""
         g = self.conf.global_conf
         fn = self._get_jit("solver_step", algo=str(algo))
-        fmasks = None
-        if mds.features_masks is not None and any(
-                m is not None for m in mds.features_masks):
-            fmasks = [None if m is None else jnp.asarray(m)
-                      for m in mds.features_masks]
-        lmasks = None
-        if mds.labels_masks is not None and any(
-                m is not None for m in mds.labels_masks):
-            lmasks = [None if m is None else jnp.asarray(m)
-                      for m in mds.labels_masks]
+        fmasks = _as_mask_list(mds.features_masks)
+        lmasks = _as_mask_list(mds.labels_masks)
         self.params_tree, loss = fn(
             self.params_tree, self.state,
             [jnp.asarray(f) for f in mds.features],
@@ -525,6 +599,22 @@ class ComputationGraph:
                 return a[:, sl]
             return a
 
+        if not self._collect_stats:
+            # Fast path: the whole chunk loop is one jitted scan — ONE
+            # dispatch per sequence (PERF.md §4); per-chunk dispatch remains
+            # only for StatsListener observability.
+            step_fn = self._get_jit("train_step_tbptt_scan")
+            fmasks = _as_mask_list(mds.features_masks)
+            lmasks = _as_mask_list(mds.labels_masks)
+            (self.params_tree, self.state, self.opt_state, loss,
+             self._clock) = step_fn(
+                self.params_tree, self.state, self.opt_state,
+                [jnp.asarray(f) for f in mds.features],
+                [jnp.asarray(l) for l in mds.labels],
+                fmasks, lmasks, self._device_clock(), ebs,
+            )
+            self._score = loss
+            return self._finish_tbptt(saved_state)
         n_chunks = math.ceil(t / fwd)
         for ci in range(n_chunks):
             sl = slice(ci * fwd, min((ci + 1) * fwd, t))
@@ -538,6 +628,9 @@ class ComputationGraph:
             )
             self._fit_one(chunk, tbptt=True, count_iteration=False, ebs=ebs,
                           advance=ci == n_chunks - 1)
+        self._finish_tbptt(saved_state)
+
+    def _finish_tbptt(self, saved_state):
         # Drop rnn carries, keep declared (BN) state.
         declared = {n: set(v.layer.state_shapes()) for n, v in self.layer_vertices.items()}
         self.state = {
@@ -568,12 +661,8 @@ class ComputationGraph:
         else:
             kind = "train_step_stats" if self._collect_stats else "train_step"
             step_fn = self._get_jit(kind)
-        fmasks = None
-        if mds.features_masks is not None and any(m is not None for m in mds.features_masks):
-            fmasks = [None if m is None else jnp.asarray(m) for m in mds.features_masks]
-        lmasks = None
-        if mds.labels_masks is not None and any(m is not None for m in mds.labels_masks):
-            lmasks = [None if m is None else jnp.asarray(m) for m in mds.labels_masks]
+        fmasks = _as_mask_list(mds.features_masks)
+        lmasks = _as_mask_list(mds.labels_masks)
         args = [
             self.params_tree, self.state, self.opt_state,
             [jnp.asarray(f) for f in mds.features],
@@ -610,12 +699,8 @@ class ComputationGraph:
     def score(self, data, labels=None) -> float:
         mds = _as_mds(data, labels)
         fn = self._get_jit("score")
-        fmasks = None
-        if mds.features_masks is not None and any(m is not None for m in mds.features_masks):
-            fmasks = [None if m is None else jnp.asarray(m) for m in mds.features_masks]
-        lmasks = None
-        if mds.labels_masks is not None and any(m is not None for m in mds.labels_masks):
-            lmasks = [None if m is None else jnp.asarray(m) for m in mds.labels_masks]
+        fmasks = _as_mask_list(mds.features_masks)
+        lmasks = _as_mask_list(mds.labels_masks)
         return float(fn(
             self.params_tree, self.state,
             [jnp.asarray(f) for f in mds.features],
@@ -636,9 +721,7 @@ class ComputationGraph:
             iterator = [iterator]
         for item in iterator:
             mds = _as_mds(item)
-            fmasks = None
-            if mds.features_masks is not None and any(m is not None for m in mds.features_masks):
-                fmasks = [None if m is None else jnp.asarray(m) for m in mds.features_masks]
+            fmasks = _as_mask_list(mds.features_masks)
             out = self.output(*mds.features, features_masks=fmasks)[0]
             lmask = mds.labels_masks[0] if mds.labels_masks else None
             ev.eval(mds.labels[0], out, mask=lmask)

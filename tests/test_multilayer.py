@@ -278,3 +278,52 @@ class TestTbpttScanPath:
                                    rtol=1e-5, atol=1e-6)
         np.testing.assert_allclose(float(fast.score_value),
                                    float(slow.score_value), rtol=1e-5)
+
+    def test_graph_scan_path_matches_per_chunk_path(self, rng):
+        """Same equivalence for the ComputationGraph engine — non-multiple
+        t, dropout, label mask, plus a STATIC second input (must pass
+        through the time chunking untouched)."""
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+        from deeplearning4j_tpu.nn.conf.layers import (
+            DenseLayer, GravesLSTM, RnnOutputLayer,
+        )
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        b, t, f, c = 4, 25, 3, 3
+        X = rng.randn(b, t, f).astype("float32")
+        S = rng.randn(b, 5).astype("float32")  # static side input
+        Y = np.eye(c)[rng.randint(0, c, (b, t))].astype("float32")
+        lmask = np.ones((b, t), "float32")
+        lmask[0, 9:] = 0.0
+
+        def conf_fn():
+            gb = (NeuralNetConfiguration.builder()
+                  .seed(11).learning_rate(0.05).updater("sgd")
+                  .weight_init("xavier")
+                  .graph_builder()
+                  .add_inputs("seq", "static")
+                  .add_layer("lstm", GravesLSTM(n_out=6, activation="tanh",
+                                                dropout=0.3), "seq")
+                  .add_layer("emb", DenseLayer(n_out=6, activation="tanh"),
+                             "static")
+                  .add_layer("out", RnnOutputLayer(
+                      n_out=c, activation="softmax",
+                      loss_function="mcxent"), "lstm")
+                  .set_outputs("out"))
+            gb.set_input_types(InputType.recurrent(f),
+                               InputType.feed_forward(5))
+            conf = gb.build()
+            conf.backprop_type = "truncatedbptt"
+            conf.tbptt_fwd_length = 10
+            return conf
+
+        mds = MultiDataSet(features=[X, S], labels=[Y],
+                           labels_masks=[lmask])
+        fast = ComputationGraph(conf_fn()).init()
+        fast.fit(mds)
+        assert fast.iteration == 1
+        slow = ComputationGraph(conf_fn()).init()
+        slow._collect_stats = True
+        slow.fit(mds)
+        np.testing.assert_allclose(fast.params(), slow.params(),
+                                   rtol=1e-5, atol=1e-6)
