@@ -459,16 +459,24 @@ def _lstm_from_keras(arrays: List[np.ndarray], n_in: int, u: int):
 
 def _conv_kernel(kernel: np.ndarray, cfg: Dict[str, Any], n_in: int,
                  n_out: int) -> np.ndarray:
-    """Keras conv kernel -> HWIO."""
+    """Keras conv kernel -> HWIO.
+
+    "th" kernels are additionally rotated 180° spatially: the Theano
+    backend applies TRUE convolution (flipping kernels at application
+    time), so reproducing the model with a cross-correlation conv (XLA,
+    like DL4J's) needs the flip baked into the weights — reference
+    `KerasConvolution.java:126-141` does the same reversal on import.
+    Validated against a real Keras-1.1.2-written model in
+    `tests/test_keras_import.py::TestRealKerasGoldenFile`."""
     if kernel.ndim != 4:
         raise KerasImportException(f"Conv kernel ndim {kernel.ndim}")
     ordering = _layer_dim_ordering(cfg)
     if ordering == "th" and kernel.shape[0] == n_out and kernel.shape[1] == n_in:
-        return np.transpose(kernel, (2, 3, 1, 0))  # OIHW -> HWIO
+        return np.transpose(kernel[:, :, ::-1, ::-1], (2, 3, 1, 0))
     if kernel.shape[-1] == n_out and kernel.shape[-2] == n_in:
-        return kernel  # already HWIO
+        return kernel  # already HWIO (tf ordering: cross-correlation, no flip)
     if kernel.shape[0] == n_out and kernel.shape[1] == n_in:
-        return np.transpose(kernel, (2, 3, 1, 0))
+        return np.transpose(kernel[:, :, ::-1, ::-1], (2, 3, 1, 0))
     raise KerasImportException(
         f"Conv kernel shape {kernel.shape} doesn't match n_in={n_in}, "
         f"n_out={n_out}")
@@ -496,8 +504,24 @@ def _layer_weight_arrays(weights_root, name: str) -> List[np.ndarray]:
     return [np.asarray(grp[n]) for n in names]
 
 
+def _th_flatten_perm(pre, dim_ordering: str):
+    """Row-permutation indices for features crossing a CNN->dense flatten
+    in a th-ordered file: the file indexes the feature map channel-first
+    [c, h, w], the framework flattens NHWC [h, w, c]. Returns None when no
+    permutation applies. Validated against a real Keras-1.1.2 model
+    (tests/test_keras_import.py::TestRealKerasGoldenFile); reference
+    analog: dl4j stays NCHW so its th flatten matches natively, while its
+    tf path uses TensorFlowCnnToFeedForwardPreProcessor."""
+    if dim_ordering != "th" or type(pre).__name__ != \
+            "CnnToFeedForwardPreProcessor":
+        return None
+    h, w, c = pre.input_height, pre.input_width, pre.num_channels
+    return np.arange(c * h * w).reshape(c, h, w).transpose(1, 2, 0).reshape(-1)
+
+
 def _apply_weights(net, weight_map, weights_root, key_for_index,
-                   conf_for_index) -> None:
+                   conf_for_index, preproc_for_index=lambda i: None,
+                   dim_ordering: str = "th") -> None:
     import jax.numpy as jnp
 
     for our_idx, (kl, kind) in weight_map.items():
@@ -516,6 +540,9 @@ def _apply_weights(net, weight_map, weights_root, key_for_index,
                 raise KerasImportException(
                     f"Dense weight shape {W.shape} != "
                     f"({conf.n_in}, {conf.n_out}) for {kl.name!r}")
+            idx = _th_flatten_perm(preproc_for_index(our_idx), dim_ordering)
+            if idx is not None:
+                W = W[idx]
             tgt["W"] = jnp.asarray(W, dtype)
             if "b" in tgt:
                 tgt["b"] = jnp.asarray(b, dtype)
@@ -532,6 +559,12 @@ def _apply_weights(net, weight_map, weights_root, key_for_index,
                 tgt[k] = jnp.asarray(v, dtype)
         elif kind == "batchnorm":
             gamma, beta, mean, var = arrays[:4]
+            # A th-file BN between Flatten and the first Dense carries its
+            # per-feature vectors in channel-first order too.
+            idx = _th_flatten_perm(preproc_for_index(our_idx), dim_ordering)
+            if idx is not None and gamma.shape[0] == idx.shape[0]:
+                gamma, beta, mean, var = (a[idx] for a in
+                                          (gamma, beta, mean, var))
             tgt["gamma"] = jnp.asarray(gamma, dtype)
             tgt["beta"] = jnp.asarray(beta, dtype)
             st = dict(net.state.get(lk, {}))
@@ -607,9 +640,27 @@ def import_keras_sequential_model_and_weights(path, input_type: Optional[InputTy
             builder.layer(layer)
         mln_conf = builder.set_input_type(itype).build()
         net = MultiLayerNetwork(mln_conf).init()
+        def flatten_preproc(i):
+            # The flatten preprocessor may sit a few indices before the
+            # dense (param-free Dropout/Activation/BN in between); only the
+            # FIRST weighted layer after it sees channel-ordered features.
+            # The barrier check must precede the preprocessor lookup for
+            # j < i: a preprocessor AT a weighted layer's index belongs to
+            # that layer, not to a later one.
+            for j in range(i, -1, -1):
+                if j < i and type(net.layers[j]).__name__ in (
+                        "DenseLayer", "ConvolutionLayer", "OutputLayer"):
+                    return None
+                pre = mln_conf.input_preprocessors.get(j)
+                if pre is not None:
+                    return pre
+            return None
+
         _apply_weights(net, conv.weight_map, weights_root,
                        lambda i: net.layer_keys[i],
-                       lambda i: net.layers[i])
+                       lambda i: net.layers[i],
+                       flatten_preproc,
+                       conv.dim_ordering)
         return net
     finally:
         f.close()
@@ -740,7 +791,10 @@ def import_keras_model_and_weights(path):
         _apply_weights(
             net, wmap, weights_root,
             lambda i: weight_jobs[i][0],
-            lambda i: net.layer_vertices[weight_jobs[i][0]].layer)
+            lambda i: net.layer_vertices[weight_jobs[i][0]].layer,
+            lambda i: getattr(
+                graph_conf.vertices[weight_jobs[i][0]], "preprocessor", None),
+            default_ordering)
         return net
     finally:
         f.close()

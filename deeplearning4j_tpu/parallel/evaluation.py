@@ -86,16 +86,24 @@ def sharded_evaluate(net, iterator, mesh=None, top_n: int = 1,
             iterator.reset()
         except Exception:
             pass
-    if isinstance(iterator, DataSet):
+    from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+
+    if isinstance(iterator, (DataSet, MultiDataSet)):
         iterator = [iterator]
     for ds in iterator:
-        feats = ds.features[0] if (is_graph and isinstance(ds.features, (list, tuple))) else ds.features
-        labels = ds.labels[0] if (is_graph and isinstance(ds.labels, (list, tuple))) else ds.labels
-        fmask, lmask = ds.features_mask, ds.labels_mask
-        if is_graph and isinstance(fmask, (list, tuple)):
-            fmask = fmask[0]
-        if is_graph and isinstance(lmask, (list, tuple)):
-            lmask = lmask[0]
+        if isinstance(ds, MultiDataSet):
+            if len(ds.features) > 1 or len(ds.labels) > 1:
+                raise ValueError(
+                    "sharded_evaluate supports single-input/single-output "
+                    f"graphs only (got {len(ds.features)} inputs, "
+                    f"{len(ds.labels)} outputs); evaluate multi-IO graphs "
+                    "with net.evaluate")
+            feats, labels = ds.features[0], ds.labels[0]
+            fmask = None if ds.features_masks is None else ds.features_masks[0]
+            lmask = None if ds.labels_masks is None else ds.labels_masks[0]
+        else:
+            feats, labels = ds.features, ds.labels
+            fmask, lmask = ds.features_mask, ds.labels_mask
         b = feats.shape[0]
         padded = -(-b // n_dev) * n_dev
         if padded != b:

@@ -334,3 +334,28 @@ class TestShardedEvaluation:
         np.testing.assert_array_equal(merged.confusion.matrix,
                                       ref.confusion.matrix)
         assert merged.accuracy() == ref.accuracy()
+
+    def test_computation_graph_sharded_eval(self, rng):
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+        from deeplearning4j_tpu.parallel.evaluation import sharded_evaluate
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        n, f, c = 40, 4, 3
+        X = rng.randn(n, f).astype("float64")
+        Y = np.eye(c)[rng.randint(0, c, n)].astype("float64")
+        gb = (NeuralNetConfiguration.builder()
+              .seed(2).learning_rate(0.1).updater("sgd").weight_init("xavier")
+              .graph_builder()
+              .add_inputs("in")
+              .add_layer("d", DenseLayer(n_out=8, activation="tanh"), "in")
+              .add_layer("out", OutputLayer(n_out=c, activation="softmax",
+                                            loss_function="mcxent"), "d")
+              .set_outputs("out"))
+        gb.set_input_types(InputType.feed_forward(f))
+        net = ComputationGraph(gb.build()).init()
+        mds = MultiDataSet(features=[X], labels=[Y])
+        net.fit(mds)
+        ref = net.evaluate(mds)
+        ev = sharded_evaluate(net, mds)
+        np.testing.assert_array_equal(ev.confusion.matrix, ref.confusion.matrix)
+        assert ev.total == ref.total == n
