@@ -1,0 +1,93 @@
+"""Native (C++) runtime components.
+
+The reference's runtime around the compute path is native (ND4J C++ ops,
+DataVec's JavaCV-backed readers); the TPU build keeps XLA as the compute
+path and implements its IO hot paths in C++ too. Modules here are built
+with `g++` on first use (no pybind11 in the image — plain `extern "C"` +
+ctypes) and every caller has a pure-Python fallback, so the package works
+on machines without a toolchain.
+
+Current components:
+- `fastcsv` — numeric CSV -> float32 matrix parser
+  (`parse_numeric_csv`), used by `datasets/records.py`'s
+  `CSVRecordReader.numeric_matrix`. ~4x the csv-module path on a
+  100k x 10 file (PERF.md §7).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_LIB_FAILED = False
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    src = os.path.join(_HERE, "fastcsv.cpp")
+    so = os.path.join(_HERE, "_fastcsv.so")
+    try:
+        if (not os.path.exists(so)
+                or os.path.getmtime(so) < os.path.getmtime(src)):
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                 "-o", so + ".tmp", src],
+                check=True, capture_output=True, timeout=120)
+            os.replace(so + ".tmp", so)
+        lib = ctypes.CDLL(so)
+        lib.csv_dims.restype = ctypes.c_long
+        lib.csv_dims.argtypes = [
+            ctypes.c_char_p, ctypes.c_char, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_long)]
+        lib.csv_parse.restype = ctypes.c_long
+        lib.csv_parse.argtypes = [
+            ctypes.c_char_p, ctypes.c_char, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_float), ctypes.c_long, ctypes.c_long]
+        return lib
+    except Exception:
+        return None
+
+
+def _lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _LIB_FAILED
+    if _LIB is None and not _LIB_FAILED:
+        with _LOCK:
+            if _LIB is None and not _LIB_FAILED:
+                _LIB = _build_and_load()
+                _LIB_FAILED = _LIB is None
+    return _LIB
+
+
+def native_available() -> bool:
+    return _lib() is not None
+
+
+def parse_numeric_csv(path: str, delimiter: str = ",",
+                      skip: int = 0) -> Optional[np.ndarray]:
+    """Parse an all-numeric CSV into a float32 [rows, cols] matrix with the
+    native parser. Returns None when the native library is unavailable OR
+    the file isn't uniformly numeric (callers fall back to the Python
+    reader — same result, slower)."""
+    lib = _lib()
+    if lib is None or len(delimiter.encode()) != 1:
+        return None
+    rows = ctypes.c_long()
+    cols = ctypes.c_long()
+    p = os.fsencode(path)
+    d = delimiter.encode()
+    if lib.csv_dims(p, d, skip, ctypes.byref(rows), ctypes.byref(cols)) != 0:
+        return None
+    if rows.value == 0 or cols.value == 0:
+        return np.zeros((rows.value, cols.value), np.float32)
+    out = np.empty((rows.value, cols.value), np.float32)
+    rc = lib.csv_parse(p, d, skip,
+                       out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                       rows.value, cols.value)
+    return out if rc == 0 else None

@@ -1,0 +1,138 @@
+// Native numeric-CSV parser (the DataVec-bridge hot path).
+//
+// The reference's data-ingestion layer is native-backed (DataVec's readers
+// sit on JavaCV/opencv and ND4J native buffers); this is the TPU build's
+// analog for tabular data: a single-allocation two-pass parser that turns a
+// numeric CSV straight into a float32 matrix at C speed. Python fallback
+// lives in datasets/records.py; deeplearning4j_tpu/native/__init__.py
+// builds this file with g++ on first use and loads it via ctypes (no
+// pybind11 in the image).
+//
+// Exported contract (all returns: 0 ok, -1 file error, -2 non-numeric
+// field, -3 ragged rows):
+//   csv_dims(path, delim, skip, &rows, &cols)   -- count data rows/cols
+//   csv_parse(path, delim, skip, out, rows, cols) -- fill out[rows*cols]
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+// Read the whole file + a trailing NUL (so strtof can never overrun);
+// empty vector on failure. The NUL is part of the vector: use
+// `content_end()` for the logical end of the file data.
+std::vector<char> slurp(const char* path) {
+    std::vector<char> buf;
+    FILE* f = std::fopen(path, "rb");
+    if (!f) return buf;
+    std::fseek(f, 0, SEEK_END);
+    long n = std::ftell(f);
+    std::fseek(f, 0, SEEK_SET);
+    if (n > 0) {
+        buf.resize(static_cast<size_t>(n) + 1);
+        if (std::fread(buf.data(), 1, static_cast<size_t>(n), f) !=
+            static_cast<size_t>(n)) {
+            buf.clear();
+        } else {
+            buf.back() = '\0';
+        }
+    }
+    std::fclose(f);
+    return buf;
+}
+
+struct LineWalker {
+    const char* p;
+    const char* end;
+    explicit LineWalker(const std::vector<char>& b)  // excludes the NUL
+        : p(b.data()), end(b.data() + b.size() - 1) {}
+    // Next line [begin, stop) INCLUDING blank ones (callers count every
+    // line toward `skip`, exactly like the Python csv.reader fallback,
+    // then drop blanks); false at EOF.
+    bool next(const char** begin, const char** stop) {
+        if (p >= end) return false;
+        const char* line = p;
+        const char* nl = static_cast<const char*>(
+            std::memchr(p, '\n', static_cast<size_t>(end - p)));
+        const char* e = nl ? nl : end;
+        p = nl ? nl + 1 : end;
+        if (e > line && e[-1] == '\r') --e;
+        *begin = line;
+        *stop = e;
+        return true;
+    }
+};
+
+long count_fields(const char* b, const char* e, char delim) {
+    long n = 1;
+    for (const char* q = b; q < e; ++q)
+        if (*q == delim) ++n;
+    return n;
+}
+
+}  // namespace
+
+extern "C" {
+
+long csv_dims(const char* path, char delim, long skip, long* rows,
+              long* cols) {
+    std::vector<char> buf = slurp(path);
+    if (buf.empty()) return -1;
+    LineWalker w(buf);
+    const char *b, *e;
+    long line_no = 0, nrows = 0, ncols = 0;
+    while (w.next(&b, &e)) {
+        if (line_no++ < skip) continue;
+        if (b == e) continue;  // blank line (counted toward skip above)
+        long c = count_fields(b, e, delim);
+        if (ncols == 0) ncols = c;
+        else if (c != ncols) return -3;
+        ++nrows;
+    }
+    *rows = nrows;
+    *cols = ncols;
+    return 0;
+}
+
+long csv_parse(const char* path, char delim, long skip, float* out,
+               long rows, long cols) {
+    std::vector<char> buf = slurp(path);
+    if (buf.empty()) return -1;
+    LineWalker w(buf);
+    const char *b, *e;
+    long line_no = 0, r = 0;
+    while (w.next(&b, &e)) {
+        if (line_no++ < skip) continue;
+        if (b == e) continue;  // blank line
+        if (r >= rows) return -3;
+        long c = 0;
+        const char* q = b;
+        while (q <= e) {
+            const char* field_end = q;
+            while (field_end < e && *field_end != delim) ++field_end;
+            if (c >= cols || field_end == q) return -2;
+            // strtof directly on the buffer: the delimiter/newline byte
+            // after the field stops the parse (slurp() NUL-terminates the
+            // whole buffer so the final field is safe too).
+            // Python float() rejects C hex-float literals; stay in sync
+            // so native vs fallback never disagree on the same file.
+            for (const char* hx = q; hx < field_end; ++hx)
+                if (*hx == 'x' || *hx == 'X') return -2;
+            char* endp = nullptr;
+            float v = std::strtof(q, &endp);
+            while (endp < field_end && *endp == ' ') ++endp;
+            if (endp != field_end) return -2;
+            out[r * cols + c] = v;
+            ++c;
+            q = field_end + 1;
+            if (field_end == e) break;
+        }
+        if (c != cols) return -3;
+        ++r;
+    }
+    return r == rows ? 0 : -3;
+}
+
+}  // extern "C"

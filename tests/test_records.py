@@ -275,3 +275,87 @@ class TestEndToEnd:
         ev = net.evaluate(it)
         # Only the 5 real rows are counted, not the 3 padding rows.
         assert int(ev.confusion.matrix.sum()) == 5
+
+
+class TestNativeCsv:
+    """The C++ fastcsv parser (deeplearning4j_tpu/native) must agree with
+    the Python reader exactly, and fall back gracefully."""
+
+    def test_native_matches_python(self, tmp_path, rng):
+        from deeplearning4j_tpu import native as native_mod
+        from deeplearning4j_tpu.datasets.records import CSVRecordReader
+
+        data = rng.randn(50, 7).astype("float32")
+        path = str(tmp_path / "m.csv")
+        np.savetxt(path, data, delimiter=",", fmt="%.6g",
+                   header="a,b,c,d,e,f,g", comments="")
+        rr = CSVRecordReader(skip_num_lines=1).initialize(path)
+        m = rr.numeric_matrix()
+        py = np.asarray([[float(v) for v in row] for row in rr.records()],
+                        np.float32)
+        np.testing.assert_allclose(m, py, rtol=1e-6)
+        assert m.dtype == np.float32 and m.shape == (50, 7)
+        # When the toolchain exists, the native path must actually be used.
+        if native_mod.native_available():
+            nat = native_mod.parse_numeric_csv(path, ",", 1)
+            np.testing.assert_array_equal(nat, m)
+
+    def test_non_numeric_falls_back(self, tmp_path):
+        from deeplearning4j_tpu import native as native_mod
+        from deeplearning4j_tpu.datasets.records import CSVRecordReader
+
+        path = str(tmp_path / "s.csv")
+        with open(path, "w") as f:
+            f.write("1.0,2.0\n3.0,oops\n")
+        # Native parser refuses (returns None)…
+        if native_mod.native_available():
+            assert native_mod.parse_numeric_csv(path, ",", 0) is None
+        # …and numeric_matrix surfaces the Python error for bad floats.
+        rr = CSVRecordReader().initialize(path)
+        with pytest.raises(ValueError):
+            rr.numeric_matrix()
+
+    def test_ragged_rejected_by_native(self, tmp_path):
+        from deeplearning4j_tpu import native as native_mod
+
+        if not native_mod.native_available():
+            pytest.skip("no toolchain")
+        path = str(tmp_path / "r.csv")
+        with open(path, "w") as f:
+            f.write("1,2,3\n4,5\n")
+        assert native_mod.parse_numeric_csv(path, ",", 0) is None
+
+    def test_blank_line_skip_parity_and_hex_rejection(self, tmp_path):
+        from deeplearning4j_tpu import native as native_mod
+
+        if not native_mod.native_available():
+            pytest.skip("no toolchain")
+        # Blank lines count toward skip in BOTH paths (csv.reader parity).
+        path = str(tmp_path / "b.csv")
+        with open(path, "w") as f:
+            f.write("\nheader,h2\n1,2\n3,4\n")
+        from deeplearning4j_tpu.datasets.records import CSVRecordReader
+        rr = CSVRecordReader(skip_num_lines=2).initialize(path)
+        m = rr.numeric_matrix()
+        np.testing.assert_array_equal(m, [[1, 2], [3, 4]])
+        assert native_mod.parse_numeric_csv(path, ",", 2) is not None
+        # Hex floats: Python float() rejects them; native must too.
+        path2 = str(tmp_path / "h.csv")
+        with open(path2, "w") as f:
+            f.write("1.0,0x10\n")
+        assert native_mod.parse_numeric_csv(path2, ",", 0) is None
+
+    def test_empty_and_multibyte_delimiter(self, tmp_path):
+        from deeplearning4j_tpu import native as native_mod
+        from deeplearning4j_tpu.datasets.records import CSVRecordReader
+
+        empty = str(tmp_path / "e.csv")
+        open(empty, "w").close()
+        data = str(tmp_path / "d.csv")
+        with open(data, "w") as f:
+            f.write("1,2\n3,4\n")
+        m = CSVRecordReader().initialize([empty, data]).numeric_matrix()
+        np.testing.assert_array_equal(m, [[1, 2], [3, 4]])
+        assert CSVRecordReader().initialize(empty).numeric_matrix().shape == (0, 0)
+        # Multibyte delimiter: documented None, not a ctypes explosion.
+        assert native_mod.parse_numeric_csv(data, "é", 0) is None
