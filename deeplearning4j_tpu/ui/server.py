@@ -12,6 +12,8 @@ attaches to a `StatsStorage` and serves
 - `/model`               — model overview table: layers, types, hyperparams
                            from the static-info config JSON (reference
                            `TrainModule.java:92-99` model route)
+- `/system`              — device memory / host RSS / throughput charts
+                           (reference `TrainModule` system tab)
 - `/api/sessions`        — session ids
 - `/api/static?sid=`     — model static info
 - `/api/updates?sid=`    — the full update stream as JSON
@@ -37,22 +39,17 @@ from urllib.parse import parse_qs, urlparse
 
 from deeplearning4j_tpu.api.storage import StatsStorage
 
-_PAGE = """<!doctype html>
-<html><head><title>deeplearning4j-tpu training UI</title>
-<style>
+_STYLE = """<style>
  body { font-family: sans-serif; margin: 2em; background: #fafafa; }
  h1 { font-size: 1.3em; } h2 { font-size: 1.05em; margin-top: 1.5em; }
  .chart { border: 1px solid #ccc; background: #fff; }
  #meta { color: #555; font-size: 0.9em; white-space: pre-line; }
-</style></head>
-<body>
-<h1>deeplearning4j-tpu training UI</h1>
-<div id="meta">loading…</div>
-<h2>Score</h2><canvas id="score" class="chart" width="860" height="240"></canvas>
-<h2>Per-layer mean magnitudes (updates)</h2>
-<canvas id="mm" class="chart" width="860" height="240"></canvas>
-<script>
-function drawSeries(canvas, series, labels) {
+</style>"""
+
+_NAV = ("<div id=nav><a href=/>overview</a> | <a href=/histogram>histograms</a> | <a href=/model>model</a> | <a href=/system>system</a></div>")
+
+# Shared canvas line-chart renderer, interpolated into every page.
+_CHART_JS = """function drawSeries(canvas, series, labels) {
   const ctx = canvas.getContext('2d');
   ctx.clearRect(0, 0, canvas.width, canvas.height);
   const all = series.flatMap(s => s.pts.map(p => p[1]))
@@ -78,7 +75,21 @@ function drawSeries(canvas, series, labels) {
   ctx.fillText(ymax.toPrecision(4), 2, 14);
   ctx.fillText(ymin.toPrecision(4), 2, canvas.height - 8);
 }
-async function refresh() {
+"""
+
+
+_PAGE = """<!doctype html>
+<html><head><title>deeplearning4j-tpu training UI</title>
+{style}</head>
+<body>
+<h1>deeplearning4j-tpu training UI</h1>
+{nav}
+<div id="meta">loading…</div>
+<h2>Score</h2><canvas id="score" class="chart" width="860" height="240"></canvas>
+<h2>Per-layer mean magnitudes (updates)</h2>
+<canvas id="mm" class="chart" width="860" height="240"></canvas>
+<script>
+{chart_js}async function refresh() {
   const sessions = await (await fetch('api/sessions')).json();
   if (!sessions.length) return;
   const sid = sessions[sessions.length - 1];
@@ -112,6 +123,45 @@ refresh(); setInterval(refresh, 3000);
 """
 
 
+_SYSTEM_PAGE = """<!doctype html>
+<html><head><title>system — deeplearning4j-tpu UI</title>
+{style}</head>
+<body>
+<h1>System (reference: TrainModule system tab)</h1>
+{nav}
+<div id="meta">loading…</div>
+<h2>Device memory in use (MiB)</h2>
+<canvas id="dev" class="chart" width="860" height="220"></canvas>
+<h2>Host process RSS (MiB)</h2>
+<canvas id="host" class="chart" width="860" height="220"></canvas>
+<h2>Throughput (iterations/sec)</h2>
+<canvas id="tput" class="chart" width="860" height="220"></canvas>
+<script>
+{chart_js}
+async function refresh() {
+  const sessions = await (await fetch('api/sessions')).json();
+  if (!sessions.length) return;
+  const sid = sessions[sessions.length - 1];
+  const updates = await (await fetch('api/updates?sid=' + sid)).json();
+  const info = await (await fetch('api/static?sid=' + sid)).json();
+  document.getElementById('meta').textContent =
+    'session ' + sid + ' — ' + (info.model_class || '?') + ' — ' +
+    updates.length + ' samples';
+  drawSeries(document.getElementById('dev'),
+    [{name: 'bytes_in_use', pts: updates.filter(u => u.device_memory)
+      .map(u => [u.iteration, u.device_memory.bytes_in_use / 1048576])}]);
+  drawSeries(document.getElementById('host'),
+    [{name: 'host_rss_mb', pts: updates.filter(u => u.host_rss_mb)
+      .map(u => [u.iteration, u.host_rss_mb])}]);
+  drawSeries(document.getElementById('tput'),
+    [{name: 'it/s', pts: updates.filter(u => u.iterations_per_sec)
+      .map(u => [u.iteration, u.iterations_per_sec])}]);
+}
+refresh(); setInterval(refresh, 3000);
+</script></body></html>
+"""
+
+
 _HISTOGRAM_PAGE = """<!doctype html>
 <html><head><title>parameter histograms</title>
 <style>
@@ -121,7 +171,7 @@ _HISTOGRAM_PAGE = """<!doctype html>
  a { color: #1565c0; }
 </style></head>
 <body>
-<h1>Parameter histograms <a href="/">overview</a> <a href="/model">model</a></h1>
+<h1>Parameter histograms <a href="/">overview</a> <a href="/model">model</a> <a href="/system">system</a></h1>
 <div id="charts">loading…</div>
 <script>
 function drawHist(canvas, hist) {
@@ -171,7 +221,7 @@ _MODEL_PAGE = """<!doctype html>
        max-width: 900px; overflow: auto; font-size: 0.8em; }
 </style></head>
 <body>
-<h1>Model <a href="/">overview</a> <a href="/histogram">histograms</a></h1>
+<h1>Model <a href="/">overview</a> <a href="/histogram">histograms</a> <a href="/system">system</a></h1>
 <div id="meta"></div>
 <table id="layers"><tr><th>#</th><th>layer</th><th>type</th>
 <th>n_in</th><th>n_out</th><th>activation</th></tr></table>
@@ -202,6 +252,13 @@ async function refresh() {
 refresh();
 </script></body></html>
 """
+
+
+for _n in ("_PAGE", "_HISTOGRAM_PAGE", "_MODEL_PAGE", "_SYSTEM_PAGE"):
+    globals()[_n] = (globals()[_n]
+                     .replace("{style}", _STYLE)
+                     .replace("{chart_js}", _CHART_JS)
+                     .replace("{nav}", _NAV))
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -260,6 +317,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._html(_HISTOGRAM_PAGE)
         elif url.path == "/model":
             self._html(_MODEL_PAGE)
+        elif url.path == "/system":
+            self._html(_SYSTEM_PAGE)
         elif url.path == "/api/sessions":
             self._json(storage.list_session_ids() if storage else [])
         elif url.path == "/api/static":

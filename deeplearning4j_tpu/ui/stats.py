@@ -24,6 +24,29 @@ from deeplearning4j_tpu.api.storage import StatsStorageRouter
 from deeplearning4j_tpu.optimize.listeners import IterationListener
 
 
+def _host_rss_mb():
+    """CURRENT process resident-set size in MiB (the process-level analog
+    of the reference BaseStatsListener's JVM memory reporting). Prefers
+    /proc/self/statm (live value, Linux); falls back to getrusage peak RSS
+    with the platform's unit (KiB on Linux, bytes on macOS)."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        import os
+
+        return pages * os.sysconf("SC_PAGE_SIZE") / 1048576.0
+    except Exception:
+        pass
+    try:
+        import resource
+        import sys
+
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return peak / (1048576.0 if sys.platform == "darwin" else 1024.0)
+    except Exception:
+        return None
+
+
 class StatsListener(IterationListener):
     """See module docstring. `frequency` = sample every N iterations."""
 
@@ -116,6 +139,9 @@ class StatsListener(IterationListener):
         mem = self._device_memory()
         if mem:
             record["device_memory"] = mem
+        rss = _host_rss_mb()
+        if rss is not None:
+            record["host_rss_mb"] = rss
         self.storage.put_update(record)
 
 

@@ -261,3 +261,33 @@ class TestEpochHooks:
         x, y = batch(rng)
         net.fit(x, y)
         assert spy.starts == 1 and spy.ends == 1 and spy.iters == 1
+
+
+class TestSystemPage:
+    def test_system_page_and_host_rss(self, rng):
+        """The /system page serves, and update records carry host RSS +
+        device memory (reference: TrainModule system tab +
+        BaseStatsListener memory reporting)."""
+        import urllib.request
+
+        from deeplearning4j_tpu.api.storage import InMemoryStatsStorage
+        from deeplearning4j_tpu.ui.server import UIServer
+        from deeplearning4j_tpu.ui.stats import StatsListener
+
+        storage = InMemoryStatsStorage()
+        net = mlp_net()
+        net.set_listeners(StatsListener(storage, frequency=1,
+                                        collect_histograms=False))
+        X, Y = batch(rng)
+        for _ in range(3):
+            net.fit(X, Y)
+        sid = storage.list_session_ids()[0]
+        ups = storage.get_updates(sid)
+        assert any("host_rss_mb" in u and u["host_rss_mb"] > 0 for u in ups)
+        server = UIServer(port=0).attach(storage).start()
+        try:
+            html = urllib.request.urlopen(server.url + "/system",
+                                          timeout=10).read().decode()
+            assert "Device memory" in html and "host_rss_mb" in html
+        finally:
+            server.stop()
