@@ -276,8 +276,14 @@ def bench_word2vec(steps, warmup):
     idx = rng.choice(V, size=n_words, p=p)
     sents = [[words[j] for j in idx[i:i + sent_len]]
              for i in range(0, n_words, sent_len)]
-    w2v = Word2Vec(layer_size=100, window_size=5, min_word_frequency=1,
-                   sample=1e-3, negative=0, seed=1, batch_size=16384)
+    kw = dict(layer_size=100, window_size=5, min_word_frequency=1,
+              sample=1e-3, negative=0, seed=1, batch_size=16384)
+    # Warm the compiled programs on the full corpus (kernel shapes depend
+    # on vocab size + Huffman depth, so a prefix would leave the timed run
+    # recompiling); the timed second fit is steady-state throughput, the
+    # way the reference's PerformanceListener reports it.
+    Word2Vec(**kw).fit(sents)
+    w2v = Word2Vec(**kw)
     t0 = time.perf_counter()
     w2v.fit(sents)
     dt = time.perf_counter() - t0

@@ -252,17 +252,19 @@ class Word2Vec(WordVectors):
                         self.syn0, self.syn1neg, put(buf_center),
                         put(targets), put(labels),
                         put(pm), jnp.float32(lr))
-            elif self.cbow:
-                self.syn0, self.syn1 = kernels.hs_cbow_step_tbl(
-                    self.syn0, self.syn1, put(buf_ctx),
-                    put(buf_ctx_mask), put(buf_word),
-                    codes_dev, points_dev, cmask_dev, put(pm),
-                    jnp.float32(lr))
+            elif self.mesh is None:
+                # HS single-chip: queue K flushes and dispatch them as ONE
+                # jitted scan — per-dispatch host cost dominates otherwise
+                # (PERF.md §5); the scan applies them in the same order, so
+                # results are identical to per-flush dispatch.
+                scan_q.append((buf_ctx if self.cbow else buf_center,
+                               buf_ctx_mask, buf_word, pm, np.float32(lr)))
+                if len(scan_q) == K_SCAN:
+                    dispatch_scan()
             else:
-                self.syn0, self.syn1 = kernels.hs_skipgram_step_tbl(
-                    self.syn0, self.syn1, put(buf_center),
-                    put(buf_word), codes_dev, points_dev, cmask_dev,
-                    put(pm), jnp.float32(lr))
+                # HS on a mesh: per-flush dispatch with sharded buffers.
+                hs_step_single(buf_ctx if self.cbow else buf_center,
+                               buf_ctx_mask, buf_word, pm, lr, put)
 
         # Vectorized training-example assembly (the per-position Python loop
         # it replaces was the measured bottleneck — ~8 k words/s host-bound
@@ -278,6 +280,51 @@ class Word2Vec(WordVectors):
         def lr_now():
             return max(self.min_learning_rate,
                        self.learning_rate * (1 - words_done / max(total_words, 1)))
+
+        K_SCAN = 8
+        scan_q: List = []
+
+        def hs_step_single(ctx_or_c, cm, w, pm, lr, put_fn):
+            """The one single-step HS call site (mesh flushes and scan-queue
+            leftovers both go through here)."""
+            if self.cbow:
+                self.syn0, self.syn1 = kernels.hs_cbow_step_tbl(
+                    self.syn0, self.syn1, put_fn(ctx_or_c), put_fn(cm),
+                    put_fn(w), codes_dev, points_dev, cmask_dev, put_fn(pm),
+                    jnp.float32(lr))
+            else:
+                self.syn0, self.syn1 = kernels.hs_skipgram_step_tbl(
+                    self.syn0, self.syn1, put_fn(ctx_or_c), put_fn(w),
+                    codes_dev, points_dev, cmask_dev, put_fn(pm),
+                    jnp.float32(lr))
+
+        def dispatch_scan():
+            if not scan_q:
+                return
+            if len(scan_q) < K_SCAN:
+                # Leftovers reuse the single-step program (a k-specific
+                # scan would compile once per distinct leftover count).
+                for ctx_or_c, cm, w, pm, lr in scan_q:
+                    hs_step_single(ctx_or_c, cm, w, pm, lr, jnp.asarray)
+                scan_q.clear()
+                return
+            stacked_ctx = np.stack([q[0] for q in scan_q])
+            words_s = np.stack([q[2] for q in scan_q])
+            pms = np.stack([q[3] for q in scan_q])
+            lrs = np.asarray([q[4] for q in scan_q], np.float32)
+            if self.cbow:
+                cms = np.stack([q[1] for q in scan_q])
+                self.syn0, self.syn1 = kernels.hs_cbow_scan_tbl(
+                    self.syn0, self.syn1, jnp.asarray(stacked_ctx),
+                    jnp.asarray(cms), jnp.asarray(words_s), codes_dev,
+                    points_dev, cmask_dev, jnp.asarray(pms),
+                    jnp.asarray(lrs))
+            else:
+                self.syn0, self.syn1 = kernels.hs_skipgram_scan_tbl(
+                    self.syn0, self.syn1, jnp.asarray(stacked_ctx),
+                    jnp.asarray(words_s), codes_dev, points_dev, cmask_dev,
+                    jnp.asarray(pms), jnp.asarray(lrs))
+            scan_q.clear()
 
         def flush_slice(cols, k, count, lr):
             """Pad examples [k:k+count] into fixed-B buffers and flush."""
@@ -350,5 +397,6 @@ class Word2Vec(WordVectors):
                 drain()
                 words_done += n
         drain(final=True)
+        dispatch_scan()  # leftover queued HS flushes (any K compiles once)
         WordVectors.__init__(self, self.vocab, np.asarray(self.syn0))
         return self

@@ -192,3 +192,41 @@ def hs_cbow_step_tbl(syn0, syn1, context, context_mask, words, codes_tbl,
     return hs_cbow_step.__wrapped__(
         syn0, syn1, context, context_mask, codes_tbl[words],
         points_tbl[words], cmask_tbl[words], pair_mask, lr)
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def hs_skipgram_scan_tbl(syn0, syn1, centers, words, codes_tbl, points_tbl,
+                         cmask_tbl, pair_mask, lrs):
+    """K stacked HS skip-gram batches in ONE dispatch: `lax.scan` of
+    `hs_skipgram_step_tbl` over the leading K axis. Each host dispatch
+    costs milliseconds over a tunneled transport (PERF.md §4), so the
+    word2vec flush loop batches K flushes per dispatch.
+
+    centers/words/pair_mask: [K, B]; lrs: [K]."""
+    def body(carry, inp):
+        syn0, syn1 = carry
+        c, w, pm, lr = inp
+        syn0, syn1 = hs_skipgram_step_tbl.__wrapped__(
+            syn0, syn1, c, w, codes_tbl, points_tbl, cmask_tbl, pm, lr)
+        return (syn0, syn1), None
+
+    (syn0, syn1), _ = jax.lax.scan(
+        body, (syn0, syn1), (centers, words, pair_mask, lrs))
+    return syn0, syn1
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def hs_cbow_scan_tbl(syn0, syn1, context, context_mask, words, codes_tbl,
+                     points_tbl, cmask_tbl, pair_mask, lrs):
+    """K stacked HS CBOW batches in one dispatch (see hs_skipgram_scan_tbl).
+    context/context_mask: [K, B, W]; words/pair_mask: [K, B]; lrs: [K]."""
+    def body(carry, inp):
+        syn0, syn1 = carry
+        ctx, cm, w, pm, lr = inp
+        syn0, syn1 = hs_cbow_step_tbl.__wrapped__(
+            syn0, syn1, ctx, cm, w, codes_tbl, points_tbl, cmask_tbl, pm, lr)
+        return (syn0, syn1), None
+
+    (syn0, syn1), _ = jax.lax.scan(
+        body, (syn0, syn1), (context, context_mask, words, pair_mask, lrs))
+    return syn0, syn1
