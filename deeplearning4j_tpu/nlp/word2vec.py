@@ -172,6 +172,11 @@ class Word2Vec(WordVectors):
                 self.syn1 = jnp.asarray(np.asarray(self.syn1, np.float32))
         if self.negative > 0:
             self._neg_table = make_unigram_table(self.vocab)
+            # Constant labels (positive first): device-resident, uploaded
+            # once instead of [K, B, 1+neg] per scan dispatch.
+            labels_dev = jnp.zeros(
+                (self.batch_size, 1 + self.negative),
+                jnp.float32).at[:, 0].set(1.0)
 
         max_code = max((len(w.codes) for w in self.vocab._by_index), default=1) or 1
         seqs = [
@@ -234,24 +239,23 @@ class Word2Vec(WordVectors):
             pm[:fill] = 1.0
             if self.negative > 0:
                 # Shared negative-sampling batch: positive word first, then
-                # K unigram-table draws (both CBOW and skip-gram NS modes).
+                # K unigram-table draws (both CBOW and skip-gram NS modes);
+                # the 1/0 labels are the device-resident constant.
                 K = self.negative
                 targets = np.zeros((B, 1 + K), np.int32)
-                labels = np.zeros((B, 1 + K), np.float32)
                 targets[:, 0] = buf_word
-                labels[:, 0] = 1.0
                 targets[:, 1:] = self._neg_table[
                     rng.randint(0, len(self._neg_table), (B, K))]
-                if self.cbow:
-                    self.syn0, self.syn1neg = kernels.ns_cbow_step(
-                        self.syn0, self.syn1neg, put(buf_ctx),
-                        put(buf_ctx_mask), put(targets),
-                        put(labels), put(pm), jnp.float32(lr))
+                if self.mesh is None:
+                    # Single-chip: queue and scan-dispatch like the HS path.
+                    scan_q.append((buf_ctx if self.cbow else buf_center,
+                                   buf_ctx_mask, targets, pm,
+                                   np.float32(lr)))
+                    if len(scan_q) == K_SCAN:
+                        dispatch_scan()
                 else:
-                    self.syn0, self.syn1neg = kernels.ns_skipgram_step(
-                        self.syn0, self.syn1neg, put(buf_center),
-                        put(targets), put(labels),
-                        put(pm), jnp.float32(lr))
+                    ns_step_single(buf_ctx if self.cbow else buf_center,
+                                   buf_ctx_mask, targets, pm, lr, put)
             elif self.mesh is None:
                 # HS single-chip: queue K flushes and dispatch them as ONE
                 # jitted scan — per-dispatch host cost dominates otherwise
@@ -298,20 +302,56 @@ class Word2Vec(WordVectors):
                     codes_dev, points_dev, cmask_dev, put_fn(pm),
                     jnp.float32(lr))
 
+        def ns_step_single(ctx_or_c, cm, targets, pm, lr, put_fn=jnp.asarray):
+            """The one single-step NS call site (mesh flushes and scan-queue
+            leftovers)."""
+            if self.cbow:
+                self.syn0, self.syn1neg = kernels.ns_cbow_step(
+                    self.syn0, self.syn1neg, put_fn(ctx_or_c),
+                    put_fn(cm), put_fn(targets),
+                    labels_dev, put_fn(pm), jnp.float32(lr))
+            else:
+                self.syn0, self.syn1neg = kernels.ns_skipgram_step(
+                    self.syn0, self.syn1neg, put_fn(ctx_or_c),
+                    put_fn(targets), labels_dev,
+                    put_fn(pm), jnp.float32(lr))
+
         def dispatch_scan():
             if not scan_q:
                 return
+            ns = self.negative > 0
             if len(scan_q) < K_SCAN:
                 # Leftovers reuse the single-step program (a k-specific
                 # scan would compile once per distinct leftover count).
-                for ctx_or_c, cm, w, pm, lr in scan_q:
-                    hs_step_single(ctx_or_c, cm, w, pm, lr, jnp.asarray)
+                for q in scan_q:
+                    if ns:
+                        ns_step_single(*q)
+                    else:
+                        ctx_or_c, cm, w, pm, lr = q
+                        hs_step_single(ctx_or_c, cm, w, pm, lr, jnp.asarray)
                 scan_q.clear()
                 return
             stacked_ctx = np.stack([q[0] for q in scan_q])
+            lrs = np.asarray([q[-1] for q in scan_q], np.float32)
+            if ns:
+                tgts = np.stack([q[2] for q in scan_q])
+                pms = np.stack([q[3] for q in scan_q])
+                if self.cbow:
+                    cms = np.stack([q[1] for q in scan_q])
+                    self.syn0, self.syn1neg = kernels.ns_cbow_scan(
+                        self.syn0, self.syn1neg, jnp.asarray(stacked_ctx),
+                        jnp.asarray(cms), jnp.asarray(tgts),
+                        labels_dev, jnp.asarray(pms),
+                        jnp.asarray(lrs))
+                else:
+                    self.syn0, self.syn1neg = kernels.ns_skipgram_scan(
+                        self.syn0, self.syn1neg, jnp.asarray(stacked_ctx),
+                        jnp.asarray(tgts), labels_dev,
+                        jnp.asarray(pms), jnp.asarray(lrs))
+                scan_q.clear()
+                return
             words_s = np.stack([q[2] for q in scan_q])
             pms = np.stack([q[3] for q in scan_q])
-            lrs = np.asarray([q[4] for q in scan_q], np.float32)
             if self.cbow:
                 cms = np.stack([q[1] for q in scan_q])
                 self.syn0, self.syn1 = kernels.hs_cbow_scan_tbl(

@@ -230,3 +230,40 @@ def hs_cbow_scan_tbl(syn0, syn1, context, context_mask, words, codes_tbl,
     (syn0, syn1), _ = jax.lax.scan(
         body, (syn0, syn1), (context, context_mask, words, pair_mask, lrs))
     return syn0, syn1
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def ns_skipgram_scan(syn0, syn1neg, centers, targets, labels, pair_mask, lrs):
+    """K stacked NS skip-gram batches in one dispatch (see
+    hs_skipgram_scan_tbl). centers/pair_mask: [K, B]; targets:
+    [K, B, 1+neg]; labels: [B, 1+neg] SHARED across the K batches (it is a
+    constant — positive first, zeros after — so it uploads once, not per
+    dispatch); lrs: [K]."""
+    def body(carry, inp):
+        syn0, syn1neg = carry
+        c, t, pm, lr = inp
+        syn0, syn1neg = ns_skipgram_step.__wrapped__(
+            syn0, syn1neg, c, t, labels, pm, lr)
+        return (syn0, syn1neg), None
+
+    (syn0, syn1neg), _ = jax.lax.scan(
+        body, (syn0, syn1neg), (centers, targets, pair_mask, lrs))
+    return syn0, syn1neg
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def ns_cbow_scan(syn0, syn1neg, context, context_mask, targets, labels,
+                 pair_mask, lrs):
+    """K stacked NS CBOW batches in one dispatch; labels [B, 1+neg] shared
+    (see ns_skipgram_scan)."""
+    def body(carry, inp):
+        syn0, syn1neg = carry
+        ctx, cm, t, pm, lr = inp
+        syn0, syn1neg = ns_cbow_step.__wrapped__(
+            syn0, syn1neg, ctx, cm, t, labels, pm, lr)
+        return (syn0, syn1neg), None
+
+    (syn0, syn1neg), _ = jax.lax.scan(
+        body, (syn0, syn1neg),
+        (context, context_mask, targets, pair_mask, lrs))
+    return syn0, syn1neg
