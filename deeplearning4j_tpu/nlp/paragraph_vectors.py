@@ -99,18 +99,36 @@ class ParagraphVectors:
         total = sum(len(s) for s, _ in seqs) * self.epochs
         done = 0
 
-        def flush(centers, words, count, lr):
+        # K flushes per dispatch via the shared scan-queue protocol
+        # (kernels.ScanDispatchQueue, PERF.md §5).
+        def _one(q):
             nonlocal combined
+            c, w, pm, lr = q
+            combined, self.syn1 = kernels.hs_skipgram_step_tbl(
+                combined, self.syn1, jnp.asarray(c), jnp.asarray(w),
+                codes_dev, points_dev, cmask_dev, jnp.asarray(pm),
+                jnp.float32(lr))
+
+        def _many(qs):
+            nonlocal combined
+            combined, self.syn1 = kernels.hs_skipgram_scan_tbl(
+                combined, self.syn1,
+                jnp.asarray(np.stack([q[0] for q in qs])),
+                jnp.asarray(np.stack([q[1] for q in qs])),
+                codes_dev, points_dev, cmask_dev,
+                jnp.asarray(np.stack([q[2] for q in qs])),
+                jnp.asarray(np.asarray([q[3] for q in qs], np.float32)))
+
+        queue = kernels.ScanDispatchQueue(8, _many, _one)
+
+        def flush(centers, words, count, lr):
             buf_center = np.zeros(B, np.int32)
             buf_word = np.zeros(B, np.int32)
             pm = np.zeros(B, np.float32)
             buf_center[:count] = centers
             buf_word[:count] = words
             pm[:count] = 1.0
-            combined, self.syn1 = kernels.hs_skipgram_step_tbl(
-                combined, self.syn1, jnp.asarray(buf_center),
-                jnp.asarray(buf_word), codes_dev, points_dev, cmask_dev,
-                jnp.asarray(pm), jnp.float32(lr))
+            queue.add((buf_center, buf_word, pm, np.float32(lr)))
 
         pend: List = []
         n_pend = 0
@@ -158,6 +176,7 @@ class ParagraphVectors:
                 drain()
                 done += n
         drain(final=True)
+        queue.drain()  # leftover queued flushes
         self.doc_vectors = combined[:L]
         self.syn0 = combined[L:]
         dv = np.asarray(self.doc_vectors)

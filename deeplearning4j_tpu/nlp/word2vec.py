@@ -248,11 +248,9 @@ class Word2Vec(WordVectors):
                     rng.randint(0, len(self._neg_table), (B, K))]
                 if self.mesh is None:
                     # Single-chip: queue and scan-dispatch like the HS path.
-                    scan_q.append((buf_ctx if self.cbow else buf_center,
-                                   buf_ctx_mask, targets, pm,
-                                   np.float32(lr)))
-                    if len(scan_q) == K_SCAN:
-                        dispatch_scan()
+                    scan_queue.add((buf_ctx if self.cbow else buf_center,
+                                    buf_ctx_mask, targets, pm,
+                                    np.float32(lr)))
                 else:
                     ns_step_single(buf_ctx if self.cbow else buf_center,
                                    buf_ctx_mask, targets, pm, lr, put)
@@ -261,10 +259,8 @@ class Word2Vec(WordVectors):
                 # jitted scan — per-dispatch host cost dominates otherwise
                 # (PERF.md §5); the scan applies them in the same order, so
                 # results are identical to per-flush dispatch.
-                scan_q.append((buf_ctx if self.cbow else buf_center,
-                               buf_ctx_mask, buf_word, pm, np.float32(lr)))
-                if len(scan_q) == K_SCAN:
-                    dispatch_scan()
+                scan_queue.add((buf_ctx if self.cbow else buf_center,
+                                buf_ctx_mask, buf_word, pm, np.float32(lr)))
             else:
                 # HS on a mesh: per-flush dispatch with sharded buffers.
                 hs_step_single(buf_ctx if self.cbow else buf_center,
@@ -286,7 +282,6 @@ class Word2Vec(WordVectors):
                        self.learning_rate * (1 - words_done / max(total_words, 1)))
 
         K_SCAN = 8
-        scan_q: List = []
 
         def hs_step_single(ctx_or_c, cm, w, pm, lr, put_fn):
             """The one single-step HS call site (mesh flushes and scan-queue
@@ -316,28 +311,21 @@ class Word2Vec(WordVectors):
                     put_fn(targets), labels_dev,
                     put_fn(pm), jnp.float32(lr))
 
-        def dispatch_scan():
-            if not scan_q:
-                return
-            ns = self.negative > 0
-            if len(scan_q) < K_SCAN:
-                # Leftovers reuse the single-step program (a k-specific
-                # scan would compile once per distinct leftover count).
-                for q in scan_q:
-                    if ns:
-                        ns_step_single(*q)
-                    else:
-                        ctx_or_c, cm, w, pm, lr = q
-                        hs_step_single(ctx_or_c, cm, w, pm, lr, jnp.asarray)
-                scan_q.clear()
-                return
-            stacked_ctx = np.stack([q[0] for q in scan_q])
-            lrs = np.asarray([q[-1] for q in scan_q], np.float32)
-            if ns:
-                tgts = np.stack([q[2] for q in scan_q])
-                pms = np.stack([q[3] for q in scan_q])
+        def _dispatch_one(q):
+            if self.negative > 0:
+                ns_step_single(*q)
+            else:
+                ctx_or_c, cm, w, pm, lr = q
+                hs_step_single(ctx_or_c, cm, w, pm, lr, jnp.asarray)
+
+        def _dispatch_many(qs):
+            stacked_ctx = np.stack([q[0] for q in qs])
+            lrs = np.asarray([q[-1] for q in qs], np.float32)
+            if self.negative > 0:
+                tgts = np.stack([q[2] for q in qs])
+                pms = np.stack([q[3] for q in qs])
                 if self.cbow:
-                    cms = np.stack([q[1] for q in scan_q])
+                    cms = np.stack([q[1] for q in qs])
                     self.syn0, self.syn1neg = kernels.ns_cbow_scan(
                         self.syn0, self.syn1neg, jnp.asarray(stacked_ctx),
                         jnp.asarray(cms), jnp.asarray(tgts),
@@ -348,12 +336,11 @@ class Word2Vec(WordVectors):
                         self.syn0, self.syn1neg, jnp.asarray(stacked_ctx),
                         jnp.asarray(tgts), labels_dev,
                         jnp.asarray(pms), jnp.asarray(lrs))
-                scan_q.clear()
                 return
-            words_s = np.stack([q[2] for q in scan_q])
-            pms = np.stack([q[3] for q in scan_q])
+            words_s = np.stack([q[2] for q in qs])
+            pms = np.stack([q[3] for q in qs])
             if self.cbow:
-                cms = np.stack([q[1] for q in scan_q])
+                cms = np.stack([q[1] for q in qs])
                 self.syn0, self.syn1 = kernels.hs_cbow_scan_tbl(
                     self.syn0, self.syn1, jnp.asarray(stacked_ctx),
                     jnp.asarray(cms), jnp.asarray(words_s), codes_dev,
@@ -364,7 +351,9 @@ class Word2Vec(WordVectors):
                     self.syn0, self.syn1, jnp.asarray(stacked_ctx),
                     jnp.asarray(words_s), codes_dev, points_dev, cmask_dev,
                     jnp.asarray(pms), jnp.asarray(lrs))
-            scan_q.clear()
+
+        scan_queue = kernels.ScanDispatchQueue(K_SCAN, _dispatch_many,
+                                               _dispatch_one)
 
         def flush_slice(cols, k, count, lr):
             """Pad examples [k:k+count] into fixed-B buffers and flush."""
@@ -437,6 +426,6 @@ class Word2Vec(WordVectors):
                 drain()
                 words_done += n
         drain(final=True)
-        dispatch_scan()  # leftover queued HS flushes (any K compiles once)
+        scan_queue.drain()  # leftover queued flushes
         WordVectors.__init__(self, self.vocab, np.asarray(self.syn0))
         return self

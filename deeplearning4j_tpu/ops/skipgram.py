@@ -267,3 +267,34 @@ def ns_cbow_scan(syn0, syn1neg, context, context_mask, targets, labels,
         body, (syn0, syn1neg),
         (context, context_mask, targets, pair_mask, lrs))
     return syn0, syn1neg
+
+
+class ScanDispatchQueue:
+    """The K-flush dispatch protocol shared by Word2Vec and
+    ParagraphVectors (PERF.md §5): enqueue flush batches; at `k` of them,
+    hand the whole list to `dispatch_many` (one scanned program); any
+    leftover short of `k` goes through `dispatch_one` per batch so only
+    two program shapes ever compile."""
+
+    def __init__(self, k: int, dispatch_many, dispatch_one):
+        self.k = int(k)
+        self._many = dispatch_many
+        self._one = dispatch_one
+        self._q = []
+
+    def add(self, item) -> None:
+        self._q.append(item)
+        if len(self._q) == self.k:
+            self._many(self._q)
+            self._q.clear()
+
+    def drain(self) -> None:
+        """Dispatch whatever is queued (call once at end of training)."""
+        if not self._q:
+            return
+        if len(self._q) == self.k:
+            self._many(self._q)
+        else:
+            for item in self._q:
+                self._one(item)
+        self._q.clear()
