@@ -4,6 +4,7 @@
 - MLP-MNIST (the minimal end-to-end slice)
 - GravesLSTM char-RNN (reference: GravesLSTMCharModellingExample)
 - VGG-16 (reference: Keras-import VGG16 zoo, `keras/trainedmodels/TrainedModels.java:16-19`)
+- AlexNet (reference: the LRN layer's model family, `conf/layers/LocalResponseNormalization.java`)
 
 All built through the public config DSL, so they double as integration tests
 of the builder.
@@ -17,6 +18,7 @@ from deeplearning4j_tpu.nn.conf.layers import (
     ConvolutionLayer,
     DenseLayer,
     GravesLSTM,
+    LocalResponseNormalization,
     OutputLayer,
     RnnOutputLayer,
     SubsamplingLayer,
@@ -106,3 +108,47 @@ def vgg16(n_classes: int = 1000, seed: int = 123, dtype: str = "bfloat16") -> Mu
     b.layer(DenseLayer(n_out=4096, activation="relu"))
     b.layer(OutputLayer(n_out=n_classes, activation="softmax", loss_function="mcxent"))
     return b.set_input_type(InputType.convolutional(224, 224, 3)).build()
+
+
+def alexnet(n_classes: int = 1000, seed: int = 123, image: int = 224,
+            dtype: str = "bfloat16") -> MultiLayerConfiguration:
+    """AlexNet (Krizhevsky et al. 2012) — the model family the reference's
+    LocalResponseNormalization layer exists for
+    (`nn/conf/layers/LocalResponseNormalization.java` cites it) and the
+    dl4j-era examples' large-image CNN: conv11x11/4 + LRN + pool,
+    conv5x5 + LRN + pool, 3x conv3x3, pool, two dense-4096, softmax."""
+    return (
+        NeuralNetConfiguration.builder()
+        .seed(seed).learning_rate(0.01).updater(Updater.NESTEROVS)
+        .momentum(0.9).weight_init("xavier").l2(5e-4).dtype(dtype)
+        .list()
+        .layer(ConvolutionLayer(kernel_size=(11, 11), stride=(4, 4),
+                                n_out=96, activation="relu",
+                                convolution_mode="truncate"))
+        .layer(LocalResponseNormalization())
+        .layer(SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                                stride=(2, 2)))
+        .layer(ConvolutionLayer(kernel_size=(5, 5), stride=(1, 1),
+                                n_out=256, activation="relu",
+                                convolution_mode="same"))
+        .layer(LocalResponseNormalization())
+        .layer(SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                                stride=(2, 2)))
+        .layer(ConvolutionLayer(kernel_size=(3, 3), stride=(1, 1),
+                                n_out=384, activation="relu",
+                                convolution_mode="same"))
+        .layer(ConvolutionLayer(kernel_size=(3, 3), stride=(1, 1),
+                                n_out=384, activation="relu",
+                                convolution_mode="same"))
+        .layer(ConvolutionLayer(kernel_size=(3, 3), stride=(1, 1),
+                                n_out=256, activation="relu",
+                                convolution_mode="same"))
+        .layer(SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                                stride=(2, 2)))
+        .layer(DenseLayer(n_out=4096, activation="relu", dropout=0.5))
+        .layer(DenseLayer(n_out=4096, activation="relu", dropout=0.5))
+        .layer(OutputLayer(n_out=n_classes, activation="softmax",
+                           loss_function="negativeloglikelihood"))
+        .set_input_type(InputType.convolutional(image, image, 3))
+        .build()
+    )

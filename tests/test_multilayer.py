@@ -327,3 +327,20 @@ class TestTbpttScanPath:
         slow.fit(mds)
         np.testing.assert_allclose(fast.params(), slow.params(),
                                    rtol=1e-5, atol=1e-6)
+
+
+class TestZooModels:
+    def test_alexnet_builds_and_steps(self, rng):
+        """AlexNet (the reference LRN layer's raison d'etre) builds, runs a
+        small-image forward + one train step."""
+        from deeplearning4j_tpu.models import zoo
+
+        conf = zoo.alexnet(n_classes=10, image=67, dtype="float32")
+        net = MultiLayerNetwork(conf).init()
+        X = rng.rand(2, 67, 67, 3).astype("float32")
+        Y = np.eye(10, dtype="float32")[rng.randint(0, 10, 2)]
+        out = net.output(X)
+        assert out.shape == (2, 10)
+        np.testing.assert_allclose(np.asarray(out).sum(-1), 1.0, rtol=1e-4)
+        net.fit(DataSet(X, Y))
+        assert np.isfinite(net.score_value)
