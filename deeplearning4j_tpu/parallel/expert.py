@@ -55,34 +55,88 @@ def shard_moe_params(params, mesh: Mesh, expert_axis: str = "expert"):
     return {k: put(k, v) for k, v in params.items()}
 
 
+def _capacity_dispatch(onehot, C, acc, *, base_count=None):
+    """[N, E] assignment one-hot -> [N, E, C] dispatch tensor.
+
+    Position of each token within its expert's capacity buffer is its rank
+    among same-expert tokens (first-come order); `base_count` [E] offsets the
+    ranks (top-2 second choices queue behind every first choice, GShard
+    semantics)."""
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot           # [N, E]
+    if base_count is not None:
+        pos = pos + base_count[None, :] * onehot
+    pos_tok = jnp.sum(pos, axis=-1)                             # [N]
+    keep = pos_tok < C
+    # int cast for one_hot (it rejects float indices going forward);
+    # over-capacity tokens are already zeroed by the keep mask.
+    return (onehot * keep[:, None])[:, :, None] * jax.nn.one_hot(
+        pos_tok.astype(jnp.int32), C, dtype=acc)[:, None, :]    # [N, E, C]
+
+
 def moe_ffn(params, x, *, capacity_factor: float = 1.25,
-            mesh: Optional[Mesh] = None, expert_axis: str = "expert"):
-    """Top-1 routed MoE FFN. x: [N, D] tokens -> [N, D].
+            mesh: Optional[Mesh] = None, expert_axis: str = "expert",
+            top_k: int = 1, rng=None, jitter_eps: float = 0.0,
+            return_aux: bool = False):
+    """Top-1 / top-2 routed MoE FFN. x: [N, D] tokens -> [N, D_out].
+
+    GShard routing semantics (the module's design donor):
+    - `top_k=2`: each token is dispatched to its two highest-probability
+      experts; the two gate values are renormalized to sum to 1; second
+      choices queue behind ALL first choices in each expert's capacity
+      buffer, so under pressure first choices win buffer slots.
+    - load-balance auxiliary loss `E * sum_e(fraction_tokens_e * mean_prob_e)`
+      over FIRST-choice assignments (GShard eq. (4) / Switch Transformer
+      eq. (4)); minimized at 1.0 for a perfectly uniform router. Returned
+      when `return_aux=True` as `(y, aux_loss)`; callers scale it into
+      their training loss.
+    - router jitter: with `rng` and `jitter_eps > 0`, router inputs are
+      multiplied by uniform noise in [1-eps, 1+eps] (training-time
+      exploration; pass rng=None at eval).
 
     With `mesh`, the [E, C, D] expert batch is sharding-constrained to the
     expert axis so GSPMD all-to-alls tokens to their expert's device; the
     math is identical with or without a mesh (exact-equivalence tested)."""
     N, D = x.shape
     E = params["gate_w"].shape[1]
-    C = max(1, int(capacity_factor * N / E))
+    C = max(1, int(capacity_factor * top_k * N / E))
     # Accumulate in at least fp32 (fp64 stays fp64 so x64 tests are exact).
     acc = jnp.promote_types(x.dtype, jnp.float32)
 
-    logits = x @ params["gate_w"]                       # [N, E]
-    probs = jax.nn.softmax(logits.astype(acc), axis=-1)
-    expert_idx = jnp.argmax(probs, axis=-1)             # [N]
-    gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=1)[:, 0]
+    x_router = x.astype(acc)
+    if rng is not None and jitter_eps > 0.0:
+        x_router = x_router * jax.random.uniform(
+            rng, x.shape, acc, 1.0 - jitter_eps, 1.0 + jitter_eps)
+    logits = x_router @ params["gate_w"].astype(acc)            # [N, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(logits, axis=-1)            # [N] first choice
+    gate1 = jnp.take_along_axis(probs, expert_idx[:, None], axis=1)[:, 0]
+    onehot1 = jax.nn.one_hot(expert_idx, E, dtype=acc)          # [N, E]
 
-    onehot = jax.nn.one_hot(expert_idx, E, dtype=acc)           # [N, E]
-    # Position of each token within its expert's capacity buffer.
-    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot           # [N, E]
-    pos_tok = jnp.sum(pos, axis=-1)                             # [N]
-    keep = pos_tok < C
-    # int cast for one_hot (it rejects float indices going forward);
-    # over-capacity tokens are already zeroed by the keep mask.
-    dispatch = (onehot * keep[:, None])[:, :, None] * jax.nn.one_hot(
-        pos_tok.astype(jnp.int32), C, dtype=acc)[:, None, :]    # [N, E, C]
-    combine = dispatch * gate[:, None, None]
+    # Load-balance aux loss from FIRST-choice fractions (GShard eq. 4).
+    frac_tokens = jnp.mean(onehot1, axis=0)                     # [E]
+    mean_prob = jnp.mean(probs, axis=0)                         # [E]
+    aux_loss = E * jnp.sum(frac_tokens * mean_prob)
+
+    if top_k == 1:
+        dispatch = _capacity_dispatch(onehot1, C, acc)
+        combine = dispatch * gate1[:, None, None]
+    elif top_k == 2:
+        # Second choice = highest remaining LOGIT (not prob): a saturated
+        # softmax zeroes the non-first-choice probs exactly, and an argmax
+        # over those zeros would re-select the first-choice expert.
+        logits2 = jnp.where(onehot1 > 0, -jnp.inf, logits)
+        idx2 = jnp.argmax(logits2, axis=-1)
+        gate2 = jnp.take_along_axis(probs, idx2[:, None], axis=1)[:, 0]
+        onehot2 = jax.nn.one_hot(idx2, E, dtype=acc)
+        denom = gate1 + gate2 + 1e-9
+        g1, g2 = gate1 / denom, gate2 / denom
+        d1 = _capacity_dispatch(onehot1, C, acc)
+        count1 = jnp.sum(onehot1, axis=0)                       # [E]
+        d2 = _capacity_dispatch(onehot2, C, acc, base_count=count1)
+        dispatch = d1 + d2
+        combine = d1 * g1[:, None, None] + d2 * g2[:, None, None]
+    else:
+        raise ValueError(f"top_k must be 1 or 2, got {top_k}")
 
     expert_in = jnp.einsum("nec,nd->ecd", dispatch,
                            x.astype(acc))                       # [E, C, D]
@@ -99,34 +153,63 @@ def moe_ffn(params, x, *, capacity_factor: float = 1.25,
         out_e = jax.lax.with_sharding_constraint(
             out_e, NamedSharding(mesh, P(expert_axis, None, None)))
     y = jnp.einsum("nec,ecd->nd", combine, out_e)
-    return y.astype(x.dtype)
+    y = y.astype(x.dtype)
+    if return_aux:
+        return y, aux_loss
+    return y
 
 
-def dense_moe_reference(params, x, *, capacity_factor: float = 1.25):
-    """Per-token reference: run every token through ITS expert's FFN
-    directly (same capacity-dropping rule), for equivalence tests."""
+def dense_moe_reference(params, x, *, capacity_factor: float = 1.25,
+                        top_k: int = 1):
+    """Per-token reference: run every token through ITS expert(s)' FFN
+    directly (same capacity/queueing rules as `moe_ffn`), for equivalence
+    tests. Second choices queue behind every first choice (GShard)."""
     import numpy as np
 
     x64 = np.asarray(x, np.float64)
     gate_w = np.asarray(params["gate_w"], np.float64)
     N, D = x64.shape
     E = gate_w.shape[1]
-    C = max(1, int(capacity_factor * N / E))
+    C = max(1, int(capacity_factor * top_k * N / E))
     logits = x64 @ gate_w
     e = np.exp(logits - logits.max(axis=1, keepdims=True))
     probs = e / e.sum(axis=1, keepdims=True)
-    idx = probs.argmax(axis=1)
-    out = np.zeros_like(x64)
+    idx = logits.argmax(axis=1)
+    d_out = np.asarray(params["w2"]).shape[-1]
+    out = np.zeros((N, d_out), np.float64)
+
+    def expert_out(j, v):
+        h = np.maximum(v @ np.asarray(params["w1"][j], np.float64)
+                       + np.asarray(params["b1"][j], np.float64), 0.0)
+        return h @ np.asarray(params["w2"][j], np.float64) + np.asarray(
+            params["b2"][j], np.float64)
+
     counts = {j: 0 for j in range(E)}
+    if top_k == 1:
+        for n in range(N):
+            j = int(idx[n])
+            if counts[j] >= C:
+                continue  # dropped
+            counts[j] += 1
+            out[n] = expert_out(j, x64[n]) * probs[n, j]
+        return out
+    # top-2: first choices claim buffer slots for ALL tokens first; second
+    # choice is the highest remaining LOGIT (matches moe_ffn's tie-robust
+    # selection under saturated softmax).
+    logits2 = logits.copy()
+    logits2[np.arange(N), idx] = -np.inf
+    idx2 = logits2.argmax(axis=1)
+    g1 = probs[np.arange(N), idx]
+    g2 = probs[np.arange(N), idx2]
+    denom = g1 + g2 + 1e-9
     for n in range(N):
         j = int(idx[n])
-        if counts[j] >= C:
-            continue  # dropped
-        counts[j] += 1
-        w1 = np.asarray(params["w1"][j], np.float64)
-        b1 = np.asarray(params["b1"][j], np.float64)
-        w2 = np.asarray(params["w2"][j], np.float64)
-        b2 = np.asarray(params["b2"][j], np.float64)
-        h = np.maximum(x64[n] @ w1 + b1, 0.0)
-        out[n] = (h @ w2 + b2) * probs[n, j]
+        if counts[j] < C:
+            counts[j] += 1
+            out[n] += expert_out(j, x64[n]) * (g1[n] / denom[n])
+    for n in range(N):
+        j = int(idx2[n])
+        if counts[j] < C:
+            counts[j] += 1
+            out[n] += expert_out(j, x64[n]) * (g2[n] / denom[n])
     return out
