@@ -530,6 +530,78 @@ class SimpleRnn(BaseRecurrentLayer):
 
 @register_layer
 @dataclass
+class SelfAttentionLayer(BaseRecurrentLayer):
+    """Multi-head self-attention over a [B, T, F] sequence.
+
+    No reference equivalent (the reference predates attention; its
+    long-sequence mechanism is tBPTT, `MultiLayerNetwork.java:1207`) —
+    this is SURVEY.md §5's named TPU-native extension, surfaced through
+    the config DSL. The impl (`nn/layers/attention.py`) picks the Pallas
+    flash kernel, masked XLA dense, or mesh-sharded ring attention at
+    trace time from the active `ParallelContext`.
+
+    n_in = input feature size, n_out = model width (divisible by
+    n_heads). `activation` defaults to identity (an attention block is
+    linear after the softmax-weighted sum; set it explicitly to opt in).
+    """
+
+    n_heads: int = 4
+    causal: bool = True
+    attention_impl: str = "auto"  # "auto" (Pallas flash) | "dense" (XLA)
+    activation: Any = "identity"
+
+    def param_shapes(self):
+        # No key bias: softmax is invariant to the per-query constant q·kB
+        # adds to every score, so kB's true gradient is identically zero —
+        # a degenerate parameter that adaptive updaters would random-walk.
+        return {
+            "Wq": (self.n_in, self.n_out), "qB": (self.n_out,),
+            "Wk": (self.n_in, self.n_out),
+            "Wv": (self.n_in, self.n_out), "vB": (self.n_out,),
+            "Wo": (self.n_out, self.n_out), "oB": (self.n_out,),
+        }
+
+
+@register_layer
+@dataclass
+class MoELayer(FeedForwardLayer):
+    """Mixture-of-experts FFN with GShard routing (top-1/top-2, capacity
+    dropping, router jitter, load-balance aux loss).
+
+    No reference equivalent (predates MoE; SURVEY.md §2.3 extension row).
+    The engines fold `aux_loss_weight * load_balance_loss` into the
+    training objective; under a `ParallelContext` with an expert axis the
+    experts shard across the mesh (`nn/layers/moe.py`).
+
+    n_in = n_out = model width; `expert_hidden` is each expert's FFN
+    hidden size (the expert MLP's own ReLU is fixed — `activation`
+    defaults to identity and applies to the combined output).
+    """
+
+    n_experts: int = 4
+    expert_hidden: int = 0  # 0 -> 4 * n_in at build time
+    capacity_factor: float = 1.25
+    top_k: int = 2
+    router_jitter: float = 0.0
+    aux_loss_weight: float = 1e-2
+    activation: Any = "identity"
+
+    def set_n_in(self, input_type: InputType, override: bool) -> None:
+        super().set_n_in(input_type, override)
+        if not self.expert_hidden:
+            self.expert_hidden = 4 * self.n_in
+
+    def param_shapes(self):
+        E, h = self.n_experts, self.expert_hidden or 4 * self.n_in
+        return {
+            "gate_w": (self.n_in, E),
+            "w1": (E, self.n_in, h), "b_1": (E, h),
+            "w2": (E, h, self.n_out), "b_2": (E, self.n_out),
+        }
+
+
+@register_layer
+@dataclass
 class GlobalPoolingLayer(Layer):
     """Global pooling over time or space (reference: `GlobalPoolingLayer.java`;
     SUM/AVG/MAX/PNORM, mask-aware)."""

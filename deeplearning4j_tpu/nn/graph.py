@@ -192,6 +192,12 @@ class ComputationGraph:
                     layer, lparams, state.get(name, {}), x,
                     rng=lrng, train=train, mask=mask,
                 )
+                if lstate_new and "_aux_loss" in lstate_new:
+                    # Reserved key: auxiliary loss terms (MoE load balance)
+                    # go into the objective, never persist as state.
+                    lstate_new = dict(lstate_new)
+                    aux["aux_loss"] = aux.get("aux_loss", 0.0) + \
+                        lstate_new.pop("_aux_loss")
                 if lstate_new:
                     declared = set(layer.state_shapes())
                     keep = {k: v for k, v in lstate_new.items()
@@ -218,7 +224,11 @@ class ComputationGraph:
         return outs, new_state, aux, omasks
 
     def _get_jit(self, kind: str, **static):
-        key = (kind, tuple(sorted(static.items())))
+        from deeplearning4j_tpu.parallel.context import context_cache_key
+
+        # Active ParallelContext selects which program layer impls trace
+        # (ring vs flash attention, expert-sharded vs local MoE).
+        key = (kind, tuple(sorted(static.items())), context_cache_key())
         if key not in self._jit_cache:
             self._jit_cache[key] = self._build_jit(kind, **static)
         return self._jit_cache[key]
@@ -435,6 +445,10 @@ class ComputationGraph:
                 cnt = jax.ops.segment_sum(w.astype(jnp.float32), cls,
                                           num_segments=layer.n_out)
                 extra_state[name] = {"centers": centers - layer.alpha * num / (1.0 + cnt)[:, None]}
+        if "aux_loss" in aux:
+            # Layer-emitted auxiliary objectives (MoE load balance), already
+            # scaled per-layer; batch-size-invariant means, not divided by eb.
+            total = total + aux["aux_loss"]
         # Penalty divided by minibatch size, matching the reference objective
         # (BaseOutputLayer.java:100-101, LayerUpdater.postApply:104-108).
         return total + self._l1_l2_penalty(params) / eb0, extra_state

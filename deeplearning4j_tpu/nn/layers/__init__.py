@@ -11,8 +11,10 @@ Uniform signature:
 from __future__ import annotations
 
 from deeplearning4j_tpu.nn.layers import (
+    attention,
     convolution,
     feedforward,
+    moe,
     normalization,
     pooling,
     recurrent,
@@ -39,6 +41,8 @@ LAYER_IMPLS = {
     "GravesBidirectionalLSTM": recurrent.bidirectional_lstm_apply,
     "SimpleRnn": recurrent.simple_rnn_apply,
     "GlobalPoolingLayer": pooling.global_pooling_apply,
+    "SelfAttentionLayer": attention.self_attention_apply,
+    "MoELayer": moe.moe_apply,
     "VariationalAutoencoder": variational.vae_apply,
 }
 

@@ -1,0 +1,104 @@
+"""Multi-head self-attention as a first-class DSL layer.
+
+The reference framework predates attention (its long-sequence story is
+tBPTT, `MultiLayerNetwork.java:1207`); SURVEY.md §5 names attention with
+ring/Ulysses sequence parallelism as the TPU-native extension. Round 4
+shipped the kernels as standalone functions (`parallel/sequence.py`,
+`ops/flash_attention.py`); this module makes them reachable from the
+framework's own config DSL: `SelfAttentionLayer` in a
+`NeuralNetConfiguration` builds a model whose jitted train step computes
+attention through
+
+- the Pallas flash kernel (single device, no mask — `impl="auto"`),
+- XLA dense attention with key masking (when a features mask is present),
+- ring attention over the active mesh's sequence axis, selected at trace
+  time from the installed `parallel.context.ParallelContext` — the same
+  DSL model trains sequence-sharded under `ParallelWrapper(...,
+  seq_axis=...)` with zero config changes.
+
+The layer is an ordinary engine citizen: gradient-checked
+(`tests/test_gradientcheck.py`), serialized to JSON/YAML, updater/L2
+semantics identical to every other layer.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import activations
+from deeplearning4j_tpu.nn.layers.common import layer_input_dropout
+from deeplearning4j_tpu.parallel.context import current_context
+
+_NEG = -1e30
+
+
+def _masked_dense_attention(q, k, v, mask, causal, scale):
+    """Dense attention with key-position masking. q/k/v: [B, T, H, D];
+    mask: [B, T] (1 = real, 0 = padded). Masked KEYS are excluded from
+    every softmax; masked QUERY rows produce zeros (their downstream loss
+    contribution is masked anyway, and zeros keep them finite)."""
+    acc = jnp.promote_types(q.dtype, jnp.float32)
+    qt, kt, vt = (jnp.swapaxes(a, 1, 2).astype(acc) for a in (q, k, v))
+    s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+    s = jnp.where(mask[:, None, None, :] > 0, s, _NEG)
+    if causal:
+        T = s.shape[-1]
+        s = jnp.where(jnp.triu(jnp.ones((T, T), bool), 1)[None, None], _NEG, s)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    p = jnp.where(m <= _NEG / 2, 0.0, p)  # fully-masked rows -> all-zero p
+    denom = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p / denom, vt)
+    return jnp.swapaxes(o, 1, 2).astype(q.dtype)
+
+
+def self_attention_apply(conf, params, state, x, *, rng=None, train=False,
+                         mask=None):
+    """x: [B, T, n_in] -> [B, T, n_out] multi-head self-attention.
+
+    Path selection (trace-time, static):
+    1. active ParallelContext with a >1 sequence axis -> ring attention
+       (sequence-sharded exact attention; requires causal or no mask);
+    2. features mask present -> XLA dense with key masking;
+    3. otherwise -> `parallel.sequence.attention` (Pallas flash kernel for
+       `impl="auto"`, dense oracle for `impl="dense"`).
+    """
+    from deeplearning4j_tpu.parallel import sequence as seq_mod
+
+    x = layer_input_dropout(conf, x, rng, train)
+    B, T, _ = x.shape
+    H = conf.n_heads
+    if conf.n_out % H:
+        raise ValueError(
+            f"SelfAttentionLayer n_out ({conf.n_out}) must be divisible by "
+            f"n_heads ({H})")
+    Dh = conf.n_out // H
+
+    def proj(w, b=None):
+        h = x @ params[w]
+        if b is not None:
+            h = h + params[b]
+        return h.reshape(B, T, H, Dh)
+
+    q = proj("Wq", "qB")
+    k = proj("Wk")  # key bias is a softmax no-op (see conf.param_shapes)
+    v = proj("Wv", "vB")
+    scale = Dh ** -0.5
+
+    ctx = current_context()
+    if ctx is not None and ctx.seq_axis is not None and ctx.axis_size("seq") > 1:
+        if mask is not None and not conf.causal:
+            raise ValueError(
+                "sequence-sharded non-causal attention with a features mask "
+                "is not supported; pad to full length or drop the seq axis")
+        o = seq_mod.ring_attention(
+            q, k, v, ctx.mesh, seq_axis=ctx.seq_axis,
+            batch_axis=ctx.data_axis, causal=conf.causal, scale=scale)
+    elif mask is not None:
+        o = _masked_dense_attention(q, k, v, mask, conf.causal, scale)
+    else:
+        o = seq_mod.attention(q, k, v, causal=conf.causal, scale=scale,
+                              impl=conf.attention_impl)
+    out = o.reshape(B, T, conf.n_out) @ params["Wo"] + params["oB"]
+    out = activations.resolve(conf.activation)(out)
+    return out, state, mask

@@ -1,0 +1,59 @@
+"""Mixture-of-experts FFN as a first-class DSL layer.
+
+Makes `parallel/expert.py`'s GShard-style routed FFN reachable from the
+config DSL: `MoELayer` in a `NeuralNetConfiguration` trains through the
+engines with top-1/top-2 routing, capacity dropping, router jitter, and
+the load-balance auxiliary loss folded into the network objective (the
+engine collects the `_aux_loss` state entry each MoE layer emits and adds
+it to the loss — `nn/multilayer.py._loss_from_preout`). Under an active
+`ParallelContext` with an expert axis, the per-expert einsum batch is
+sharding-constrained to that axis, so the SAME DSL model trains
+expert-parallel with GSPMD-inserted all-to-alls (no reference equivalent;
+the reference predates MoE — SURVEY.md §2.3 extension row).
+"""
+
+from __future__ import annotations
+
+import jax
+
+from deeplearning4j_tpu.nn import activations
+from deeplearning4j_tpu.nn.layers.common import layer_input_dropout
+from deeplearning4j_tpu.parallel.context import current_context
+
+
+def moe_apply(conf, params, state, x, *, rng=None, train=False, mask=None):
+    """x: [B, n_in] or [B, T, n_in] -> same leading shape with n_out.
+
+    Emits `{"_aux_loss": w * aux}` in the returned state — the engines pop
+    this reserved key into the training objective (never persisted)."""
+    from deeplearning4j_tpu.parallel import expert as expert_mod
+
+    drop_rng = jitter_rng = None
+    if rng is not None:
+        # Independent streams: dropout and router jitter must not consume
+        # the same key (identical bits => correlated draws).
+        drop_rng, jitter_rng = jax.random.split(rng)
+    x = layer_input_dropout(conf, x, drop_rng, train)
+    lead = x.shape[:-1]
+    tokens = x.reshape(-1, x.shape[-1])
+    ffn_params = {
+        "gate_w": params["gate_w"],
+        "w1": params["w1"], "b1": params["b_1"],
+        "w2": params["w2"], "b2": params["b_2"],
+    }
+    ctx = current_context()
+    mesh = expert_axis = None
+    if ctx is not None and ctx.expert_axis is not None and ctx.axis_size("expert") > 1:
+        mesh, expert_axis = ctx.mesh, ctx.expert_axis
+    kwargs = dict(
+        capacity_factor=conf.capacity_factor, top_k=conf.top_k,
+        rng=jitter_rng if train else None, jitter_eps=conf.router_jitter,
+        return_aux=True,
+    )
+    if mesh is not None:
+        kwargs.update(mesh=mesh, expert_axis=expert_axis)
+    y, aux = expert_mod.moe_ffn(ffn_params, tokens, **kwargs)
+    out = activations.resolve(conf.activation)(y.reshape(lead + (conf.n_out,)))
+    new_state = dict(state)
+    new_state["_aux_loss"] = conf.aux_loss_weight * aux
+    return out, new_state, mask

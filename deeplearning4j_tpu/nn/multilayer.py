@@ -184,6 +184,12 @@ class MultiLayerNetwork:
             x, lstate_new, mask = get_impl(layer)(
                 layer, lparams, lstate, x, rng=lrng, train=train, mask=mask
             )
+            if lstate_new and "_aux_loss" in lstate_new:
+                # Reserved key: auxiliary loss terms (MoE load balance) are
+                # collected into the objective, never persisted as state.
+                lstate_new = dict(lstate_new)
+                aux["aux_loss"] = aux.get("aux_loss", 0.0) + lstate_new.pop(
+                    "_aux_loss")
             if lstate_new:
                 # Only persist what the layer declares (BN stats) unless the
                 # caller wants rnn hidden state carried (tbptt / rnn_time_step).
@@ -203,7 +209,12 @@ class MultiLayerNetwork:
         return preout
 
     def _get_jit(self, kind: str, **static):
-        key = (kind, tuple(sorted(static.items())))
+        from deeplearning4j_tpu.parallel.context import context_cache_key
+
+        # The active ParallelContext selects which program layer impls trace
+        # (ring vs flash attention, expert-sharded vs local MoE), so it is
+        # part of the program identity.
+        key = (kind, tuple(sorted(static.items())), context_cache_key())
         if key in self._jit_cache:
             return self._jit_cache[key]
         fn = self._build_jit(kind, **static)
@@ -427,6 +438,11 @@ class MultiLayerNetwork:
                                       num_segments=layer.n_out)
             new_centers = centers - layer.alpha * num / (1.0 + cnt)[:, None]
             extra_state = {self.layer_keys[-1]: {"centers": new_centers}}
+        if "aux_loss" in aux:
+            # Layer-emitted auxiliary objectives (MoE load balance), already
+            # scaled by their layer's weight; batch-size-invariant means, so
+            # not divided by eb.
+            data_loss = data_loss + aux["aux_loss"]
         # Reference: `score += fullNetworkL1 + fullNetworkL2; score /= miniBatch`
         # (BaseOutputLayer.java:100-101) and the matching gradient
         # `(g + l2*w)/miniBatch` (LayerUpdater.postApply:104-108) — so the

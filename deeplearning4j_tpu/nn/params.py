@@ -24,6 +24,7 @@ from deeplearning4j_tpu.nn.conf.layers import (
     GravesLSTM,
     LSTM,
     Layer,
+    MoELayer,
     VariationalAutoencoder,
     is_bias_param,
 )
@@ -35,6 +36,10 @@ def _fans(conf: Layer, name: str, shape: Tuple[int, ...]) -> Tuple[float, float]
     if isinstance(conf, ConvolutionLayer) and name == "W":
         kh, kw, cin, cout = shape
         return (cin * kh * kw, cout * kh * kw)
+    if isinstance(conf, MoELayer) and len(shape) == 3:
+        # Per-expert FFN tables [E, in, out]: fans are the PER-EXPERT matmul
+        # dims, not the stacked leading axis.
+        return (shape[1], shape[2])
     if len(shape) >= 2:
         return (shape[0], shape[1])
     return (shape[0], shape[0])

@@ -124,6 +124,12 @@ class DistributedTrainer(ParallelWrapper):
         if not net._initialized:
             net.init()
         replicate_params_global(net, mesh, model_axis=model_axis)
+        from deeplearning4j_tpu.parallel.context import ParallelContext
+
+        # The inherited fit() installs this around every dispatch (layer
+        # impls consult it for the sharded attention/MoE paths).
+        self.context = ParallelContext(
+            mesh=mesh, data_axis=self.data_axis, model_axis=model_axis)
         self._shape_checked = False
 
     def _shard(self, a):

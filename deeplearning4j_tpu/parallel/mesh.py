@@ -78,19 +78,62 @@ def param_shardings(params, mesh: Mesh, model_axis: Optional[str] = None,
     return jax.tree_util.tree_map(rule, params)
 
 
-def shard_params(net, mesh: Mesh, model_axis: Optional[str] = None, put=None):
+def _moe_layers(net) -> Dict[str, object]:
+    """Param-tree keys of MoELayer configs in either engine (layer key for
+    MultiLayerNetwork, vertex name for ComputationGraph)."""
+    found: Dict[str, object] = {}
+    layers = getattr(net, "layers", None)
+    if layers is not None:
+        for lk, layer in zip(net.layer_keys, layers):
+            if type(layer).__name__ == "MoELayer":
+                found[lk] = layer
+    for name, v in (getattr(net, "layer_vertices", None) or {}).items():
+        if type(v.layer).__name__ == "MoELayer":
+            found[name] = v.layer
+    return found
+
+
+def shard_params(net, mesh: Mesh, model_axis: Optional[str] = None,
+                 expert_axis: Optional[str] = None, put=None):
     """Place a network's params/opt_state/state on the mesh in-place.
 
     `put(leaf, sharding)` is the placement primitive: `jax.device_put` by
     default (single-process — all mesh devices addressable); multi-process
     callers pass `parallel/distributed.py`'s global-array builder. One
-    routine, one set of sharding rules for both worlds."""
+    routine, one set of sharding rules for both worlds.
+
+    With `expert_axis`, every MoELayer's per-expert tables (leading [E]
+    axis) shard over that axis — the expert-parallel placement
+    `nn/layers/moe.py`'s sharding constraints then keep through the step."""
     if put is None:
         put = jax.device_put
     ps = param_shardings(net.params_tree, mesh, model_axis)
+    moe = _moe_layers(net) if expert_axis in mesh.shape else {}
+    for lk, layer in moe.items():
+        for pn in ("w1", "b_1", "w2", "b_2"):
+            a = net.params_tree[lk][pn]
+            ps[lk][pn] = NamedSharding(
+                mesh, P(expert_axis, *([None] * (a.ndim - 1))))
     net.params_tree = jax.tree_util.tree_map(put, net.params_tree, ps)
     if net.opt_state is not None:
         os_shard = param_shardings(net.opt_state, mesh, model_axis)
+        expert_param_names = {"w1", "b_1", "w2", "b_2"}
+        for lk in moe:
+            # Updater state mirrors the param dict (tree_map(zeros_like)),
+            # so the PATH carries the param name — shard by name, exactly
+            # like the params branch above (a shape heuristic would
+            # mis-shard gate_w state when n_in == n_experts).
+            flat, treedef = jax.tree_util.tree_flatten_with_path(
+                net.opt_state[lk])
+            flat_s = jax.tree_util.tree_leaves(os_shard[lk])
+            new_s = []
+            for (path, a), s in zip(flat, flat_s):
+                names = {getattr(k, "key", None) for k in path}
+                if names & expert_param_names and hasattr(a, "ndim"):
+                    s = NamedSharding(
+                        mesh, P(expert_axis, *([None] * (a.ndim - 1))))
+                new_s.append(s)
+            os_shard[lk] = jax.tree_util.tree_unflatten(treedef, new_s)
         net.opt_state = jax.tree_util.tree_map(
             lambda a, s: put(a, s) if hasattr(a, "shape") else a,
             net.opt_state, os_shard)

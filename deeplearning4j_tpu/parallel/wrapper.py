@@ -21,6 +21,7 @@ import numpy as np
 
 from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.parallel import mesh as mesh_mod
+from deeplearning4j_tpu.parallel.context import parallel_context
 
 
 class ParallelWrapper:
@@ -33,7 +34,10 @@ class ParallelWrapper:
 
     def __init__(self, net, mesh=None, workers: Optional[int] = None,
                  averaging_frequency: int = 1, prefetch_buffer: int = 2,
-                 report_score_after_averaging: bool = True):
+                 report_score_after_averaging: bool = True,
+                 model_axis: Optional[str] = None,
+                 seq_axis: Optional[str] = None,
+                 expert_axis: Optional[str] = None):
         self.net = net
         if mesh is None:
             devices = jax.devices()[:workers] if workers else jax.devices()
@@ -43,7 +47,16 @@ class ParallelWrapper:
         self.n_devices = int(np.prod(mesh.devices.shape))
         if not net._initialized:
             net.init()
-        mesh_mod.shard_params(net, mesh)
+        mesh_mod.shard_params(net, mesh, model_axis=model_axis,
+                              expert_axis=expert_axis)
+        # Axis roles beyond "data" activate the corresponding layer paths
+        # (ring attention over seq_axis, expert-parallel MoE) at trace time
+        # via the ParallelContext installed around every dispatch.
+        from deeplearning4j_tpu.parallel.context import ParallelContext
+
+        self.context = ParallelContext(
+            mesh=mesh, data_axis=self.data_axis, model_axis=model_axis,
+            seq_axis=seq_axis, expert_axis=expert_axis)
 
     def _pad_dataset(self, ds: DataSet) -> DataSet:
         """Pad the batch dim up to a multiple of the mesh size (XLA needs the
@@ -137,7 +150,8 @@ class ParallelWrapper:
                     self._shard(padded.features_mask),
                     self._shard(padded.labels_mask),
                 )
-            net._fit_dispatch(sharded)
+            with parallel_context(getattr(self, "context", None)):
+                net._fit_dispatch(sharded)
         return net
 
     def evaluate(self, iterator, top_n: int = 1):

@@ -105,6 +105,8 @@ def test_two_process_training_matches_single_machine(tmp_path):
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
     env["JAX_PLATFORMS"] = "cpu"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
     procs = [subprocess.Popen(
         [sys.executable, str(script), str(pid), str(port), str(out)],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, text=True)
