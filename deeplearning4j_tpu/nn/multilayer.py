@@ -464,6 +464,23 @@ class MultiLayerNetwork:
 
         (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
 
+        new_params, new_opt, stats = self._apply_updates(
+            params, grads, opt_state, step, collect_stats=collect_stats)
+        # Merge persistent-state updates (BN stats / rnn carries) over old state.
+        merged_state = dict(state)
+        for lk, s in new_state.items():
+            merged = dict(merged_state.get(lk, {}))
+            merged.update(s)
+            merged_state[lk] = merged
+        if collect_stats:
+            return new_params, merged_state, new_opt, loss, stats
+        return new_params, merged_state, new_opt, loss
+
+    def _apply_updates(self, params, grads, opt_state, step,
+                       collect_stats=False):
+        """Per-layer gradient-normalize + updater + param update (traced) —
+        the reference's LayerUpdater stack. Shared by `_train_step` and
+        `parallel/pipeline_trainer.py`'s pipelined step."""
         g = self.conf.global_conf
         sign = 1.0 if g.minimize else -1.0
         new_params: Dict[str, Any] = {}
@@ -507,15 +524,7 @@ class MultiLayerNetwork:
                     }
                     for k in lgrads
                 }
-        # Merge persistent-state updates (BN stats / rnn carries) over old state.
-        merged_state = dict(state)
-        for lk, s in new_state.items():
-            merged = dict(merged_state.get(lk, {}))
-            merged.update(s)
-            merged_state[lk] = merged
-        if collect_stats:
-            return new_params, merged_state, new_opt, loss, stats
-        return new_params, merged_state, new_opt, loss
+        return new_params, new_opt, stats
 
     # ------------------------------------------------------------------ fit
 

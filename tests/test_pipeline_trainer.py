@@ -1,0 +1,100 @@
+"""Engine-driven pipeline parallelism: a DSL-built MultiLayerNetwork with
+structurally-repeated blocks trains through `PipelineTrainer`'s GPipe
+schedule and matches unpipelined training parameter-for-parameter.
+
+No reference equivalent (SURVEY.md §2.3 TPU-native extension row); the
+equivalence contract mirrors the reference's distributed-vs-single-machine
+tests (`TestCompareParameterAveragingSparkVsSingleMachine`).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.neural_net import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel import mesh as mesh_mod
+from deeplearning4j_tpu.parallel.pipeline_trainer import PipelineTrainer
+
+
+def _conf(n_blocks=4, width=16, updater="sgd"):
+    b = (NeuralNetConfiguration.builder()
+         .seed(44).learning_rate(0.05).updater(updater)
+         .list()
+         .layer(DenseLayer(n_out=width, activation="tanh")))
+    for _ in range(n_blocks * 2):
+        b = b.layer(DenseLayer(n_out=width, activation="tanh"))
+    return (b.layer(OutputLayer(n_out=3, activation="softmax",
+                                loss_function="mcxent"))
+            .set_input_type(InputType.feed_forward(6))
+            .build())
+
+
+def _data(rng, n=8):
+    X = rng.randn(n, 6).astype("float32")
+    Y = np.eye(3)[rng.randint(0, 3, n)].astype("float32")
+    return X, Y
+
+
+@pytest.mark.parametrize("updater", ["sgd", "adam"])
+def test_pipelined_training_matches_plain(rng, updater):
+    X, Y = _data(rng)
+    net0 = MultiLayerNetwork(_conf(updater=updater)).init()
+    for _ in range(4):
+        net0.fit(DataSet(X, Y))
+
+    net1 = MultiLayerNetwork(_conf(updater=updater)).init()
+    mesh = mesh_mod.create_mesh((2, 4), axis_names=("data", "pipe"))
+    pt = PipelineTrainer(net1, mesh, block_range=(1, 9), n_microbatches=2)
+    pt.fit((X, Y))  # (x, y) convenience form, like the engine's fit
+    for _ in range(3):
+        pt.fit(DataSet(X, Y))
+
+    assert abs(net0.score_value - net1.score_value) < 1e-4
+    for lk in net0.params_tree:
+        for pk in net0.params_tree[lk]:
+            np.testing.assert_allclose(
+                np.asarray(net0.params_tree[lk][pk]),
+                np.asarray(net1.params_tree[lk][pk]),
+                rtol=2e-4, atol=2e-5, err_msg=f"{lk}/{pk}")
+
+
+def test_structural_validation(rng):
+    """Mismatched stages and in-range dropout are rejected at construction."""
+    mesh = mesh_mod.create_mesh((2, 4), axis_names=("data", "pipe"))
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).learning_rate(0.1).updater("sgd")
+            .list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(DenseLayer(n_out=12, activation="tanh"))  # width breaks
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss_function="mcxent"))
+            .set_input_type(InputType.feed_forward(6))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    with pytest.raises(ValueError, match="differs structurally"):
+        PipelineTrainer(net, mesh, block_range=(1, 5), n_microbatches=2)
+
+    conf2 = _conf()
+    for i in range(1, 9):  # uniform, so the structural check passes
+        conf2.layers[i].dropout = 0.5
+    net2 = MultiLayerNetwork(conf2).init()
+    with pytest.raises(ValueError, match="dropout"):
+        PipelineTrainer(net2, mesh, block_range=(1, 9), n_microbatches=2)
+
+    # Same shapes, different activation: must be rejected (the block body
+    # applies stage 0's config to every stage).
+    conf3 = _conf()
+    conf3.layers[5].activation = "relu"
+    net3 = MultiLayerNetwork(conf3).init()
+    with pytest.raises(ValueError, match="differs structurally"):
+        PipelineTrainer(net3, mesh, block_range=(1, 9), n_microbatches=2)
+
+    with pytest.raises(ValueError, match="multiple of the pipe"):
+        PipelineTrainer(MultiLayerNetwork(_conf()).init(), mesh,
+                        block_range=(1, 8), n_microbatches=2)
