@@ -31,6 +31,8 @@ class KDTree:
     constructor (`KDTree(points)`) that builds a balanced tree."""
 
     def __init__(self, points: Optional[np.ndarray] = None, dims: Optional[int] = None):
+        if isinstance(points, int) and dims is None:
+            points, dims = None, points  # KDTree(3) == KDTree(dims=3)
         if points is not None:
             points = np.asarray(points, np.float64)
             self.dims = points.shape[1]
@@ -94,6 +96,8 @@ class KDTree:
         return [(d, self._points[i]) for d, i in self.knn_indices(query, k)]
 
     def knn_indices(self, query: np.ndarray, k: int) -> List[Tuple[float, int]]:
+        if not self._points:
+            raise ValueError("query on an empty KDTree (add points first)")
         query = np.asarray(query, np.float64)
         best: List[Tuple[float, int]] = []  # kept sorted, max size k
         # Explicit stack instead of recursion: an insert-built tree can be a

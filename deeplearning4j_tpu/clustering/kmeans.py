@@ -102,11 +102,23 @@ class KMeansClustering:
         # the lowest-inertia run (Lloyd only finds local optima; the
         # reference samples random initial centers once — ++ with restarts
         # strictly improves on that and stays deterministic).
+        def seed_dist(c):
+            # ++ seeding uses the RUN's OWN metric: squared Euclidean for
+            # euclidean runs, (1 - cosine similarity) for cosine runs —
+            # a Euclidean D^2 would mis-seed cosine clusterings by vector
+            # magnitude.
+            if cosine:
+                num = points @ c
+                den = (np.linalg.norm(points, axis=1)
+                       * max(np.linalg.norm(c), 1e-12)) + 1e-12
+                return np.maximum(1.0 - num / den, 0.0)
+            return np.sum((points - c) ** 2, axis=1)
+
         for _ in range(max(self.n_init, 1)):
             centers = [points[rng.randint(N)]]
             # Running elementwise minimum: one distance pass per new center
             # (O(K*N)) instead of re-scanning every chosen center (O(K^2*N)).
-            d2 = np.sum((points - centers[0]) ** 2, axis=1)
+            d2 = seed_dist(centers[0])
             for _ in range(1, self.k):
                 total = d2.sum()
                 if total > 0:
@@ -114,7 +126,7 @@ class KMeansClustering:
                 else:  # all remaining points coincide with a chosen center
                     c = points[rng.randint(N)]
                 centers.append(c)
-                d2 = np.minimum(d2, np.sum((points - c) ** 2, axis=1))
+                d2 = np.minimum(d2, seed_dist(c))
             c, a, d = _lloyd(pts, jnp.asarray(np.stack(centers)),
                              self.max_iterations, cosine)
             inertia = float(jnp.sum(d * d))

@@ -629,6 +629,151 @@ def load_cifar10(train: bool = True, num_examples: Optional[int] = None,
     return DataSet(x, onehot)
 
 
+def _lfw_search_dirs() -> List[str]:
+    return [
+        os.environ.get("LFW_DIR", ""),
+        os.path.expanduser("~/.deeplearning4j_tpu/lfw"),
+        os.path.expanduser("~/lfw"),
+    ]
+
+
+class LFWDataSetIterator(RecordReaderDataSetIterator):
+    """Labeled Faces in the Wild (reference:
+    `datasets/iterator/impl/LFWDataSetIterator.java` over `LFWLoader` —
+    parent-path person labels, configurable image dims / numExamples /
+    train-test split).
+
+    Zero-egress policy: the loader searches `LFW_DIR` /
+    `~/.deeplearning4j_tpu/lfw` for the standard `lfw/<person>/<img>.jpg`
+    layout (the reference downloads lfw.tgz to the same layout); absent
+    that, a deterministic synthetic face-like set (class-dependent
+    blob/stripe statistics, like the CIFAR fallback) stands in so the
+    pipeline stays drivable.
+    """
+
+    def __init__(self, batch_size: int, num_examples: Optional[int] = None,
+                 image_shape: Tuple[int, int, int] = (250, 250, 3),
+                 num_labels: Optional[int] = None, train: bool = True,
+                 split_train_test: float = 1.0, seed: int = 123):
+        h, w, c = image_shape
+        for d in _lfw_search_dirs():
+            if d and os.path.isdir(d) and any(
+                    os.path.isdir(os.path.join(d, s)) for s in os.listdir(d)):
+                reader = ImageRecordReader(h, w, c).initialize(d)
+                if num_labels is not None:
+                    keep = set(reader.labels[:num_labels])
+                    reader._files = [(p, li) for p, li in reader._files
+                                     if reader.labels[li] in keep]
+                self._synthetic = False
+                break
+        else:
+            reader = _SyntheticFaceReader(h, w, c, num_labels or 5,
+                                          num_examples or 200, seed)
+            self._synthetic = True
+        files = reader._files
+        rng = np.random.RandomState(seed)
+        order = rng.permutation(len(files))
+        if num_examples is not None:
+            order = order[:num_examples]
+        split = int(len(order) * split_train_test)
+        order = order[:split] if train else order[split:]
+        reader._files = [files[i] for i in order]
+        super().__init__(reader, batch_size)
+
+    def total_examples(self) -> int:
+        return len(self.reader._files)
+
+
+class _SyntheticFaceReader(ImageRecordReader):
+    """Deterministic stand-in for the LFW archive (see LFWDataSetIterator)."""
+
+    def __init__(self, h, w, c, n_labels, n_examples, seed):
+        self.height, self.width, self.channels = h, w, c
+        self.normalize = True
+        self.labels = [f"person_{i}" for i in range(n_labels)]
+        self._files = [(f"synthetic_{i}", i % n_labels)
+                       for i in range(n_examples)]
+        self._seed = seed
+
+    def _load(self, path: str) -> np.ndarray:
+        i = int(path.rsplit("_", 1)[1])
+        li = i % len(self.labels)
+        rng = np.random.RandomState(self._seed + i)
+        img = rng.rand(self.height, self.width, self.channels) * 0.2
+        # "Face": a class-positioned bright ellipse + identity stripes.
+        yy, xx = np.mgrid[0:self.height, 0:self.width]
+        cy = self.height * (0.3 + 0.05 * li)
+        cx = self.width * 0.5
+        r = ((yy - cy) / (0.3 * self.height)) ** 2 + \
+            ((xx - cx) / (0.22 * self.width)) ** 2
+        img[r < 1.0] += 0.5
+        img[:, :: max(2, li + 2), :] += 0.15
+        return np.clip(img, 0.0, 1.0).astype(np.float32)
+
+
+def load_curves(num_examples: Optional[int] = None,
+                seed: int = 123) -> DataSet:
+    """The "curves" benchmark set (reference:
+    `datasets/fetchers/CurvesDataFetcher.java` — downloads `curves.ser`,
+    the classic 28x28 synthetic-curve images used for deep-autoencoder
+    pretraining; features double as reconstruction targets).
+
+    Zero-egress: searches `CURVES_DIR` / `~/.deeplearning4j_tpu/curves`
+    for `curves.npz` (key "x", [N, 784] float; the Java-serialized
+    `curves.ser` is not parseable outside the JVM — convert once with any
+    dl4j install). Absent that, generates the same KIND of data the
+    benchmark uses: random cubic Bezier curves rasterized onto 28x28."""
+    for d in (os.environ.get("CURVES_DIR", ""),
+              os.path.expanduser("~/.deeplearning4j_tpu/curves")):
+        p = os.path.join(d, "curves.npz") if d else ""
+        if p and os.path.exists(p):
+            x = np.load(p)["x"].astype(np.float32)
+            break
+    else:
+        rng = np.random.RandomState(seed)
+        n = num_examples or 2000
+        ts = np.linspace(0.0, 1.0, 64)[:, None]
+        b0 = (1 - ts) ** 3
+        b1 = 3 * ts * (1 - ts) ** 2
+        b2 = 3 * ts ** 2 * (1 - ts)
+        b3 = ts ** 3
+        ctrl = rng.rand(n, 4, 2) * 24 + 2  # 4 control points in [2, 26)
+        pts = (b0[None] * ctrl[:, None, 0] + b1[None] * ctrl[:, None, 1]
+               + b2[None] * ctrl[:, None, 2] + b3[None] * ctrl[:, None, 3])
+        x = np.zeros((n, 28, 28), np.float32)
+        idx = np.clip(pts.round().astype(int), 0, 27)
+        rows = np.repeat(np.arange(n), 64)
+        x[rows, idx[:, :, 1].ravel(), idx[:, :, 0].ravel()] = 1.0
+        x = x.reshape(n, 784)
+    if num_examples is not None:
+        x = x[:num_examples]
+    return DataSet(x, x.copy())  # reconstruction targets = inputs
+
+
+class CurvesDataSetIterator(DataSetIterator):
+    """Reference: `CurvesDataFetcher` consumed through the fetcher-backed
+    iterator pattern (BaseDatasetIterator)."""
+
+    def __init__(self, batch_size: int, num_examples: Optional[int] = None,
+                 seed: int = 123, shuffle: bool = False):
+        from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+        ds = load_curves(num_examples=num_examples, seed=seed)
+        self._impl = ListDataSetIterator(ds, batch_size=batch_size,
+                                         shuffle=shuffle, seed=seed)
+
+    def __iter__(self):
+        return iter(self._impl)
+
+    def reset(self):
+        self._impl.reset()
+
+    def batch_size(self):
+        return self._impl.batch_size()
+
+    def total_examples(self):
+        return self._impl.total_examples()
+
+
 class Cifar10DataSetIterator(DataSetIterator):
     """Reference: `CifarDataSetIterator` (deeplearning4j-core)."""
 

@@ -84,7 +84,15 @@ long csv_dims(const char* path, char delim, long skip, long* rows,
     const char *b, *e;
     long line_no = 0, nrows = 0, ncols = 0;
     while (w.next(&b, &e)) {
-        if (line_no++ < skip) continue;
+        if (line_no++ < skip) {
+            // A quoted field in the skipped region can span lines: the
+            // Python csv.reader fallback counts LOGICAL rows toward skip,
+            // this walker counts physical lines. Punt to the fallback the
+            // moment a quote shows up so the two paths can never start
+            // data at different rows.
+            if (std::memchr(b, '"', static_cast<size_t>(e - b))) return -2;
+            continue;
+        }
         if (b == e) continue;  // blank line (counted toward skip above)
         long c = count_fields(b, e, delim);
         if (ncols == 0) ncols = c;
@@ -104,7 +112,11 @@ long csv_parse(const char* path, char delim, long skip, float* out,
     const char *b, *e;
     long line_no = 0, r = 0;
     while (w.next(&b, &e)) {
-        if (line_no++ < skip) continue;
+        if (line_no++ < skip) {
+            // Match csv_dims: quoted skip regions go to the Python fallback.
+            if (std::memchr(b, '"', static_cast<size_t>(e - b))) return -2;
+            continue;
+        }
         if (b == e) continue;  // blank line
         if (r >= rows) return -3;
         long c = 0;

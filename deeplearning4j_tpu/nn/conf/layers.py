@@ -666,9 +666,10 @@ class VariationalAutoencoder(FeedForwardLayer):
 
     encoder_layer_sizes: Tuple[int, ...] = (100,)
     decoder_layer_sizes: Tuple[int, ...] = (100,)
-    # "gaussian" | "bernoulli" | "exponential", or a composite list of
-    # (name, data_size) pairs (reference: `conf/layers/variational/`
-    # ReconstructionDistribution SPI incl. Composite).
+    # "gaussian" | "bernoulli" | "exponential", a loss wrapper
+    # ("loss", loss_function[, activation]), or a composite list of
+    # (spec, data_size) pairs (reference: `conf/layers/variational/`
+    # ReconstructionDistribution SPI incl. Composite + LossFunctionWrapper).
     reconstruction_distribution: Any = "gaussian"
     pzx_activation: Any = Activation.IDENTITY
     num_samples: int = 1

@@ -8,9 +8,16 @@ Composite impls), reparameterization-trick sampling. Supervised forward =
 encoder mean (the reference's activate()); the ELBO pretrain loss is
 `vae_pretrain_loss`, driven by the layerwise pretrain loop.
 
-A distribution spec is either a string ("gaussian" | "bernoulli" |
-"exponential") or, for the composite (`CompositeReconstructionDistribution`),
-a list of (name, data_size) pairs partitioning the feature axis.
+A distribution spec is one of:
+- a string: "gaussian" | "bernoulli" | "exponential";
+- a loss wrapper ("loss", loss_function[, activation]) — any ILossFunction
+  as the reconstruction "distribution" (reference:
+  `nn/conf/layers/variational/LossFunctionWrapper.java` — negLogProbability
+  delegates to the wrapped loss's per-example score; activation defaults
+  to identity);
+- for the composite (`CompositeReconstructionDistribution`), a list of
+  (spec, data_size) pairs partitioning the feature axis (entries may
+  themselves be loss wrappers).
 """
 
 from __future__ import annotations
@@ -25,9 +32,17 @@ from deeplearning4j_tpu.nn import activations
 # Reconstruction-distribution SPI
 
 
+def _is_loss_wrapper(dist) -> bool:
+    """("loss", loss_function[, activation]) spec — LossFunctionWrapper."""
+    return (isinstance(dist, (list, tuple)) and len(dist) in (2, 3)
+            and isinstance(dist[0], str) and dist[0] == "loss")
+
+
 def dist_input_size(dist, data_size: int) -> int:
     """Decoder-output width for `data_size` features (reference:
     `ReconstructionDistribution.distributionInputSize`)."""
+    if _is_loss_wrapper(dist):
+        return data_size  # LossFunctionWrapper.distributionInputSize
     if isinstance(dist, (list, tuple)) and not isinstance(dist, str):
         if sum(size for _, size in dist) != data_size:
             raise ValueError(
@@ -47,6 +62,13 @@ def dist_input_size(dist, data_size: int) -> int:
 def neg_log_prob(dist, x, pre):
     """Per-example negative log-probability [B] given decoder pre-output
     (reference: `exampleNegLogProbability` of each distribution impl)."""
+    if _is_loss_wrapper(dist):
+        # LossFunctionWrapper: the wrapped loss's per-example score stands
+        # in for -log p(x|z) (`LossFunctionWrapper.exampleNegLogProbability`).
+        from deeplearning4j_tpu.nn import losses as losses_mod
+
+        activation = dist[2] if len(dist) > 2 else "identity"
+        return losses_mod.compute_per_example(dist[1], x, pre, activation)
     if isinstance(dist, (list, tuple)) and not isinstance(dist, str):
         # Composite: slice x by data sizes and pre by distribution input
         # sizes, in order (reference `CompositeReconstructionDistribution

@@ -122,7 +122,7 @@ class Tsne:
                  switch_momentum_iteration: int = 100,
                  stop_lying_iteration: int = 250, exaggeration: float = 4.0,
                  min_gain: float = 0.01, normalize: bool = True,
-                 seed: int = 12345):
+                 seed: int = 12345, max_points: int = 20_000):
         self.n_components = n_components
         self.max_iter = max_iter
         self.perplexity = perplexity
@@ -135,6 +135,7 @@ class Tsne:
         self.min_gain = min_gain
         self.normalize = normalize
         self.seed = seed
+        self.max_points = max_points
         self.Y: Optional[np.ndarray] = None
         self.kl_divergences: Optional[np.ndarray] = None
 
@@ -143,6 +144,16 @@ class Tsne:
         N = len(X)
         if N <= self.n_components:
             raise ValueError("need more points than output dimensions")
+        if N > self.max_points:
+            # The documented dense-on-MXU trade (module docstring) is only a
+            # win in the plotting regime; make it explicit at runtime rather
+            # than silently allocating an [N, N] affinity matrix.
+            gb = 3 * N * N * 8 / 1e9  # P, Q, D2 fp64 resident together
+            raise ValueError(
+                f"N={N} exceeds max_points={self.max_points}: the dense "
+                f"formulation would allocate ~{gb:.0f} GB of [N, N] "
+                "matrices. Subsample the data, or pass max_points=N to "
+                "override explicitly")
         if self.normalize:
             # Reference normalization path: zero-mean, scaled by max |x|.
             X = X - X.mean(axis=0)

@@ -45,10 +45,15 @@ class InferenceServer:
     """
 
     def __init__(self, net, port: int = 0, host: str = "127.0.0.1",
-                 max_batch_size: int = 32, max_delay_ms: float = 5.0):
+                 max_batch_size: int = 32, max_delay_ms: float = 5.0,
+                 predict_timeout_s: Optional[float] = 300.0):
         self.net = net
         self.host = host
         self.port = port
+        # How long predict() waits for its batch; the first request after a
+        # model/shape change pays a fresh XLA compile, so the default is
+        # generous. None waits indefinitely.
+        self.predict_timeout_s = predict_timeout_s
         self.max_batch_size = int(max_batch_size)
         self.max_delay_s = float(max_delay_ms) / 1000.0
         self._queue: "queue.Queue[Optional[_Pending]]" = queue.Queue()
@@ -122,11 +127,14 @@ class InferenceServer:
                 for i in range(0, arr.shape[0], self.max_batch_size)])
         p = _Pending(arr)
         self._queue.put(p)
-        p.event.wait(timeout=60)
+        p.event.wait(timeout=self.predict_timeout_s)
         if p.error is not None:
             raise RuntimeError(p.error)
         if p.result is None:
-            raise TimeoutError("prediction timed out")
+            raise TimeoutError(
+                f"prediction timed out after {self.predict_timeout_s}s "
+                "(cold XLA compiles can be slow; raise predict_timeout_s "
+                "or pass None to wait indefinitely)")
         return p.result
 
     # --------------------------------------------------------------- http

@@ -114,3 +114,29 @@ class TestTsne:
     def test_too_few_points_raises(self):
         with pytest.raises(ValueError):
             Tsne().fit_transform(np.zeros((2, 3)))
+
+
+def test_kdtree_empty_queries_raise():
+    from deeplearning4j_tpu.clustering.kdtree import KDTree
+
+    t = KDTree(2)
+    with pytest.raises(ValueError, match="empty KDTree"):
+        t.nn(np.zeros(2))
+    with pytest.raises(ValueError, match="empty KDTree"):
+        t.knn(np.zeros(2), 3)
+
+
+def test_kmeans_cosine_seeding_uses_cosine(rng):
+    """k-means++ on cosine runs seeds by angle, not magnitude: two angular
+    clusters with very different norms must still split by direction."""
+    from deeplearning4j_tpu.clustering.kmeans import KMeansClustering
+
+    a = rng.randn(40, 2) * 0.05 + np.array([1.0, 0.0])
+    b = rng.randn(40, 2) * 0.05 + np.array([0.0, 1.0])
+    pts = np.concatenate([a * 100.0, b * 0.01])  # extreme magnitude skew
+    km = KMeansClustering(k=2, distance_function="cosine", seed=7)
+    cs = km.apply_to(pts.astype(np.float64))
+    assign = np.asarray(cs.assignments)
+    assert len(set(assign[:40])) == 1
+    assert len(set(assign[40:])) == 1
+    assert assign[0] != assign[40]

@@ -501,3 +501,116 @@ class TestRecordReaderMultiDataSetIterator:
               .build())
         with pytest.raises(ValueError, match="ran out of records"):
             list(it)
+
+
+class TestLFWAndCurves:
+    """Reference parity: LFWDataSetIterator + CurvesDataFetcher analogs
+    (zero-egress: local archives when present, deterministic synthetic
+    fallbacks otherwise)."""
+
+    def test_lfw_iterator_shapes_and_split(self):
+        from deeplearning4j_tpu.datasets.records import LFWDataSetIterator
+
+        it = LFWDataSetIterator(batch_size=8, num_examples=40,
+                                image_shape=(32, 32, 3), num_labels=4,
+                                train=True, split_train_test=0.75)
+        batches = list(it)
+        assert sum(b.features.shape[0] for b in batches) == 30
+        assert batches[0].features.shape[1:] == (32, 32, 3)
+        assert batches[0].labels.shape[1] == 4
+        assert batches[0].features.min() >= 0.0
+        assert batches[0].features.max() <= 1.0
+        test_it = LFWDataSetIterator(batch_size=8, num_examples=40,
+                                     image_shape=(32, 32, 3), num_labels=4,
+                                     train=False, split_train_test=0.75)
+        assert test_it.total_examples() == 10
+
+    def test_lfw_trains(self):
+        from deeplearning4j_tpu.datasets.records import LFWDataSetIterator
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import (
+            ConvolutionLayer, OutputLayer, SubsamplingLayer,
+        )
+        from deeplearning4j_tpu.nn.conf.neural_net import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        it = LFWDataSetIterator(batch_size=16, num_examples=48,
+                                image_shape=(16, 16, 3), num_labels=3)
+        conf = (NeuralNetConfiguration.builder()
+                .seed(3).learning_rate(0.01).updater("adam")
+                .list()
+                .layer(ConvolutionLayer(n_out=8, kernel_size=3,
+                                        convolution_mode="same",
+                                        activation="relu"))
+                .layer(SubsamplingLayer(kernel_size=2, stride=2))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss_function="mcxent"))
+                .set_input_type(InputType.convolutional(16, 16, 3))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        first = list(it)[0]
+        s0 = net.score(first)
+        for _ in range(10):
+            net.fit(it)
+        assert net.score(first) < s0
+
+    def test_curves_autoencoder_pretrain(self):
+        from deeplearning4j_tpu.datasets.records import (
+            CurvesDataSetIterator, load_curves,
+        )
+
+        ds = load_curves(num_examples=64)
+        assert ds.features.shape == (64, 784)
+        np.testing.assert_array_equal(ds.features, ds.labels)
+        assert 0.01 < ds.features.mean() < 0.5  # sparse curve pixels
+
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import AutoEncoder, OutputLayer
+        from deeplearning4j_tpu.nn.conf.neural_net import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        it = CurvesDataSetIterator(batch_size=32, num_examples=64)
+        conf = (NeuralNetConfiguration.builder()
+                .seed(1).learning_rate(0.1).updater("sgd")
+                .list()
+                .layer(AutoEncoder(n_out=32, activation="sigmoid"))
+                .layer(OutputLayer(n_out=784, activation="sigmoid",
+                                   loss_function="mse"))
+                .set_input_type(InputType.feed_forward(784))
+                .pretrain(True)
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.pretrain(it)
+        assert np.isfinite(net.score_value)
+
+
+class TestTsneGuard:
+    def test_oversize_raises(self, rng):
+        from deeplearning4j_tpu.plot.tsne import BarnesHutTsne
+
+        t = BarnesHutTsne(theta=0.5, max_points=100)
+        with pytest.raises(ValueError, match="max_points"):
+            t.fit_transform(rng.randn(101, 4))
+        # Explicit override runs (tiny budget keeps the test fast).
+        t2 = BarnesHutTsne(theta=0.5, max_points=101, max_iter=5)
+        Y = t2.fit_transform(rng.randn(101, 4))
+        assert Y.shape == (101, 2)
+
+
+def test_native_quoted_skip_region_falls_back(tmp_path):
+    """A quoted header region (logical rows can span physical lines) must
+    punt to the Python fallback so both paths start data at the same row."""
+    from deeplearning4j_tpu import native as native_mod
+    from deeplearning4j_tpu.datasets.records import CSVRecordReader
+
+    if not native_mod.native_available():
+        pytest.skip("no toolchain")
+    path = str(tmp_path / "q.csv")
+    with open(path, "w") as f:
+        f.write('"multi\nline header",x\n1,2\n3,4\n')
+    # Native path must refuse (quote in the skipped region)...
+    assert native_mod.parse_numeric_csv(path, ",", 1) is None
+    # ...and the public reader still parses via csv.reader, which counts
+    # the quoted header as ONE logical row.
+    m = CSVRecordReader(skip_num_lines=1).initialize(path).numeric_matrix()
+    np.testing.assert_array_equal(m, [[1, 2], [3, 4]])
