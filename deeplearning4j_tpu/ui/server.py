@@ -17,6 +17,17 @@ attaches to a `StatsStorage` and serves
 - `/api/sessions`        — session ids
 - `/api/static?sid=`     — model static info
 - `/api/updates?sid=`    — the full update stream as JSON
+- `/flow`                — network-graph page: the model topology (layer
+                           chain or ComputationGraph DAG) rendered as
+                           layered boxes + edges (reference flow module,
+                           `ui/module/flow/FlowListenerModule`)
+- `/tsne`                — t-SNE scatter of coords posted to `/api/tsne`
+                           or uploaded via `UIServer.upload_tsne(Y, labels)`
+                           (reference `ui/module/tsne/TsneModule`; compute
+                           coords with `plot/tsne.py`)
+- `/activations`         — convolutional activation grids from the latest
+                           `ConvolutionalListener` sample (reference
+                           `ui/module/convolutional/ConvolutionalListenerModule`)
 - `POST /remote`         — remote-receiver endpoint for
                            `RemoteStatsStorageRouter` (reference
                            `RemoteReceiverModule`); enable with
@@ -46,7 +57,7 @@ _STYLE = """<style>
  #meta { color: #555; font-size: 0.9em; white-space: pre-line; }
 </style>"""
 
-_NAV = ("<div id=nav><a href=/>overview</a> | <a href=/histogram>histograms</a> | <a href=/model>model</a> | <a href=/system>system</a></div>")
+_NAV = ("<div id=nav><a href=/>overview</a> | <a href=/histogram>histograms</a> | <a href=/model>model</a> | <a href=/system>system</a> | <a href=/flow>flow</a> | <a href=/tsne>t-SNE</a> | <a href=/activations>activations</a></div>")
 
 # Shared canvas line-chart renderer, interpolated into every page.
 _CHART_JS = """function drawSeries(canvas, series, labels) {
@@ -254,7 +265,211 @@ refresh();
 """
 
 
-for _n in ("_PAGE", "_HISTOGRAM_PAGE", "_MODEL_PAGE", "_SYSTEM_PAGE"):
+_FLOW_PAGE = """<!doctype html>
+<html><head><title>flow — deeplearning4j-tpu UI</title>
+{style}</head>
+<body>
+<h1>Network graph (reference: flow module)</h1>
+{nav}
+<div id="meta">loading…</div>
+<canvas id="graph" class="chart" width="980" height="640"></canvas>
+<script>
+function layout(conf) {
+  // MLN: a chain. CG: rank = 1 + max(rank of inputs) (topological layers).
+  if (conf.layers) {
+    return {nodes: conf.layers.map((l, i) => ({
+        id: 'layer_' + i, label: (l.name || ('layer_' + i)),
+        type: l['@class'] || '?', n_out: l.n_out, rank: i, col: 0})),
+      edges: conf.layers.slice(1).map((_, i) =>
+        ['layer_' + i, 'layer_' + (i + 1)])};
+  }
+  const nodes = [], edges = [], rank = {};
+  (conf.network_inputs || []).forEach((n, i) => {
+    rank[n] = 0;
+    nodes.push({id: n, label: n, type: 'input', rank: 0});
+  });
+  const vertices = conf.vertices || {};
+  const inputs = conf.vertex_inputs || {};
+  let changed = true, guard = 0;
+  while (changed && guard++ < 100) {
+    changed = false;
+    Object.keys(vertices).forEach(name => {
+      const ins = inputs[name] || [];
+      if (name in rank || !ins.every(i => i in rank)) return;
+      rank[name] = 1 + Math.max(...ins.map(i => rank[i]), 0);
+      const v = vertices[name];
+      nodes.push({id: name, label: name,
+        type: (v.layer ? v.layer['@class'] : v['@class']) || '?',
+        n_out: v.layer ? v.layer.n_out : undefined, rank: rank[name]});
+      ins.forEach(i => edges.push([i, name]));
+      changed = true;
+    });
+  }
+  return {nodes, edges};
+}
+async function refresh() {
+  const sessions = await (await fetch('api/sessions')).json();
+  if (!sessions.length) return;
+  const sid = sessions[sessions.length - 1];
+  const info = await (await fetch('api/static?sid=' + sid)).json();
+  const updates = await (await fetch('api/updates?sid=' + sid)).json();
+  if (!info.model_config_json) return;
+  const conf = JSON.parse(info.model_config_json);
+  const g = layout(conf);
+  document.getElementById('meta').textContent =
+    (info.model_class || '?') + ' — ' + g.nodes.length + ' nodes, ' +
+    g.edges.length + ' edges — ' + updates.length + ' update samples';
+  // update-magnitude coloring from the latest layer_stats sample
+  const last = [...updates].reverse().find(u => u.layer_stats) || {};
+  const mags = {};
+  Object.entries(last.layer_stats || {}).forEach(([lk, ps]) => {
+    mags[lk] = Math.max(...Object.values(ps).map(d => d.update_mm || 0));
+  });
+  const canvas = document.getElementById('graph');
+  const ctx = canvas.getContext('2d');
+  ctx.clearRect(0, 0, canvas.width, canvas.height);
+  const ranks = {};
+  g.nodes.forEach(n => (ranks[n.rank] = ranks[n.rank] || []).push(n));
+  const nRanks = Object.keys(ranks).length;
+  const pos = {};
+  Object.entries(ranks).forEach(([r, ns]) => {
+    ns.forEach((n, i) => {
+      pos[n.id] = [60 + (canvas.width - 200) * i / Math.max(1, ns.length - 1 || 1),
+                   40 + (canvas.height - 90) * r / Math.max(1, nRanks - 1)];
+      if (ns.length === 1) pos[n.id][0] = canvas.width / 2 - 70;
+    });
+  });
+  ctx.strokeStyle = '#999';
+  g.edges.forEach(([a, b]) => {
+    const [xa, ya] = pos[a], [xb, yb] = pos[b];
+    ctx.beginPath(); ctx.moveTo(xa + 70, ya + 14);
+    ctx.lineTo(xb + 70, yb); ctx.stroke();
+  });
+  const peak = Math.max(...Object.values(mags), 1e-12);
+  g.nodes.forEach(n => {
+    const [x, y] = pos[n.id];
+    const m = mags[n.id];
+    ctx.fillStyle = m === undefined ? '#e3f2fd'
+      : 'rgba(21,101,192,' + (0.15 + 0.6 * m / peak).toFixed(2) + ')';
+    ctx.fillRect(x, y, 140, 28);
+    ctx.strokeStyle = '#1565c0'; ctx.strokeRect(x, y, 140, 28);
+    ctx.fillStyle = '#111';
+    ctx.fillText(n.label + ' · ' + n.type.replace('Layer', '') +
+      (n.n_out ? ' · ' + n.n_out : ''), x + 4, y + 17);
+  });
+}
+refresh(); setInterval(refresh, 4000);
+</script></body></html>
+"""
+
+_TSNE_PAGE = """<!doctype html>
+<html><head><title>t-SNE — deeplearning4j-tpu UI</title>
+{style}</head>
+<body>
+<h1>t-SNE (reference: tsne module; coords from plot/tsne.py)</h1>
+{nav}
+<div id="meta">no coordinates uploaded — POST /api/tsne or
+UIServer.upload_tsne(Y, labels)</div>
+<canvas id="scatter" class="chart" width="860" height="640"></canvas>
+<script>
+async function refresh() {
+  const data = await (await fetch('api/tsne')).json();
+  if (!data.coords || !data.coords.length) return;
+  document.getElementById('meta').textContent =
+    data.coords.length + ' points' + (data.name ? ' — ' + data.name : '');
+  const canvas = document.getElementById('scatter');
+  const ctx = canvas.getContext('2d');
+  ctx.clearRect(0, 0, canvas.width, canvas.height);
+  const xs = data.coords.map(p => p[0]), ys = data.coords.map(p => p[1]);
+  const xmin = Math.min(...xs), xmax = Math.max(...xs);
+  const ymin = Math.min(...ys), ymax = Math.max(...ys);
+  const px = x => 20 + (canvas.width - 40) * (x - xmin) / Math.max(1e-12, xmax - xmin);
+  const py = y => canvas.height - 20 - (canvas.height - 40) * (y - ymin) / Math.max(1e-12, ymax - ymin);
+  const colors = ['#1565c0','#c62828','#2e7d32','#6a1b9a','#ef6c00',
+                  '#00838f','#5d4037','#455a64','#9e9d24','#d81b60'];
+  const labelIdx = {};
+  (data.labels || []).forEach(l => {
+    if (!(l in labelIdx)) labelIdx[l] = Object.keys(labelIdx).length;
+  });
+  data.coords.forEach((p, i) => {
+    const l = data.labels ? data.labels[i] : 0;
+    ctx.fillStyle = colors[(labelIdx[l] || 0) % colors.length];
+    ctx.beginPath();
+    ctx.arc(px(p[0]), py(p[1]), 3, 0, 6.3);
+    ctx.fill();
+    if (data.point_names) ctx.fillText(data.point_names[i], px(p[0]) + 4, py(p[1]));
+  });
+  Object.entries(labelIdx).forEach(([l, i]) => {
+    ctx.fillStyle = colors[i % colors.length];
+    ctx.fillText(String(l), 8, 16 + 14 * i);
+  });
+}
+refresh(); setInterval(refresh, 5000);
+</script></body></html>
+"""
+
+_ACTIVATIONS_PAGE = """<!doctype html>
+<html><head><title>activations — deeplearning4j-tpu UI</title>
+{style}</head>
+<body>
+<h1>Convolutional activations (reference: convolutional module)</h1>
+{nav}
+<div id="meta">waiting for a ConvolutionalListener sample…</div>
+<div id="grids"></div>
+<script>
+function drawGrid(canvas, act) {
+  // act: {h, w, channels: [[row-major floats]]} — grayscale tiles.
+  const n = act.channels.length;
+  const cols = Math.min(n, 8), rows = Math.ceil(n / cols);
+  const cw = act.w * 3, ch = act.h * 3;
+  canvas.width = cols * (cw + 4); canvas.height = rows * (ch + 4);
+  const ctx = canvas.getContext('2d');
+  act.channels.forEach((chan, ci) => {
+    let lo = Infinity, hi = -Infinity;
+    chan.forEach(v => { lo = Math.min(lo, v); hi = Math.max(hi, v); });
+    const img = ctx.createImageData(act.w, act.h);
+    chan.forEach((v, i) => {
+      const g = Math.round(255 * (v - lo) / Math.max(1e-12, hi - lo));
+      img.data[4 * i] = img.data[4 * i + 1] = img.data[4 * i + 2] = g;
+      img.data[4 * i + 3] = 255;
+    });
+    const ox = (ci % cols) * (cw + 4), oy = Math.floor(ci / cols) * (ch + 4);
+    // scale via a temp canvas
+    const tmp = document.createElement('canvas');
+    tmp.width = act.w; tmp.height = act.h;
+    tmp.getContext('2d').putImageData(img, 0, 0);
+    ctx.imageSmoothingEnabled = false;
+    ctx.drawImage(tmp, ox, oy, cw, ch);
+  });
+}
+async function refresh() {
+  const sessions = await (await fetch('api/sessions')).json();
+  if (!sessions.length) return;
+  const updates = await (await fetch('api/updates?sid=' +
+      sessions[sessions.length - 1])).json();
+  const last = [...updates].reverse().find(u => u.conv_activations);
+  if (!last) return;
+  document.getElementById('meta').textContent =
+    'iteration ' + last.iteration;
+  const div = document.getElementById('grids');
+  div.textContent = '';
+  Object.entries(last.conv_activations).forEach(([name, act]) => {
+    const h2 = document.createElement('h2');
+    h2.textContent = name + '  [' + act.h + 'x' + act.w + ' x ' +
+      act.channels.length + 'ch]';
+    const c = document.createElement('canvas');
+    c.className = 'chart';
+    div.appendChild(h2); div.appendChild(c);
+    drawGrid(c, act);
+  });
+}
+refresh(); setInterval(refresh, 5000);
+</script></body></html>
+"""
+
+
+for _n in ("_PAGE", "_HISTOGRAM_PAGE", "_MODEL_PAGE", "_SYSTEM_PAGE",
+           "_FLOW_PAGE", "_TSNE_PAGE", "_ACTIVATIONS_PAGE"):
     globals()[_n] = (globals()[_n]
                      .replace("{style}", _STYLE)
                      .replace("{chart_js}", _CHART_JS)
@@ -264,6 +479,7 @@ for _n in ("_PAGE", "_HISTOGRAM_PAGE", "_MODEL_PAGE", "_SYSTEM_PAGE"):
 class _Handler(BaseHTTPRequestHandler):
     storage: Optional[StatsStorage] = None
     enable_remote: bool = False
+    tsne_data: Optional[dict] = None  # latest uploaded t-SNE coords
 
     def log_message(self, *args):  # quiet
         pass
@@ -285,10 +501,28 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_POST(self):
+        storage = type(self).storage
+        path = urlparse(self.path).path
+        if path == "/api/tsne":
+            # t-SNE coord upload (reference: TsneModule's file upload).
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(length))
+                coords = payload["coords"]
+                if not coords or len(coords[0]) != 2:
+                    raise ValueError("coords must be a [N, 2] list")
+                type(self).tsne_data = {
+                    "coords": coords,
+                    "labels": payload.get("labels"),
+                    "point_names": payload.get("point_names"),
+                    "name": payload.get("name"),
+                }
+            except Exception as e:
+                return self._json({"error": str(e)}, 400)
+            return self._json({"ok": True, "n": len(coords)})
         # Remote-receiver endpoint (reference: `RemoteReceiverModule` —
         # must be explicitly enabled, like the reference's enable flag).
-        storage = type(self).storage
-        if urlparse(self.path).path != "/remote":
+        if path != "/remote":
             return self._json({"error": "not found"}, 404)
         if not type(self).enable_remote:
             return self._json({"error": "remote receiver disabled"}, 403)
@@ -319,6 +553,14 @@ class _Handler(BaseHTTPRequestHandler):
             self._html(_MODEL_PAGE)
         elif url.path == "/system":
             self._html(_SYSTEM_PAGE)
+        elif url.path == "/flow":
+            self._html(_FLOW_PAGE)
+        elif url.path == "/tsne":
+            self._html(_TSNE_PAGE)
+        elif url.path == "/activations":
+            self._html(_ACTIVATIONS_PAGE)
+        elif url.path == "/api/tsne":
+            self._json(type(self).tsne_data or {})
         elif url.path == "/api/sessions":
             self._json(storage.list_session_ids() if storage else [])
         elif url.path == "/api/static":
@@ -345,6 +587,24 @@ class UIServer:
 
     def attach(self, storage: StatsStorage) -> "UIServer":
         self._handler.storage = storage
+        return self
+
+    def upload_tsne(self, coords, labels=None, point_names=None,
+                    name: Optional[str] = None) -> "UIServer":
+        """Publish a t-SNE embedding to the `/tsne` page (compute coords
+        with `plot.tsne.Tsne().fit_transform(X)`). In-process equivalent
+        of POSTing to `/api/tsne`."""
+        import numpy as np
+
+        coords = np.asarray(coords, float)
+        if coords.ndim != 2 or coords.shape[1] != 2:
+            raise ValueError(f"coords must be [N, 2], got {coords.shape}")
+        self._handler.tsne_data = {
+            "coords": coords.tolist(),
+            "labels": None if labels is None else list(labels),
+            "point_names": None if point_names is None else list(point_names),
+            "name": name,
+        }
         return self
 
     def start(self) -> "UIServer":

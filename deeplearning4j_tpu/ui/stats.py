@@ -145,6 +145,61 @@ class StatsListener(IterationListener):
         self.storage.put_update(record)
 
 
+class ConvolutionalListener(IterationListener):
+    """Sample convolutional activation grids for the UI's `/activations`
+    page (reference: `ui/module/convolutional/ConvolutionalListenerModule`
+    fed by `ConvolutionalIterationListener` — activation maps rendered as
+    image grids).
+
+    The reference listener grabs the live minibatch's activations off the
+    mutable model; the jitted engines don't keep batches around, so this
+    listener carries its own fixed `probe_input` (one example is enough)
+    and runs a forward pass on the sampled iterations. 4-D [1, H, W, C]
+    activations are strided down to `max_hw` per side and capped at
+    `max_channels`, then shipped as row-major float lists in the update
+    record under `conv_activations`.
+
+    Pass the StatsListener's `session_id` when using both, so the UI sees
+    one merged update stream."""
+
+    def __init__(self, storage: StatsStorageRouter, probe_input,
+                 frequency: int = 25, session_id: Optional[str] = None,
+                 max_hw: int = 24, max_channels: int = 16):
+        self.storage = storage
+        self.probe = np.asarray(probe_input)[:1]
+        self.frequency = max(1, int(frequency))
+        self.session_id = session_id or f"session_{uuid.uuid4().hex[:12]}"
+        self.max_hw = int(max_hw)
+        self.max_channels = int(max_channels)
+
+    def iteration_done(self, model, iteration: int) -> None:
+        if iteration % self.frequency != 0:
+            return
+        acts = model.feed_forward(self.probe)
+        grids: Dict[str, Any] = {}
+        names = getattr(model, "layer_keys", None) or [
+            f"layer_{i}" for i in range(len(acts))]
+        for name, a in zip(names, acts):
+            a = np.asarray(a, dtype="float32")
+            if a.ndim != 4:  # NHWC conv activations only
+                continue
+            a = a[0]
+            sh = max(1, a.shape[0] // self.max_hw)
+            sw = max(1, a.shape[1] // self.max_hw)
+            a = a[::sh, ::sw, : self.max_channels]
+            grids[name] = {
+                "h": int(a.shape[0]), "w": int(a.shape[1]),
+                "channels": [a[:, :, c].ravel().tolist()
+                             for c in range(a.shape[2])],
+            }
+        if grids:
+            self.storage.put_update({
+                "session_id": self.session_id,
+                "iteration": int(iteration),
+                "conv_activations": grids,
+            })
+
+
 class ProfilerListener(IterationListener):
     """Opt-in `jax.profiler` trace around a window of iterations — the
     XPlane-level analog of the reference's per-phase timing stats

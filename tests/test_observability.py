@@ -291,3 +291,108 @@ class TestSystemPage:
             assert "Device memory" in html and "host_rss_mb" in html
         finally:
             server.stop()
+
+
+class TestRound5UIModules:
+    """The three reference UI modules added in round 5: flow (network
+    graph), t-SNE, convolutional activations — pages render and their data
+    routes serve live content during a fit (reference:
+    `deeplearning4j-play/.../ui/module/{flow,tsne,convolutional}/`)."""
+
+    def _get(self, server, path):
+        with urllib.request.urlopen(server.url.rstrip("/") + path,
+                                    timeout=5) as r:
+            return r.status, r.read()
+
+    def test_flow_page_and_graph_json(self, rng):
+        storage = InMemoryStatsStorage()
+        net = mlp_net()
+        net.set_listeners(StatsListener(storage, frequency=1,
+                                        session_id="f1",
+                                        collect_histograms=False))
+        x, y = batch(rng)
+        net.fit(x, y)
+        server = UIServer(port=0).attach(storage).start()
+        try:
+            status, body = self._get(server, "/flow")
+            assert status == 200 and b"Network graph" in body
+            # The page's data source: static info must carry the config
+            # JSON the layout() JS walks.
+            _, body = self._get(server, "/api/static?sid=f1")
+            conf = json.loads(json.loads(body)["model_config_json"])
+            assert "layers" in conf or "vertices" in conf
+        finally:
+            server.stop()
+
+    def test_tsne_page_and_upload(self, rng):
+        from deeplearning4j_tpu.plot.tsne import Tsne
+
+        X = np.concatenate([rng.randn(15, 4), rng.randn(15, 4) + 6.0])
+        Y = Tsne(max_iter=30, perplexity=5.0).fit_transform(X)
+        labels = [0] * 15 + [1] * 15
+
+        server = UIServer(port=0).attach(InMemoryStatsStorage()).start()
+        try:
+            server.upload_tsne(Y, labels=labels, name="test-embedding")
+            status, body = self._get(server, "/tsne")
+            assert status == 200 and b"t-SNE" in body
+            _, body = self._get(server, "/api/tsne")
+            data = json.loads(body)
+            assert len(data["coords"]) == 30 and data["labels"] == labels
+            # HTTP upload path too (the reference's file-upload analog).
+            req = urllib.request.Request(
+                server.url.rstrip("/") + "/api/tsne",
+                data=json.dumps({"coords": [[0.0, 1.0], [1.0, 0.0]],
+                                 "labels": ["a", "b"]}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=5) as r:
+                assert json.loads(r.read())["n"] == 2
+            _, body = self._get(server, "/api/tsne")
+            assert len(json.loads(body)["coords"]) == 2
+        finally:
+            server.stop()
+
+    def test_activations_page_live_during_fit(self, rng):
+        from deeplearning4j_tpu.nn.conf.layers import (
+            ConvolutionLayer, OutputLayer, SubsamplingLayer,
+        )
+        from deeplearning4j_tpu.ui.stats import ConvolutionalListener
+
+        conf = (NeuralNetConfiguration.builder()
+                .seed(4).learning_rate(0.01).updater("adam")
+                .list()
+                .layer(ConvolutionLayer(n_out=6, kernel_size=3,
+                                        convolution_mode="same",
+                                        activation="relu"))
+                .layer(SubsamplingLayer(kernel_size=2, stride=2))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss_function="mcxent"))
+                .set_input_type(InputType.convolutional(12, 12, 1))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        storage = InMemoryStatsStorage()
+        probe = rng.rand(1, 12, 12, 1).astype("float32")
+        net.set_listeners(
+            StatsListener(storage, frequency=1, session_id="c1",
+                          collect_histograms=False),
+            ConvolutionalListener(storage, probe, frequency=1,
+                                  session_id="c1"))
+        x = rng.rand(8, 12, 12, 1).astype("float32")
+        y = np.eye(3, dtype="float32")[rng.randint(0, 3, 8)]
+        net.fit(DataSet(x, y))
+
+        server = UIServer(port=0).attach(storage).start()
+        try:
+            status, body = self._get(server, "/activations")
+            assert status == 200 and b"Convolutional activations" in body
+            _, body = self._get(server, "/api/updates?sid=c1")
+            ups = json.loads(body)
+            conv = [u for u in ups if "conv_activations" in u]
+            assert conv, "no activation sample reached storage"
+            grids = conv[-1]["conv_activations"]
+            assert "layer_0" in grids
+            g = grids["layer_0"]
+            assert len(g["channels"]) == 6
+            assert len(g["channels"][0]) == g["h"] * g["w"]
+        finally:
+            server.stop()
