@@ -326,10 +326,18 @@ def bench_word2vec(steps, warmup):
     # way the reference's PerformanceListener reports it.
     Word2Vec(**kw).fit(sents)
     w2v = Word2Vec(**kw)
+    rtt_ms, mibps = _link_probe()
     t0 = time.perf_counter()
     w2v.fit(sents)
     dt = time.perf_counter() - t0
-    return _entry("word2vec_skipgram_words_per_sec", n_words / dt, "words/sec")
+    e = _entry("word2vec_skipgram_words_per_sec", n_words / dt, "words/sec",
+               note=("dispatch-paced over the shared tunnel: each K-flush "
+                     "scan costs one RTT, so words/sec scales ~1/RTT "
+                     "(460-490k at ~10 ms RTT, PERF.md §5); tunnel_rtt_ms "
+                     "is the in-run measurement"))
+    e["tunnel_rtt_ms"] = round(rtt_ms, 2)
+    e["link_mibps"] = round(mibps, 1)
+    return e
 
 
 def bench_vgg16_dp(steps, warmup):

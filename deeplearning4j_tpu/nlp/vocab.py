@@ -86,6 +86,21 @@ def build_huffman(cache: VocabCache, max_code_length: int = 40) -> int:
     return n_inner
 
 
+def vocab_from_arrays(words: List[str], counts) -> VocabCache:
+    """Assemble a finalized VocabCache from pre-sorted (word, count) arrays
+    — the native `fastvocab` builder's output (already in finalize_vocab
+    order). Huffman codes are NOT assigned; call `build_huffman`."""
+    cache = VocabCache()
+    total = 0.0
+    for i, (w, c) in enumerate(zip(words, counts)):
+        vw = VocabWord(word=w, frequency=float(c), index=i)
+        cache._words[w] = vw
+        cache._by_index.append(vw)
+        total += float(c)
+    cache.total_word_count = total
+    return cache
+
+
 class VocabConstructor:
     """Build a vocab from token-sequence sources (reference:
     `VocabConstructor.buildJointVocabulary`)."""

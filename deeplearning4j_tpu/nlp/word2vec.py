@@ -22,6 +22,7 @@ from deeplearning4j_tpu.nlp.tokenization import TokenizerFactory, tokenize_corpu
 from deeplearning4j_tpu.nlp.vocab import (
     VocabCache,
     VocabConstructor,
+    vocab_from_arrays,
     build_huffman,
     make_unigram_table,
 )
@@ -136,7 +137,9 @@ class Word2Vec(WordVectors):
             raise ValueError(
                 "no sentences to train on — pass them to the constructor or "
                 "to fit(sentences=...)")
-        corpus = tokenize_corpus(self._sentences, self.tokenizer_factory)
+        sentences = (self._sentences
+                     if isinstance(self._sentences, (list, tuple))
+                     else list(self._sentences))
         rng = np.random.RandomState(self.seed)
         # RESUME path (reference `loadFullModel` + continued training): a
         # model restored by `nlp/serializer.load_full_model` arrives with
@@ -144,8 +147,24 @@ class Word2Vec(WordVectors):
         # new corpus (restricted to the existing vocab) instead of
         # rebuilding/re-initializing.
         resume = self.vocab is not None and self.syn0 is not None
+        # Native fast path: tokenize + count + encode in C++
+        # (`native/fastvocab.cpp`), guaranteed Python-identical or refused
+        # (PERF.md §5's host string-handling cost).
+        fast = None
         if not resume:
-            self.vocab = VocabConstructor(self.min_word_frequency).build(corpus)
+            from deeplearning4j_tpu import native as native_mod
+
+            fast = native_mod.build_vocab_corpus(
+                sentences, self.min_word_frequency, self.tokenizer_factory)
+        corpus = (None if fast is not None
+                  else tokenize_corpus(sentences, self.tokenizer_factory))
+        if not resume:
+            if fast is not None:
+                words, counts, fast_seqs = fast
+                self.vocab = vocab_from_arrays(words, counts)
+            else:
+                self.vocab = VocabConstructor(
+                    self.min_word_frequency).build(corpus)
             n_inner = build_huffman(self.vocab)
             V, D = self.vocab.num_words(), self.layer_size
             # Reference init: syn0 ~ U(-0.5/D, 0.5/D), syn1 zeros.
@@ -179,11 +198,14 @@ class Word2Vec(WordVectors):
                 jnp.float32).at[:, 0].set(1.0)
 
         max_code = max((len(w.codes) for w in self.vocab._by_index), default=1) or 1
-        seqs = [
-            np.asarray([self.vocab.index_of(t) for t in seq if self.vocab.contains_word(t)],
-                       np.int32)
-            for seq in corpus
-        ]
+        if fast is not None:
+            seqs = fast_seqs  # already index-encoded with OOV dropped
+        else:
+            seqs = [
+                np.asarray([self.vocab.index_of(t) for t in seq
+                            if self.vocab.contains_word(t)], np.int32)
+                for seq in corpus
+            ]
         seqs = [s for s in seqs if len(s) >= 1]
         total_words = sum(len(s) for s in seqs) * self.epochs * self.iterations
         words_done = 0

@@ -308,3 +308,115 @@ class TestDistributedWord2Vec:
         mesh = mesh_mod.create_mesh((8,), axis_names=("data",))
         with pytest.raises(ValueError, match="divisible"):
             Word2Vec(batch_size=100, mesh=mesh).fit([["a", "b", "c"]])
+
+
+class TestNativeVocab:
+    """`native/fastvocab.cpp` vs the Python VocabConstructor path: the
+    native builder must be byte-for-byte identical or refuse (None)."""
+
+    def _python_ref(self, sentences, min_freq, factory=None):
+        from deeplearning4j_tpu.nlp.tokenization import (
+            TokenizerFactory, tokenize_corpus,
+        )
+        from deeplearning4j_tpu.nlp.vocab import VocabConstructor
+
+        corpus = tokenize_corpus(sentences, factory or TokenizerFactory())
+        vocab = VocabConstructor(min_freq).build(corpus)
+        seqs = [[vocab.index_of(t) for t in seq if vocab.contains_word(t)]
+                for seq in corpus]
+        return ([w.word for w in vocab._by_index],
+                [w.frequency for w in vocab._by_index], seqs)
+
+    def test_matches_python_presplit(self):
+        from deeplearning4j_tpu import native as native_mod
+
+        if native_mod._lib("fastvocab") is None:
+            pytest.skip("no toolchain")
+        sents = [["b", "a", "b", "c"], ["a", "b"], [], ["zz", "a", "a"],
+                 ["tie1", "tie2"]]  # ties sort lexicographically
+        got = native_mod.build_vocab_corpus(sents, 1.0)
+        assert got is not None
+        words, counts, seqs = got
+        w_ref, c_ref, s_ref = self._python_ref(sents, 1)
+        assert words == w_ref
+        assert counts.tolist() == c_ref
+        assert [s.tolist() for s in seqs] == s_ref
+        # min_freq filter drops singletons identically (OOV skipped).
+        got2 = native_mod.build_vocab_corpus(sents, 2.0)
+        w2, c2, s2 = got2
+        w_ref2, c_ref2, s_ref2 = self._python_ref(sents, 2)
+        assert w2 == w_ref2 and [s.tolist() for s in s2] == s_ref2
+
+    def test_matches_python_raw_with_preprocessor(self):
+        from deeplearning4j_tpu import native as native_mod
+        from deeplearning4j_tpu.nlp.tokenization import (
+            CommonPreprocessor, TokenizerFactory,
+        )
+
+        if native_mod._lib("fastvocab") is None:
+            pytest.skip("no toolchain")
+        factory = TokenizerFactory(CommonPreprocessor())
+        sents = ["The QUICK brown fox, 42 times!",
+                 "the (quick) dog...   and\tthe fox",
+                 "1234 ,,, !!!"]  # tokens that strip to nothing
+        got = native_mod.build_vocab_corpus(sents, 1.0, factory)
+        assert got is not None
+        words, counts, seqs = got
+        w_ref, c_ref, s_ref = self._python_ref(sents, 1, factory)
+        assert words == w_ref
+        assert counts.tolist() == c_ref
+        assert [s.tolist() for s in seqs] == s_ref
+
+    def test_exactness_guards_refuse(self):
+        from deeplearning4j_tpu import native as native_mod
+        from deeplearning4j_tpu.nlp.tokenization import (
+            CommonPreprocessor, EndingPreProcessor, TokenizerFactory,
+        )
+
+        if native_mod._lib("fastvocab") is None:
+            pytest.skip("no toolchain")
+        # Non-ASCII with the preprocessor: Python lower() is unicode-aware.
+        assert native_mod.build_vocab_corpus(
+            ["Füchse sind schlau"], 1.0,
+            TokenizerFactory(CommonPreprocessor())) is None
+        # Unsupported preprocessor.
+        assert native_mod.build_vocab_corpus(
+            ["plain text"], 1.0,
+            TokenizerFactory(EndingPreProcessor())) is None
+        # Pre-split token containing the separator byte.
+        assert native_mod.build_vocab_corpus(
+            [["ok", "bad token"]], 1.0) is None
+        # Raw sentence containing an embedded newline.
+        assert native_mod.build_vocab_corpus(["a b\nc d"], 1.0) is None
+        # Mixed str/list corpus.
+        assert native_mod.build_vocab_corpus(["a b", ["c"]], 1.0) is None
+        # Non-ASCII PRE-SPLIT tokens are fine (UTF-8 byte order == code
+        # point order for the sort tie-break).
+        got = native_mod.build_vocab_corpus([["é", "a", "é"]], 1.0)
+        assert got is not None and got[0] == ["é", "a"]
+
+    def test_word2vec_fit_uses_fast_path_same_result(self):
+        """End-to-end: Word2Vec trained via the native vocab path equals a
+        run forced onto the Python path (same vocab -> same kernels)."""
+        from deeplearning4j_tpu import native as native_mod
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+        if native_mod._lib("fastvocab") is None:
+            pytest.skip("no toolchain")
+        rng = np.random.RandomState(0)
+        words = [f"w{i}" for i in range(50)]
+        sents = [[words[j] for j in rng.randint(0, 50, 30)]
+                 for _ in range(40)]
+        kw = dict(layer_size=16, window_size=3, min_word_frequency=2,
+                  sample=0, negative=0, seed=3, batch_size=256)
+        m1 = Word2Vec(**kw).fit(sents)
+
+        real = native_mod.build_vocab_corpus
+        native_mod.build_vocab_corpus = lambda *a, **k: None
+        try:
+            m2 = Word2Vec(**kw).fit(sents)
+        finally:
+            native_mod.build_vocab_corpus = real
+        assert m1.vocab.words() == m2.vocab.words()
+        np.testing.assert_allclose(np.asarray(m1.syn0), np.asarray(m2.syn0),
+                                   rtol=1e-6, atol=1e-7)
