@@ -134,3 +134,174 @@ def test_two_process_training_matches_single_machine(tmp_path):
             np.testing.assert_allclose(
                 got[f"{lk}/{pk}"], np.asarray(v), rtol=2e-5, atol=2e-6,
                 err_msg=f"param {lk}/{pk} diverged from single-machine run")
+
+
+GRAPH_CONF = textwrap.dedent("""
+    import numpy as np
+    from deeplearning4j_tpu.nn.conf.graph import MergeVertex
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.conf.neural_net import NeuralNetConfiguration
+
+    def make_conf():
+        gb = (NeuralNetConfiguration.builder()
+              .seed(9).learning_rate(0.1).updater("sgd")
+              .graph_builder()
+              .add_inputs("in")
+              .add_layer("a", DenseLayer(n_out=12, activation="tanh"), "in")
+              .add_layer("b", DenseLayer(n_out=12, activation="relu"), "in")
+              .add_vertex("m", MergeVertex(), "a", "b")
+              .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                            loss_function="mcxent"), "m")
+              .set_outputs("out"))
+        gb.set_input_types(InputType.feed_forward(4))
+        return gb.build()
+
+    def make_data(step):
+        r = np.random.RandomState(200 + step)
+        X = r.randn(16, 4).astype("float32")
+        Y = np.eye(3)[r.randint(0, 3, 16)].astype("float32")
+        return X, Y
+""")
+
+
+GRAPH_WORKER = """
+import os, sys
+pid = int(sys.argv[1]); port = sys.argv[2]; out = sys.argv[3]
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.extend
+jax.extend.backend.clear_backends()
+jax.config.update("jax_num_cpu_devices", 2)
+from deeplearning4j_tpu.parallel import distributed as dist
+dist.initialize(coordinator_address="127.0.0.1:" + port,
+                num_processes=2, process_id=pid)
+assert dist.process_count() == 2 and jax.device_count() == 4
+
+{conf_code}
+
+import numpy as np
+from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+net = ComputationGraph(make_conf()).init()
+{mesh_code}
+for step in range({steps}):
+    X, Y = make_data(step)
+    lo, hi = pid * 8, (pid + 1) * 8
+    trainer.fit(MultiDataSet(features=[X[lo:hi]], labels=[Y[lo:hi]]))
+if pid == 0:
+    flat = {{f"{{k}}/{{p}}": np.asarray(v)
+            for k, layer in net.params_tree.items()
+            for p, v in layer.items()}}
+    np.savez(out, **flat)
+print("worker", pid, "done", flush=True)
+"""
+
+MLN_TP_WORKER = """
+import os, sys
+pid = int(sys.argv[1]); port = sys.argv[2]; out = sys.argv[3]
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.extend
+jax.extend.backend.clear_backends()
+jax.config.update("jax_num_cpu_devices", 2)
+from deeplearning4j_tpu.parallel import distributed as dist
+dist.initialize(coordinator_address="127.0.0.1:" + port,
+                num_processes=2, process_id=pid)
+assert dist.process_count() == 2 and jax.device_count() == 4
+
+{conf_code}
+
+import numpy as np
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+net = MultiLayerNetwork(make_conf()).init()
+# dp x tp global mesh: 2 data x 2 model over 4 devices / 2 processes.
+mesh = dist.global_mesh((2, 2), axis_names=("data", "model"))
+trainer = dist.DistributedTrainer(net, mesh=mesh, model_axis="model")
+for step in range({steps}):
+    X, Y = make_data(step)
+    lo, hi = pid * 8, (pid + 1) * 8
+    trainer.fit(DataSet(X[lo:hi], Y[lo:hi]))
+if pid == 0:
+    flat = {{f"{{k}}/{{p}}": np.asarray(v)
+            for k, layer in net.params_tree.items()
+            for p, v in layer.items()}}
+    np.savez(out, **flat)
+print("worker", pid, "done", flush=True)
+"""
+
+
+def _run_two_workers(tmp_path, script_text):
+    port = _free_port()
+    script = tmp_path / "worker.py"
+    script.write_text(script_text)
+    out = tmp_path / "params.npz"
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["JAX_PLATFORMS"] = "cpu"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(pid), str(port), str(out)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, text=True)
+        for pid in (0, 1)]
+    try:
+        outputs = [p.communicate(timeout=240)[0] for p in procs]
+        for p, text in zip(procs, outputs):
+            assert p.returncode == 0, f"worker failed:\n{text[-3000:]}"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return out, port
+
+
+def test_two_process_graph_training_matches_single_machine(tmp_path):
+    """ComputationGraph (branch + merge topology) across 2 real processes
+    equals the single-machine run (round-5 multi-host hardening)."""
+    from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    script = GRAPH_WORKER.format(
+        conf_code=GRAPH_CONF, steps=STEPS,
+        mesh_code="trainer = dist.DistributedTrainer(net)")
+    out, _ = _run_two_workers(tmp_path, script)
+
+    ns = {}
+    exec(GRAPH_CONF, ns)
+    cg = ComputationGraph(ns["make_conf"]()).init()
+    for step in range(STEPS):
+        X, Y = ns["make_data"](step)
+        cg.fit(MultiDataSet(features=[X], labels=[Y]))
+
+    got = np.load(str(out))
+    for lk, layer in cg.params_tree.items():
+        for pk, v in layer.items():
+            np.testing.assert_allclose(
+                got[f"{lk}/{pk}"], np.asarray(v), rtol=2e-5, atol=2e-6,
+                err_msg=f"graph param {lk}/{pk} diverged")
+
+
+def test_two_process_dp_tp_mesh_matches_single_machine(tmp_path):
+    """2-process dp(2) x tp(2) mesh: tensor-parallel weight sharding
+    composed with cross-host data parallelism still reproduces the
+    single-machine parameters."""
+    script = MLN_TP_WORKER.format(conf_code=_conf_code(), steps=STEPS)
+    out, _ = _run_two_workers(tmp_path, script)
+
+    ns = {}
+    exec(_conf_code(), ns)
+    net = MultiLayerNetwork(ns["make_conf"]()).init()
+    for step in range(STEPS):
+        X, Y = ns["make_data"](step)
+        net.fit(DataSet(X, Y))
+
+    got = np.load(str(out))
+    for lk, layer in net.params_tree.items():
+        for pk, v in layer.items():
+            np.testing.assert_allclose(
+                got[f"{lk}/{pk}"], np.asarray(v), rtol=2e-5, atol=2e-6,
+                err_msg=f"param {lk}/{pk} diverged (dp x tp)")
