@@ -1,0 +1,166 @@
+"""Distributed corpus pipeline: multi-host tokenization, vocab build, and
+cooccurrence counting.
+
+Reference analog: `dl4j-spark-nlp`'s `TextPipeline.java` (map-reduce word
+counting over corpus partitions) and
+`spark/models/embeddings/glove/cooccurrences/` (partitioned cooccurrence
+counting). The Spark machinery maps to the TPU-native stack as: each
+PROCESS counts its own corpus shard with the native tokenizer/counter
+(`native/fastvocab.cpp`), and the partial results merge through the
+jax.distributed collective fabric (`multihost_utils.process_allgather`
+over the same Gloo/ICI transport the trainers use) — no extra cluster
+runtime, same determinism guarantees as the single-host path:
+
+- `distributed_vocab(shard)` returns the IDENTICAL VocabCache on every
+  process — counts are summed globally before the min-frequency filter
+  and the (-freq, word) finalize ordering — plus the local shard encoded
+  against that global vocab (per-token work stays native/vectorized: the
+  local encoding is remapped local-id -> global-id with one gather).
+- `distributed_cooccurrences(seqs_shard)` merges per-shard windowed
+  COO counts (1/distance weighting, `nlp/glove.py` semantics) into the
+  same (rows, cols, weights) every process would get counting the whole
+  corpus alone.
+
+Single-process degenerates to the local path (process_allgather of one
+shard), so the same code runs everywhere — tested 2-process in
+`tests/test_distributed.py`.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.tokenization import TokenizerFactory, tokenize_corpus
+from deeplearning4j_tpu.nlp.vocab import (
+    VocabCache,
+    build_huffman,
+    vocab_from_arrays,
+)
+
+
+def _allgather_bytes(buf: bytes) -> List[bytes]:
+    """Gather one variable-length byte string from every process."""
+    from jax.experimental import multihost_utils
+
+    lens = np.atleast_1d(np.asarray(
+        multihost_utils.process_allgather(np.asarray(len(buf), np.int64))))
+    L = max(1, int(lens.max()))
+    padded = np.zeros((L,), np.uint8)
+    if buf:
+        padded[: len(buf)] = np.frombuffer(buf, np.uint8)
+    gathered = np.asarray(multihost_utils.process_allgather(padded))
+    gathered = gathered.reshape(len(lens), L)
+    return [gathered[i, : int(lens[i])].tobytes() for i in range(len(lens))]
+
+
+def _local_counts(sentences, tokenizer_factory):
+    """(words, counts, local_seqs) for THIS shard, unfiltered (min_freq=1 —
+    the global filter applies after the merge). Native when eligible."""
+    from deeplearning4j_tpu import native as native_mod
+
+    sentences = (sentences if isinstance(sentences, (list, tuple))
+                 else list(sentences))
+    fast = native_mod.build_vocab_corpus(sentences, 1.0, tokenizer_factory)
+    if fast is not None:
+        words, counts, seqs = fast
+        return list(words), np.asarray(counts, np.float64), seqs
+    corpus = tokenize_corpus(sentences,
+                             tokenizer_factory or TokenizerFactory())
+    order: List[str] = []
+    idx = {}
+    counts: List[float] = []
+    seqs = []
+    for seq in corpus:
+        enc = np.empty(len(seq), np.int32)
+        for i, tok in enumerate(seq):
+            j = idx.get(tok)
+            if j is None:
+                j = len(order)
+                idx[tok] = j
+                order.append(tok)
+                counts.append(0.0)
+            counts[j] += 1.0
+            enc[i] = j
+        seqs.append(enc)
+    # Match the native path's output convention (first-seen local ids).
+    return order, np.asarray(counts, np.float64), seqs
+
+
+def distributed_vocab(
+    sentences_shard,
+    min_word_frequency: float = 1.0,
+    tokenizer_factory: Optional[TokenizerFactory] = None,
+    huffman: bool = True,
+) -> Tuple[VocabCache, List[np.ndarray]]:
+    """Build ONE global vocab from every process's corpus shard and encode
+    this process's shard against it.
+
+    Returns (vocab, encoded_seqs): `vocab` is identical on all processes
+    (globally summed counts, global min-frequency filter, finalize_vocab
+    ordering, Huffman codes when `huffman`); `encoded_seqs` are THIS
+    shard's sentences as int32 global-vocab indices with OOV dropped.
+    """
+    words, counts, local_seqs = _local_counts(sentences_shard,
+                                              tokenizer_factory)
+    payload = "\n".join(words).encode("utf-8")
+    gathered_words = _allgather_bytes(payload)
+    gathered_counts = _allgather_bytes(counts.tobytes())
+
+    merged = {}
+    for wbuf, cbuf in zip(gathered_words, gathered_counts):
+        ws = wbuf.decode("utf-8").split("\n") if wbuf else []
+        cs = np.frombuffer(cbuf, np.float64)
+        for w, c in zip(ws, cs):
+            merged[w] = merged.get(w, 0.0) + float(c)
+    kept = [(w, c) for w, c in merged.items() if c >= min_word_frequency]
+    kept.sort(key=lambda t: (-t[1], t[0]))
+    vocab = vocab_from_arrays([w for w, _ in kept], [c for _, c in kept])
+    if huffman:
+        build_huffman(vocab)
+
+    # Remap the shard's local-id encoding to global ids with ONE gather:
+    # per-VOCAB-WORD Python, per-TOKEN numpy.
+    remap = np.asarray([vocab.index_of(w) for w in words], np.int32)
+    out = []
+    for s in local_seqs:
+        g = remap[s] if len(s) else np.zeros((0,), np.int32)
+        out.append(g[g >= 0].astype(np.int32))
+    return vocab, out
+
+
+def distributed_cooccurrences(
+    seqs_shard: Iterable[np.ndarray],
+    window_size: int = 5,
+    symmetric: bool = True,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Merge per-shard windowed cooccurrence counts into the global COO
+    (rows, cols, weights) — `nlp/glove.py::CoOccurrences` semantics, every
+    process receiving the same merged result."""
+    from deeplearning4j_tpu.nlp.glove import CoOccurrences
+
+    rows, cols, vals = CoOccurrences(window_size, symmetric).count(seqs_shard)
+    payload = np.concatenate([
+        rows.astype(np.int64), cols.astype(np.int64),
+    ]).tobytes() + vals.astype(np.float64).tobytes()
+    header = np.asarray([len(rows)], np.int64).tobytes()
+    gathered = _allgather_bytes(header + payload)
+
+    merged = {}
+    for buf in gathered:
+        n = int(np.frombuffer(buf[:8], np.int64)[0])
+        ints = np.frombuffer(buf[8: 8 + 16 * n], np.int64)
+        r, c = ints[:n], ints[n: 2 * n]
+        v = np.frombuffer(buf[8 + 16 * n:], np.float64)
+        for i in range(n):
+            key = (int(r[i]), int(c[i]))
+            merged[key] = merged.get(key, 0.0) + float(v[i])
+    if not merged:
+        return (np.zeros(0, np.int32), np.zeros(0, np.int32),
+                np.zeros(0, np.float32))
+    keys = sorted(merged)  # deterministic order on every process
+    out_r = np.asarray([k[0] for k in keys], np.int32)
+    out_c = np.asarray([k[1] for k in keys], np.int32)
+    out_v = np.asarray([merged[k] for k in keys], np.float32)
+    return out_r, out_c, out_v

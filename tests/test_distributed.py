@@ -305,3 +305,73 @@ def test_two_process_dp_tp_mesh_matches_single_machine(tmp_path):
             np.testing.assert_allclose(
                 got[f"{lk}/{pk}"], np.asarray(v), rtol=2e-5, atol=2e-6,
                 err_msg=f"param {lk}/{pk} diverged (dp x tp)")
+
+
+CORPUS_WORKER = """
+import os, sys
+pid = int(sys.argv[1]); port = sys.argv[2]; out = sys.argv[3]
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.extend
+jax.extend.backend.clear_backends()
+jax.config.update("jax_num_cpu_devices", 2)
+from deeplearning4j_tpu.parallel import distributed as dist
+dist.initialize(coordinator_address="127.0.0.1:" + port,
+                num_processes=2, process_id=pid)
+assert dist.process_count() == 2
+
+import numpy as np
+from deeplearning4j_tpu.nlp.distributed_corpus import (
+    distributed_cooccurrences, distributed_vocab,
+)
+
+# Each process holds HALF the corpus; the pipeline must produce the
+# single-machine global result on every process.
+corpus = [[f"w{j}" for j in np.random.RandomState(s).randint(0, 20, 12)]
+          for s in range(8)]
+shard = corpus[pid * 4:(pid + 1) * 4]
+vocab, seqs = distributed_vocab(shard, min_word_frequency=2)
+r, c, v = distributed_cooccurrences(seqs, window_size=3)
+if pid == 0:
+    np.savez(out,
+             words=np.array(vocab.words()),
+             freqs=np.array([w.frequency for w in vocab._by_index]),
+             rows=r, cols=c, vals=v,
+             seq0=seqs[0])
+print("worker", pid, "done", flush=True)
+"""
+
+
+def test_two_process_corpus_pipeline_matches_single_machine(tmp_path):
+    """TextPipeline analog: per-process shard counting merged over the
+    collective fabric equals single-machine counting of the full corpus."""
+    from deeplearning4j_tpu.nlp.glove import CoOccurrences
+    from deeplearning4j_tpu.nlp.tokenization import (
+        TokenizerFactory, tokenize_corpus,
+    )
+    from deeplearning4j_tpu.nlp.vocab import VocabConstructor
+
+    out, _ = _run_two_workers(tmp_path, CORPUS_WORKER)
+    got = np.load(str(out))
+
+    corpus = [[f"w{j}" for j in np.random.RandomState(s).randint(0, 20, 12)]
+              for s in range(8)]
+    ref = VocabConstructor(2).build(
+        tokenize_corpus(corpus, TokenizerFactory()))
+    assert got["words"].tolist() == ref.words()
+    np.testing.assert_allclose(
+        got["freqs"], [w.frequency for w in ref._by_index])
+    # Worker 0's first sentence encoded against the GLOBAL vocab.
+    want0 = [ref.index_of(t) for t in corpus[0] if ref.contains_word(t)]
+    assert got["seq0"].tolist() == want0
+    # Cooccurrences: encode the whole corpus, count single-machine, compare.
+    seqs_all = [np.asarray([ref.index_of(t) for t in s
+                            if ref.contains_word(t)], np.int32)
+                for s in corpus]
+    rr, cc, vv = CoOccurrences(3, True).count(seqs_all)
+    want = {(int(a), int(b)): float(w) for a, b, w in zip(rr, cc, vv)}
+    got_d = {(int(a), int(b)): float(w)
+             for a, b, w in zip(got["rows"], got["cols"], got["vals"])}
+    assert got_d.keys() == want.keys()
+    for k in want:
+        assert abs(got_d[k] - want[k]) < 1e-5

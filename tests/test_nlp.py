@@ -420,3 +420,46 @@ class TestNativeVocab:
         assert m1.vocab.words() == m2.vocab.words()
         np.testing.assert_allclose(np.asarray(m1.syn0), np.asarray(m2.syn0),
                                    rtol=1e-6, atol=1e-7)
+
+
+class TestDistributedCorpus:
+    """Single-process sanity for `nlp/distributed_corpus.py` (the 2-process
+    run lives in test_distributed.py): with one shard, the distributed
+    pipeline must equal the local VocabConstructor/CoOccurrences path."""
+
+    def test_vocab_matches_local(self):
+        from deeplearning4j_tpu.nlp.distributed_corpus import distributed_vocab
+        from deeplearning4j_tpu.nlp.tokenization import (
+            TokenizerFactory, tokenize_corpus,
+        )
+        from deeplearning4j_tpu.nlp.vocab import VocabConstructor
+
+        sents = [["b", "a", "b"], ["c", "a", "d", "a"], ["rare"]]
+        vocab, seqs = distributed_vocab(sents, min_word_frequency=2)
+        ref = VocabConstructor(2).build(
+            tokenize_corpus(sents, TokenizerFactory()))
+        assert vocab.words() == ref.words()
+        assert [w.frequency for w in vocab._by_index] == \
+            [w.frequency for w in ref._by_index]
+        # Huffman codes assigned identically.
+        assert [w.codes for w in vocab._by_index] == \
+            [w.codes for w in ref._by_index]
+        want = [[ref.index_of(t) for t in s if ref.contains_word(t)]
+                for s in sents]
+        assert [s.tolist() for s in seqs] == want
+
+    def test_cooccurrences_match_local(self):
+        from deeplearning4j_tpu.nlp.distributed_corpus import (
+            distributed_cooccurrences,
+        )
+        from deeplearning4j_tpu.nlp.glove import CoOccurrences
+
+        seqs = [np.asarray([0, 1, 2, 1, 0], np.int32),
+                np.asarray([3, 2, 1], np.int32)]
+        r, c, v = distributed_cooccurrences(seqs, window_size=2)
+        rr, cc, vv = CoOccurrences(2, True).count(seqs)
+        got = {(int(a), int(b)): float(w) for a, b, w in zip(r, c, v)}
+        want = {(int(a), int(b)): float(w) for a, b, w in zip(rr, cc, vv)}
+        assert got.keys() == want.keys()
+        for k in want:
+            assert abs(got[k] - want[k]) < 1e-6
