@@ -152,3 +152,60 @@ def alexnet(n_classes: int = 1000, seed: int = 123, image: int = 224,
         .set_input_type(InputType.convolutional(image, image, 3))
         .build()
     )
+
+
+def transformer_lm(vocab_size: int, *, t: int = 64, d_model: int = 64,
+                   n_heads: int = 4, n_blocks: int = 2, moe: bool = False,
+                   n_experts: int = 4, seed: int = 123, lr: float = 3e-3,
+                   dtype: str = "float32"):
+    """Decoder-only transformer language model built through the config DSL
+    (ComputationGraph: residual adds around causal SelfAttentionLayer and
+    an FFN — DenseLayer pair, or MoELayer when `moe`).
+
+    No reference equivalent (the reference predates attention; its
+    language model is the GravesLSTM char-RNN above) — this is the
+    round-5 model-family face of the SURVEY §2.3/§5 parallelism
+    extensions: the same config trains sequence-sharded
+    (`ParallelWrapper(..., seq_axis=...)` -> ring attention) or
+    expert-parallel (`expert_axis=...`) with zero model changes.
+    """
+    from deeplearning4j_tpu.nn.conf.graph import ElementWiseVertex
+    from deeplearning4j_tpu.nn.conf.layers import (
+        EmbeddingLayer, MoELayer, SelfAttentionLayer,
+    )
+
+    gb = (NeuralNetConfiguration.builder()
+          .seed(seed).learning_rate(lr).updater(Updater.ADAM).dtype(dtype)
+          .weight_init("xavier")
+          .graph_builder()
+          .add_inputs("tokens")
+          .add_layer("emb", EmbeddingLayer(n_out=d_model, has_bias=False,
+                                           activation="identity"), "tokens"))
+    prev = "emb"
+    for i in range(n_blocks):
+        gb.add_layer(f"attn{i}",
+                     SelfAttentionLayer(n_out=d_model, n_heads=n_heads,
+                                        causal=True), prev)
+        gb.add_vertex(f"res_a{i}", ElementWiseVertex(op="add"),
+                      prev, f"attn{i}")
+        if moe:
+            gb.add_layer(f"ffn{i}",
+                         MoELayer(n_out=d_model, n_experts=n_experts,
+                                  expert_hidden=4 * d_model, top_k=2,
+                                  router_jitter=1e-2), f"res_a{i}")
+        else:
+            gb.add_layer(f"ff1_{i}", DenseLayer(n_out=4 * d_model,
+                                                activation="relu"),
+                         f"res_a{i}")
+            gb.add_layer(f"ffn{i}", DenseLayer(n_out=d_model,
+                                               activation="identity"),
+                         f"ff1_{i}")
+        gb.add_vertex(f"res_f{i}", ElementWiseVertex(op="add"),
+                      f"res_a{i}", f"ffn{i}")
+        prev = f"res_f{i}"
+    gb.add_layer("out", RnnOutputLayer(n_out=vocab_size,
+                                       activation="softmax",
+                                       loss_function="mcxent"), prev)
+    gb.set_outputs("out")
+    gb.set_input_types(InputType.recurrent(vocab_size, t))
+    return gb.build()

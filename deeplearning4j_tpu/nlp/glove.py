@@ -103,16 +103,30 @@ class Glove(WordVectors):
         self.error_per_epoch: List[float] = []
 
     def fit(self) -> "Glove":
-        corpus = tokenize_corpus(self._sentences, self.tokenizer_factory)
-        self.vocab = VocabConstructor(self.min_word_frequency).build(corpus)
+        sentences = (self._sentences
+                     if isinstance(self._sentences, (list, tuple))
+                     else list(self._sentences))
+        # Native tokenize+count+encode fast path (exactness-guarded; see
+        # native/fastvocab.cpp), Python fallback below.
+        from deeplearning4j_tpu import native as native_mod
+        from deeplearning4j_tpu.nlp.vocab import vocab_from_arrays
+
+        fast = native_mod.build_vocab_corpus(
+            sentences, self.min_word_frequency, self.tokenizer_factory)
+        if fast is not None:
+            words, counts, seqs = fast
+            self.vocab = vocab_from_arrays(words, counts)
+        else:
+            corpus = tokenize_corpus(sentences, self.tokenizer_factory)
+            self.vocab = VocabConstructor(
+                self.min_word_frequency).build(corpus)
+            seqs = [
+                np.asarray([self.vocab.index_of(t) for t in seq
+                            if self.vocab.contains_word(t)], np.int32)
+                for seq in corpus
+            ]
         V, D = self.vocab.num_words(), self.layer_size
         rng = np.random.RandomState(self.seed)
-
-        seqs = [
-            np.asarray([self.vocab.index_of(t) for t in seq
-                        if self.vocab.contains_word(t)], np.int32)
-            for seq in corpus
-        ]
         rows, cols, vals = CoOccurrences(
             self.window_size, self.symmetric).count(seqs)
         if len(rows) == 0:
