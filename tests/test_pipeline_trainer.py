@@ -98,3 +98,25 @@ def test_structural_validation(rng):
     with pytest.raises(ValueError, match="multiple of the pipe"):
         PipelineTrainer(MultiLayerNetwork(_conf()).init(), mesh,
                         block_range=(1, 8), n_microbatches=2)
+
+
+def test_remat_pipelined_training_matches_plain(rng):
+    """remat=True (jax.checkpoint around the stage body) changes memory,
+    never numerics."""
+    X, Y = _data(rng)
+    net0 = MultiLayerNetwork(_conf()).init()
+    for _ in range(3):
+        net0.fit(DataSet(X, Y))
+
+    net1 = MultiLayerNetwork(_conf()).init()
+    mesh = mesh_mod.create_mesh((2, 4), axis_names=("data", "pipe"))
+    pt = PipelineTrainer(net1, mesh, block_range=(1, 9), n_microbatches=2,
+                         remat=True)
+    for _ in range(3):
+        pt.fit(DataSet(X, Y))
+    for lk in net0.params_tree:
+        for pk in net0.params_tree[lk]:
+            np.testing.assert_allclose(
+                np.asarray(net0.params_tree[lk][pk]),
+                np.asarray(net1.params_tree[lk][pk]),
+                rtol=2e-4, atol=2e-5, err_msg=f"{lk}/{pk}")
