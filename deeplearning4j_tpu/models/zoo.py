@@ -171,7 +171,11 @@ def transformer_lm(vocab_size: int, *, t: int = 64, d_model: int = 64,
     """
     from deeplearning4j_tpu.nn.conf.graph import ElementWiseVertex
     from deeplearning4j_tpu.nn.conf.layers import (
-        EmbeddingLayer, MoELayer, SelfAttentionLayer,
+        EmbeddingLayer,
+        LayerNormalization,
+        MoELayer,
+        PositionalEmbeddingLayer,
+        SelfAttentionLayer,
     )
 
     gb = (NeuralNetConfiguration.builder()
@@ -180,32 +184,38 @@ def transformer_lm(vocab_size: int, *, t: int = 64, d_model: int = 64,
           .graph_builder()
           .add_inputs("tokens")
           .add_layer("emb", EmbeddingLayer(n_out=d_model, has_bias=False,
-                                           activation="identity"), "tokens"))
-    prev = "emb"
+                                           activation="identity"), "tokens")
+          .add_layer("pos", PositionalEmbeddingLayer(max_length=max(t, 16)),
+                     "emb"))
+    prev = "pos"
     for i in range(n_blocks):
+        # Pre-LN block: x + Attn(LN(x)); x + FFN(LN(x)).
+        gb.add_layer(f"ln_a{i}", LayerNormalization(), prev)
         gb.add_layer(f"attn{i}",
                      SelfAttentionLayer(n_out=d_model, n_heads=n_heads,
-                                        causal=True), prev)
+                                        causal=True), f"ln_a{i}")
         gb.add_vertex(f"res_a{i}", ElementWiseVertex(op="add"),
                       prev, f"attn{i}")
+        gb.add_layer(f"ln_f{i}", LayerNormalization(), f"res_a{i}")
         if moe:
             gb.add_layer(f"ffn{i}",
                          MoELayer(n_out=d_model, n_experts=n_experts,
                                   expert_hidden=4 * d_model, top_k=2,
-                                  router_jitter=1e-2), f"res_a{i}")
+                                  router_jitter=1e-2), f"ln_f{i}")
         else:
             gb.add_layer(f"ff1_{i}", DenseLayer(n_out=4 * d_model,
                                                 activation="relu"),
-                         f"res_a{i}")
+                         f"ln_f{i}")
             gb.add_layer(f"ffn{i}", DenseLayer(n_out=d_model,
                                                activation="identity"),
                          f"ff1_{i}")
         gb.add_vertex(f"res_f{i}", ElementWiseVertex(op="add"),
                       f"res_a{i}", f"ffn{i}")
         prev = f"res_f{i}"
+    gb.add_layer("ln_out", LayerNormalization(), prev)
     gb.add_layer("out", RnnOutputLayer(n_out=vocab_size,
                                        activation="softmax",
-                                       loss_function="mcxent"), prev)
+                                       loss_function="mcxent"), "ln_out")
     gb.set_outputs("out")
     gb.set_input_types(InputType.recurrent(vocab_size, t))
     return gb.build()

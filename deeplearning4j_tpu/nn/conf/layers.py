@@ -530,6 +530,51 @@ class SimpleRnn(BaseRecurrentLayer):
 
 @register_layer
 @dataclass
+class LayerNormalization(FeedForwardLayer):
+    """Per-example layer norm over the feature axis (gamma/beta learned).
+
+    No reference equivalent (the reference predates LN; its normalizer is
+    BatchNormalization) — added for the transformer model family
+    (`models/zoo.transformer_lm`), where batch statistics are wrong for
+    autoregressive training. Works on [B, F] and [B, T, F]."""
+
+    eps: float = 1e-5
+    activation: Any = "identity"
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def set_n_in(self, input_type, override):
+        self.n_in = self.n_out = input_type.flat_size()
+
+    def param_shapes(self):
+        return {"gamma": (self.n_out,), "beta": (self.n_out,)}
+
+
+@register_layer
+@dataclass
+class PositionalEmbeddingLayer(FeedForwardLayer):
+    """Learned position table added to a [B, T, F] sequence (GPT-style).
+
+    No reference equivalent (predates transformers); feeds
+    `models/zoo.transformer_lm`. `max_length` rows are allocated; forward
+    slices the first T (T <= max_length enforced at trace time)."""
+
+    max_length: int = 512
+    activation: Any = "identity"
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def set_n_in(self, input_type, override):
+        self.n_in = self.n_out = input_type.flat_size()
+
+    def param_shapes(self):
+        return {"P": (self.max_length, self.n_out)}
+
+
+@register_layer
+@dataclass
 class SelfAttentionLayer(BaseRecurrentLayer):
     """Multi-head self-attention over a [B, T, F] sequence.
 

@@ -36,6 +36,8 @@ LAYER_IMPLS = {
     "SubsamplingLayer": convolution.subsampling_apply,
     "LocalResponseNormalization": convolution.lrn_apply,
     "BatchNormalization": normalization.batchnorm_apply,
+    "LayerNormalization": normalization.layernorm_apply,
+    "PositionalEmbeddingLayer": feedforward.positional_embedding_apply,
     "GravesLSTM": recurrent.graves_lstm_apply,
     "LSTM": recurrent.standard_lstm_apply,
     "GravesBidirectionalLSTM": recurrent.bidirectional_lstm_apply,

@@ -149,3 +149,15 @@ def rbm_apply(conf, params, state, x, *, rng=None, train=False, mask=None):
     else:
         out = jax.nn.sigmoid(pre)
     return out, state, mask
+
+
+def positional_embedding_apply(conf, params, state, x, *, rng=None,
+                               train=False, mask=None):
+    """x: [B, T, F] -> x + P[:T] (learned GPT-style position table,
+    `nn/conf/layers.py::PositionalEmbeddingLayer`)."""
+    T = x.shape[1]
+    if T > conf.max_length:
+        raise ValueError(
+            f"sequence length {T} exceeds PositionalEmbeddingLayer "
+            f"max_length {conf.max_length}")
+    return x + params["P"][:T], state, mask

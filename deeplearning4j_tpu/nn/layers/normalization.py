@@ -11,6 +11,7 @@ compiles to its own fused XLA program (no in-graph branching).
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.nn import activations
@@ -40,3 +41,20 @@ def batchnorm_apply(conf, params, state, x, *, rng=None, train=False, mask=None)
         out = params["gamma"] * xhat + params["beta"]
     out = activations.resolve(conf.activation)(out)
     return out, new_state, mask
+
+
+def layernorm_apply(conf, params, state, x, *, rng=None, train=False,
+                    mask=None):
+    """Layer norm over the trailing feature axis (no running state — the
+    statistics are per-example, so train == inference; the transformer
+    family's normalizer, `nn/conf/layers.py::LayerNormalization`)."""
+    from deeplearning4j_tpu.nn.layers.common import layer_input_dropout
+
+    x = layer_input_dropout(conf, x, rng, train)
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean((x - mu) ** 2, axis=-1, keepdims=True)
+    out = (x - mu) * jax.lax.rsqrt(var + conf.eps)
+    out = out * params["gamma"] + params["beta"]
+    from deeplearning4j_tpu.nn import activations
+
+    return activations.resolve(conf.activation)(out), state, mask

@@ -62,6 +62,10 @@ def init_layer_params(conf: Layer, rng: jax.Array, dtype=jnp.float32) -> Dict[st
             else:
                 params[name] = jnp.full(shape, conf.beta, dtype)
             continue
+        if type(conf).__name__ == "LayerNormalization":
+            params[name] = (jnp.ones(shape, dtype) if name == "gamma"
+                            else jnp.zeros(shape, dtype))
+            continue
         is_bias = is_bias_param(name) and name != "beta"
         is_peephole = name.startswith("pW")
         if is_bias:

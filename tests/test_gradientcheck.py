@@ -291,3 +291,41 @@ class TestGraphGradients:
         net = ComputationGraph(conf).init()
         mds = MultiDataSet(features=[X1, X2], labels=[Y])
         assert check_gradients(net, mds, epsilon=EPS, max_rel_error=TOL)
+
+
+class TestTransformerLayerGradients:
+    """Round-5 transformer-family layers: LayerNormalization and
+    PositionalEmbeddingLayer (no reference analog; gradient-checked to the
+    same bar as every other layer)."""
+
+    def test_layernorm(self, rng):
+        from deeplearning4j_tpu.nn.conf.layers import LayerNormalization
+
+        X, Y = class_data(rng)
+        conf = (base_builder().list()
+                .layer(DenseLayer(n_out=5, activation="tanh"))
+                .layer(LayerNormalization())
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss_function="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        assert check_gradients(net, DataSet(X, Y), epsilon=EPS,
+                               max_rel_error=TOL)
+
+    def test_positional_embedding_sequence(self, rng):
+        from deeplearning4j_tpu.nn.conf.layers import (
+            LayerNormalization, PositionalEmbeddingLayer,
+        )
+
+        b, t, f, c = 3, 5, 4, 3
+        X = rng.randn(b, t, f)
+        Y = np.eye(c)[rng.randint(0, c, (b, t))].astype("float64")
+        conf = (base_builder().list()
+                .layer(PositionalEmbeddingLayer(max_length=8))
+                .layer(LayerNormalization())
+                .layer(RnnOutputLayer(n_out=c, activation="softmax",
+                                      loss_function="mcxent"))
+                .set_input_type(InputType.recurrent(f, t)).build())
+        net = MultiLayerNetwork(conf).init()
+        assert check_gradients(net, DataSet(X, Y), epsilon=EPS,
+                               max_rel_error=TOL)
