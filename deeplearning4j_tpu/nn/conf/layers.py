@@ -592,7 +592,9 @@ class SelfAttentionLayer(BaseRecurrentLayer):
 
     n_heads: int = 4
     causal: bool = True
-    attention_impl: str = "auto"  # "auto" (Pallas flash) | "dense" (XLA)
+    # "auto" (Pallas flash; ring when seq-sharded) | "dense" (XLA oracle) |
+    # "ulysses" (all-to-all head sharding when seq-sharded; flash otherwise)
+    attention_impl: str = "auto"
     activation: Any = "identity"
 
     def param_shapes(self):

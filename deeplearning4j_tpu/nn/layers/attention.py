@@ -91,9 +91,13 @@ def self_attention_apply(conf, params, state, x, *, rng=None, train=False,
             raise ValueError(
                 "sequence-sharded non-causal attention with a features mask "
                 "is not supported; pad to full length or drop the seq axis")
-        o = seq_mod.ring_attention(
-            q, k, v, ctx.mesh, seq_axis=ctx.seq_axis,
-            batch_axis=ctx.data_axis, causal=conf.causal, scale=scale)
+        # impl="ulysses" opts into the all-to-all variant (cheaper
+        # collectives at moderate T; needs n_heads % axis == 0); anything
+        # else sequence-sharded takes the ring.
+        sp = (seq_mod.ulysses_attention
+              if conf.attention_impl == "ulysses" else seq_mod.ring_attention)
+        o = sp(q, k, v, ctx.mesh, seq_axis=ctx.seq_axis,
+               batch_axis=ctx.data_axis, causal=conf.causal, scale=scale)
     elif mask is not None:
         o = _masked_dense_attention(q, k, v, mask, conf.causal, scale)
     else:

@@ -283,3 +283,24 @@ class TestTransformerLMZoo:
                     np.asarray(cg0.params_tree[lk][pk]),
                     np.asarray(cg1.params_tree[lk][pk]),
                     rtol=5e-4, atol=5e-5, err_msg=f"{lk}/{pk}")
+
+
+def test_ulysses_impl_seq_sharded_matches_single_device(rng):
+    """attention_impl='ulysses' routes the seq-sharded path through the
+    all-to-all variant; numerics match the single-device run."""
+    X, Y = _seq_data(rng)
+    net0 = MultiLayerNetwork(_attention_conf(impl="ulysses")).init()
+    for _ in range(4):
+        net0.fit(DataSet(X, Y))
+
+    net1 = MultiLayerNetwork(_attention_conf(impl="ulysses")).init()
+    mesh = mesh_mod.create_mesh((2, 2), axis_names=("data", "seq"))
+    pw = ParallelWrapper(net1, mesh=mesh, seq_axis="seq")
+    for _ in range(4):
+        pw.fit(DataSet(X, Y))
+    for lk in net0.params_tree:
+        for pk in net0.params_tree[lk]:
+            np.testing.assert_allclose(
+                np.asarray(net0.params_tree[lk][pk]),
+                np.asarray(net1.params_tree[lk][pk]),
+                rtol=5e-4, atol=5e-5, err_msg=f"{lk}/{pk}")
