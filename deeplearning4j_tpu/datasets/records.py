@@ -661,9 +661,12 @@ class LFWDataSetIterator(RecordReaderDataSetIterator):
                     os.path.isdir(os.path.join(d, s)) for s in os.listdir(d)):
                 reader = ImageRecordReader(h, w, c).initialize(d)
                 if num_labels is not None:
-                    keep = set(reader.labels[:num_labels])
+                    # Truncate the label SPACE too, so the one-hot width is
+                    # num_labels (old indices stay valid: kept labels are a
+                    # prefix of the sorted label list).
+                    reader.labels = reader.labels[:num_labels]
                     reader._files = [(p, li) for p, li in reader._files
-                                     if reader.labels[li] in keep]
+                                     if li < num_labels]
                 self._synthetic = False
                 break
         else:

@@ -54,7 +54,11 @@ std::unordered_map<long, VocabState*> g_states;
 long g_next = 1;
 
 inline bool is_space(unsigned char c) {
-    return c == ' ' || c == '\t' || c == '\r' || c == '\v' || c == '\f';
+    // Python str.split()'s ASCII whitespace set minus '\n' (the sentence
+    // separator): \t \v \f \r space and the file/group/record/unit
+    // separators \x1c-\x1f ('a\x1cb'.split() == ['a', 'b']).
+    return c == ' ' || c == '\t' || c == '\r' || c == '\v' || c == '\f' ||
+           (c >= 0x1c && c <= 0x1f);
 }
 
 inline bool strip_char(unsigned char c) {

@@ -147,20 +147,26 @@ def distributed_cooccurrences(
     header = np.asarray([len(rows)], np.int64).tobytes()
     gathered = _allgather_bytes(header + payload)
 
-    merged = {}
+    all_r, all_c, all_v = [], [], []
     for buf in gathered:
         n = int(np.frombuffer(buf[:8], np.int64)[0])
         ints = np.frombuffer(buf[8: 8 + 16 * n], np.int64)
-        r, c = ints[:n], ints[n: 2 * n]
-        v = np.frombuffer(buf[8 + 16 * n:], np.float64)
-        for i in range(n):
-            key = (int(r[i]), int(c[i]))
-            merged[key] = merged.get(key, 0.0) + float(v[i])
-    if not merged:
+        all_r.append(ints[:n])
+        all_c.append(ints[n: 2 * n])
+        all_v.append(np.frombuffer(buf[8 + 16 * n:], np.float64))
+    r = np.concatenate(all_r) if all_r else np.zeros(0, np.int64)
+    if r.size == 0:
         return (np.zeros(0, np.int32), np.zeros(0, np.int32),
                 np.zeros(0, np.float32))
-    keys = sorted(merged)  # deterministic order on every process
-    out_r = np.asarray([k[0] for k in keys], np.int32)
-    out_c = np.asarray([k[1] for k in keys], np.int32)
-    out_v = np.asarray([merged[k] for k in keys], np.float32)
-    return out_r, out_c, out_v
+    c = np.concatenate(all_c)
+    v = np.concatenate(all_v)
+    # Vectorized merge (GloVe-scale shards carry millions of pairs): one
+    # composite sort key, np.unique for the deterministic merged order,
+    # np.add.at to sum duplicate pairs.
+    V = int(max(r.max(), c.max())) + 1
+    key = r * V + c
+    uniq, inverse = np.unique(key, return_inverse=True)
+    out_v = np.zeros(len(uniq), np.float64)
+    np.add.at(out_v, inverse, v)
+    return ((uniq // V).astype(np.int32), (uniq % V).astype(np.int32),
+            out_v.astype(np.float32))

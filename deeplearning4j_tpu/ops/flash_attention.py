@@ -194,6 +194,8 @@ def _on_tpu() -> bool:
 _RESIDENT_KV_LIMIT = 6 * 1024 * 1024
 
 
+@functools.partial(jax.jit,
+                   static_argnames=("causal", "scale", "block_q", "block_k"))
 def _flash_fwd_stream_bhtd(q, k, v, causal, scale, block_q, block_k):
     """Streaming forward via the prefetched block sequence: (o, lse)."""
     from jax.experimental.pallas import tpu as pltpu
@@ -294,7 +296,7 @@ def _fwd(q, k, v, causal, scale, block_q, block_k):
         o, lse = _flash_fwd_lse_bhtd(to_bhtd(q), to_bhtd(k), to_bhtd(v),
                                      causal, scale_v, block_q, block_k)
     else:
-        o, lse = jax.jit(_flash_fwd_stream_bhtd, static_argnums=(3, 4, 5, 6))(
+        o, lse = _flash_fwd_stream_bhtd(
             to_bhtd(q), to_bhtd(k), to_bhtd(v), causal, scale_v,
             block_q, block_k)
     return (jnp.swapaxes(o.reshape(B, H, T, D), 1, 2), (q, k, v, o, lse))

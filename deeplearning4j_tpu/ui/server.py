@@ -505,6 +505,11 @@ class _Handler(BaseHTTPRequestHandler):
         path = urlparse(self.path).path
         if path == "/api/tsne":
             # t-SNE coord upload (reference: TsneModule's file upload).
+            # HTTP writes are gated like /remote — same explicit-enable
+            # policy; in-process callers use UIServer.upload_tsne.
+            if not type(self).enable_remote:
+                return self._json({"error": "remote writes disabled "
+                                   "(UIServer(enable_remote=True))"}, 403)
             try:
                 length = int(self.headers.get("Content-Length", 0))
                 payload = json.loads(self.rfile.read(length))

@@ -184,8 +184,10 @@ class ConvolutionalListener(IterationListener):
             if a.ndim != 4:  # NHWC conv activations only
                 continue
             a = a[0]
-            sh = max(1, a.shape[0] // self.max_hw)
-            sw = max(1, a.shape[1] // self.max_hw)
+            # Ceil division: guarantees <= max_hw per side (floor under-
+            # strides, e.g. 47//24 == 1 would ship a 47x47 grid).
+            sh = max(1, -(-a.shape[0] // self.max_hw))
+            sw = max(1, -(-a.shape[1] // self.max_hw))
             a = a[::sh, ::sw, : self.max_channels]
             grids[name] = {
                 "h": int(a.shape[0]), "w": int(a.shape[1]),

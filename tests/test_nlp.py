@@ -463,3 +463,25 @@ class TestDistributedCorpus:
         assert got.keys() == want.keys()
         for k in want:
             assert abs(got[k] - want[k]) < 1e-6
+
+
+def test_native_vocab_separator_control_chars():
+    """\\x1c-\\x1f are Python str.split() whitespace: the native raw-string
+    path must split identically ('a\\x1cb'.split() == ['a', 'b'])."""
+    from deeplearning4j_tpu import native as native_mod
+    from deeplearning4j_tpu.nlp.tokenization import (
+        TokenizerFactory, tokenize_corpus,
+    )
+    from deeplearning4j_tpu.nlp.vocab import VocabConstructor
+
+    if native_mod._lib("fastvocab") is None:
+        pytest.skip("no toolchain")
+    sents = ["a\x1cb c", "b\x1d\x1e a\x1f"]
+    got = native_mod.build_vocab_corpus(sents, 1.0, TokenizerFactory())
+    assert got is not None
+    ref = VocabConstructor(1).build(
+        tokenize_corpus(sents, TokenizerFactory()))
+    assert got[0] == ref.words()
+    # Pre-split tokens CONTAINING these bytes diverge from the joined-buffer
+    # encoding; the token-count guard must refuse.
+    assert native_mod.build_vocab_corpus([["a\x1cb"]], 1.0) is None

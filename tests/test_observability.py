@@ -331,7 +331,8 @@ class TestRound5UIModules:
         Y = Tsne(max_iter=30, perplexity=5.0).fit_transform(X)
         labels = [0] * 15 + [1] * 15
 
-        server = UIServer(port=0).attach(InMemoryStatsStorage()).start()
+        server = UIServer(port=0, enable_remote=True).attach(
+            InMemoryStatsStorage()).start()
         try:
             server.upload_tsne(Y, labels=labels, name="test-embedding")
             status, body = self._get(server, "/tsne")
@@ -396,3 +397,18 @@ class TestRound5UIModules:
             assert len(g["channels"][0]) == g["h"] * g["w"]
         finally:
             server.stop()
+
+
+def test_tsne_post_gated_by_enable_remote():
+    """HTTP t-SNE writes follow the same explicit-enable policy as /remote."""
+    server = UIServer(port=0).attach(InMemoryStatsStorage()).start()
+    try:
+        req = urllib.request.Request(
+            server.url.rstrip("/") + "/api/tsne",
+            data=json.dumps({"coords": [[0.0, 1.0]]}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 403
+    finally:
+        server.stop()
