@@ -219,3 +219,38 @@ def transformer_lm(vocab_size: int, *, t: int = 64, d_model: int = 64,
     gb.set_outputs("out")
     gb.set_input_types(InputType.recurrent(vocab_size, t))
     return gb.build()
+
+
+def generate_lm(cg, prompt_ids, n_steps: int, *, window: int,
+                temperature: float = 1.0, seed: int = 0):
+    """Autoregressive sampling from a `transformer_lm` ComputationGraph
+    (reference analog: GravesLSTMCharModellingExample's
+    sampleCharactersFromNetwork — there the RNN steps statefully via
+    rnnTimeStep; a causal transformer re-reads its window instead).
+
+    One compiled shape: the context is right-padded to `window` (the
+    model's training T) and the next-token distribution read at the last
+    real position — causal masking makes the padding invisible to it.
+    `temperature=0` is greedy argmax. Returns prompt + generated ids.
+    """
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    ids = list(int(i) for i in prompt_ids)
+    if not ids:
+        raise ValueError("need at least one prompt token")
+    for _ in range(n_steps):
+        ctx = ids[-window:]
+        x = np.zeros((1, window), np.float32)
+        x[0, : len(ctx)] = ctx
+        out = cg.output_single(x)  # [1, T, V] per-step softmax
+        probs = np.asarray(out[0, len(ctx) - 1], np.float64)
+        if temperature <= 0:
+            nxt = int(probs.argmax())
+        else:
+            logits = np.log(np.maximum(probs, 1e-12)) / temperature
+            p = np.exp(logits - logits.max())
+            p /= p.sum()
+            nxt = int(rng.choice(len(p), p=p))
+        ids.append(nxt)
+    return ids
