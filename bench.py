@@ -560,6 +560,20 @@ def bench_resnet50(steps, warmup):
     extra_metrics["resnet50_stream_samples_per_link_mibps"] = _entry(
         "resnet50_stream_samples_per_link_mibps",
         stream_sps / max(mibps, 1e-9), "samples/sec per MiB/s")
+
+    # uint8 shipping: bytes over the link, 0-255 -> 0-1 scaled ON DEVICE
+    # inside the jitted step (PERF.md §3's halve-the-feature-bytes item;
+    # 2x fewer bytes than bf16, 4x fewer than f32).
+    def mk8(rng, b):
+        x = (rng.rand(b, image, image, 3) * 255).astype("uint8")
+        return x, np.eye(1000, dtype="float32")[rng.randint(0, 1000, b)]
+
+    stream8_sps, _ = _timed_fit(net, mk8, batch, 4, warmup=1, distinct=2)
+    e8 = _entry("resnet50_stream_uint8_samples_per_sec", stream8_sps,
+                "samples/sec/chip", note=_LINK_NOTE)
+    e8["vs_bf16_stream_same_run"] = round(stream8_sps / max(stream_sps,
+                                                            1e-9), 2)
+    extra_metrics["resnet50_stream_uint8_samples_per_sec"] = e8
     return head, extra_metrics
 
 

@@ -164,7 +164,12 @@ class ComputationGraph:
         masks: Dict[str, Optional[jnp.ndarray]] = {}
         for i, name in enumerate(self.conf.network_inputs):
             x = jnp.asarray(inputs[i])
-            if jnp.issubdtype(x.dtype, jnp.floating):
+            if x.dtype == jnp.uint8:
+                # Device-side ImagePreProcessingScaler (see
+                # MultiLayerNetwork._forward_fn): bytes over the link,
+                # scale 0-255 -> 0-1 on device.
+                x = x.astype(cdt) / 255.0
+            elif jnp.issubdtype(x.dtype, jnp.floating):
                 x = x.astype(cdt)
             values[name] = x
             masks[name] = None if fmasks is None else fmasks[i]

@@ -402,3 +402,58 @@ class TestVaeLossFunctionWrapper:
         back = MultiLayerConfiguration.from_json(conf.to_json())
         rd = back.layers[0].reconstruction_distribution
         assert list(rd)[:2] == ["loss", "mse"]
+
+
+class TestUint8DeviceScaling:
+    """uint8 features auto-scale 0-255 -> 0-1 ON DEVICE (the TPU-native
+    ImagePreProcessingScaler: ship bytes, normalize in-jit — PERF.md §3)."""
+
+    def test_output_and_training_match_prescaled(self, rng):
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+
+        conf = (NeuralNetConfiguration.builder()
+                .seed(5).learning_rate(0.05).updater("sgd")
+                .list()
+                .layer(DenseLayer(n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss_function="mcxent"))
+                .set_input_type(InputType.feed_forward(6))
+                .build())
+        Xb = rng.randint(0, 256, (10, 6)).astype(np.uint8)
+        Xf = Xb.astype("float32") / 255.0
+        Y = np.eye(3)[rng.randint(0, 3, 10)].astype("float32")
+
+        net_b = MultiLayerNetwork(conf).init()
+        net_f = net_b.clone()
+        np.testing.assert_allclose(net_b.output(Xb), net_f.output(Xf),
+                                   rtol=1e-6, atol=1e-7)
+        for _ in range(3):
+            net_b.fit(DataSet(Xb, Y))
+            net_f.fit(DataSet(Xf, Y))
+        for lk in net_b.params_tree:
+            for pk in net_b.params_tree[lk]:
+                np.testing.assert_allclose(
+                    np.asarray(net_b.params_tree[lk][pk]),
+                    np.asarray(net_f.params_tree[lk][pk]),
+                    rtol=1e-5, atol=1e-6)
+
+    def test_graph_engine_too(self, rng):
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        gb = (NeuralNetConfiguration.builder()
+              .seed(5).learning_rate(0.05).updater("sgd")
+              .graph_builder()
+              .add_inputs("in")
+              .add_layer("d", DenseLayer(n_out=8, activation="tanh"), "in")
+              .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                            loss_function="mcxent"), "d")
+              .set_outputs("out"))
+        gb.set_input_types(InputType.feed_forward(6))
+        cg = ComputationGraph(gb.build()).init()
+        Xb = rng.randint(0, 256, (10, 6)).astype(np.uint8)
+        Xf = Xb.astype("float32") / 255.0
+        np.testing.assert_allclose(cg.output_single(Xb),
+                                   cg.output_single(Xf),
+                                   rtol=1e-6, atol=1e-7)
