@@ -1,0 +1,34 @@
+"""GravesLSTM character model with tBPTT + stateful sampling (reference
+analog: GravesLSTMCharModellingExample)."""
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.models.zoo import char_rnn
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+text = ("the quick brown fox jumps over the lazy dog " * 40)
+chars = sorted(set(text))
+V = len(chars)
+c2i = {c: i for i, c in enumerate(chars)}
+ids = np.asarray([c2i[c] for c in text])
+
+net = MultiLayerNetwork(char_rnn(vocab_size=V, hidden=64, layers=1,
+                                 tbptt_length=25)).init()
+B, T = 16, 100
+for step in range(30):
+    starts = np.random.RandomState(step).randint(0, len(ids) - T - 1, B)
+    x = np.eye(V, dtype="float32")[np.stack([ids[s:s + T] for s in starts])]
+    y = np.eye(V, dtype="float32")[np.stack([ids[s + 1:s + T + 1]
+                                             for s in starts])]
+    net.fit(DataSet(x, y))
+print("loss:", net.score_value)
+
+# Stateful greedy sampling via rnn_time_step.
+net.rnn_clear_previous_state()
+cur = c2i["t"]
+out = ["t"]
+for _ in range(40):
+    p = net.rnn_time_step(np.eye(V, dtype="float32")[[cur]])
+    cur = int(np.asarray(p)[0].argmax())
+    out.append(chars[cur])
+print("sample:", "".join(out))

@@ -1,0 +1,56 @@
+"""Two CSV readers feeding a 2-input/2-output ComputationGraph through
+RecordReaderMultiDataSetIterator (reference analog:
+dl4j-examples MultipleRegressionOutputExample + RRMDSI docs)."""
+import os
+import tempfile
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.records import (
+    CSVRecordReader, RecordReaderMultiDataSetIterator,
+)
+from deeplearning4j_tpu.nn.conf.graph import MergeVertex
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.neural_net import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+tmp = tempfile.mkdtemp()
+rng = np.random.RandomState(0)
+pa, pb = os.path.join(tmp, "a.csv"), os.path.join(tmp, "b.csv")
+with open(pa, "w") as f:   # 4 features + class id
+    for i in range(64):
+        row = rng.rand(4).round(3)
+        f.write(",".join(map(str, row)) + f",{rng.randint(3)}\n")
+with open(pb, "w") as f:   # 3 features + 2 regression targets
+    for i in range(64):
+        row = rng.rand(5).round(3)
+        f.write(",".join(map(str, row)) + "\n")
+
+def make_iter():
+    return (RecordReaderMultiDataSetIterator.builder(batch_size=16)
+            .add_reader("a", CSVRecordReader().initialize(pa))
+            .add_reader("b", CSVRecordReader().initialize(pb))
+            .add_input("a", 0, 3)
+            .add_input("b", 0, 2)
+            .add_output_one_hot("a", 4, num_classes=3)
+            .add_output("b", 3, 4)
+            .build())
+
+gb = (NeuralNetConfiguration.builder()
+      .seed(7).learning_rate(0.05).updater("adam")
+      .graph_builder()
+      .add_inputs("ina", "inb")
+      .add_layer("da", DenseLayer(n_out=16, activation="relu"), "ina")
+      .add_layer("db", DenseLayer(n_out=16, activation="relu"), "inb")
+      .add_vertex("m", MergeVertex(), "da", "db")
+      .add_layer("cls", OutputLayer(n_out=3, activation="softmax",
+                                    loss_function="mcxent"), "m")
+      .add_layer("reg", OutputLayer(n_out=2, activation="identity",
+                                    loss_function="mse"), "m")
+      .set_outputs("cls", "reg"))
+gb.set_input_types(InputType.feed_forward(4), InputType.feed_forward(3))
+cg = ComputationGraph(gb.build()).init()
+for _ in range(20):
+    cg.fit(make_iter())
+print("final score:", cg.score_value)

@@ -1,0 +1,31 @@
+"""Data-parallel training over every visible device (reference analog:
+ParallelWrapper examples). On one device this degenerates gracefully; on
+a pod slice the same code shards the batch over the mesh and GSPMD emits
+the per-step gradient all-reduce."""
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.neural_net import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+conf = (NeuralNetConfiguration.builder()
+        .seed(7).learning_rate(0.05).updater("adam")
+        .list()
+        .layer(DenseLayer(n_out=64, activation="relu"))
+        .layer(OutputLayer(n_out=3, activation="softmax",
+                           loss_function="mcxent"))
+        .set_input_type(InputType.feed_forward(10))
+        .build())
+net = MultiLayerNetwork(conf).init()
+wrapper = ParallelWrapper(net)  # all local devices, data axis
+
+rng = np.random.RandomState(0)
+X = rng.randn(512, 10).astype("float32")
+Y = np.eye(3)[(X.sum(1) > 0).astype(int) + (X[:, 0] > 1)].astype("float32")
+for _ in range(30):
+    wrapper.fit(DataSet(X, Y))
+print("final score:", net.score_value)
+print("accuracy:", (net.predict(X) == Y.argmax(-1)).mean())

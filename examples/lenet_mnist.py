@@ -1,0 +1,24 @@
+"""LeNet on MNIST with listeners and model-zip round trip (reference
+analog: dl4j-examples LenetMnistExample)."""
+import tempfile
+
+from deeplearning4j_tpu.datasets.builtin import MnistDataSetIterator
+from deeplearning4j_tpu.models.zoo import lenet_mnist
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.listeners import (
+    PerformanceListener, ScoreIterationListener,
+)
+from deeplearning4j_tpu.util.model_serializer import load_model, save_model
+
+net = MultiLayerNetwork(lenet_mnist()).init()
+net.set_listeners(ScoreIterationListener(25), PerformanceListener(25))
+
+train = MnistDataSetIterator(batch_size=128, train=True)
+test = MnistDataSetIterator(batch_size=128, train=False)
+net.fit(train)
+print(net.evaluate(test).stats())
+
+path = tempfile.mktemp(suffix=".zip")
+save_model(net, path)
+restored = load_model(path)
+print("restored model params:", restored.num_params())

@@ -1,0 +1,28 @@
+"""Minimal end-to-end: builder DSL -> MultiLayerNetwork -> fit -> evaluate
+(reference analog: dl4j-examples MLPMnistSingleLayerExample)."""
+from deeplearning4j_tpu.datasets.builtin import MnistDataSetIterator
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.neural_net import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.listeners import ScoreIterationListener
+
+conf = (NeuralNetConfiguration.builder()
+        .seed(123).learning_rate(0.006).updater("nesterovs").momentum(0.9)
+        .l2(1e-4)
+        .list()
+        .layer(DenseLayer(n_out=500, activation="relu"))
+        .layer(OutputLayer(n_out=10, activation="softmax",
+                           loss_function="negativeloglikelihood"))
+        .set_input_type(InputType.feed_forward(784))
+        .build())
+
+net = MultiLayerNetwork(conf).init()
+net.set_listeners(ScoreIterationListener(50))
+
+train = MnistDataSetIterator(batch_size=128, train=True, flat=True)
+test = MnistDataSetIterator(batch_size=128, train=False, flat=True)
+for epoch in range(2):
+    net.fit(train)
+ev = net.evaluate(test)
+print(ev.stats())

@@ -1,0 +1,21 @@
+"""Word2Vec on a toy corpus: fit, query nearest words, export in the
+Google text format (reference analog: dl4j-examples Word2VecRawTextExample)."""
+import numpy as np
+
+from deeplearning4j_tpu.nlp.serializer import write_word_vectors
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+rng = np.random.RandomState(0)
+topics = [["cat", "dog", "pet", "fur", "paw"],
+          ["car", "road", "wheel", "drive", "engine"],
+          ["sun", "moon", "star", "sky", "orbit"]]
+sentences = [[t[i] for i in rng.randint(0, 5, 12)]
+             for t in (topics[rng.randint(3)] for _ in range(600))]
+
+w2v = Word2Vec(layer_size=32, window_size=3, min_word_frequency=5,
+               negative=5, seed=1).fit(sentences)
+print("nearest to 'cat':", w2v.words_nearest("cat", top=4))
+print("similarity cat~dog:", round(w2v.similarity("cat", "dog"), 3),
+      " cat~engine:", round(w2v.similarity("cat", "engine"), 3))
+write_word_vectors(w2v, "/tmp/vectors.txt")
+print("exported to /tmp/vectors.txt")
