@@ -57,8 +57,21 @@ class ParagraphVectors:
     # ------------------------------------------------------------------ fit
 
     def fit(self) -> "ParagraphVectors":
-        corpus = [self.tf.create(d.content).get_tokens() for d in self._docs]
-        self.vocab = VocabConstructor(self.min_word_frequency).build(corpus)
+        # Native tokenize+count+encode fast path (exactness-guarded,
+        # `native/fastvocab.cpp`); Python fallback keeps identical results.
+        from deeplearning4j_tpu import native as native_mod
+        from deeplearning4j_tpu.nlp.vocab import vocab_from_arrays
+
+        fast = native_mod.build_vocab_corpus(
+            [d.content for d in self._docs], self.min_word_frequency, self.tf)
+        if fast is not None:
+            words, counts, fast_seqs = fast
+            self.vocab = vocab_from_arrays(words, counts)
+            corpus = None
+        else:
+            corpus = [self.tf.create(d.content).get_tokens()
+                      for d in self._docs]
+            self.vocab = VocabConstructor(self.min_word_frequency).build(corpus)
         n_inner = build_huffman(self.vocab)
         V, D = self.vocab.num_words(), self.layer_size
 
@@ -81,12 +94,16 @@ class ParagraphVectors:
             self._points_tbl[w.index, :n] = w.points
             self._cmask_tbl[w.index, :n] = 1.0
 
-        seqs = [
-            (np.asarray([self.vocab.index_of(t) for t in toks if self.vocab.contains_word(t)],
-                        np.int32),
-             [self._label_index[l] for l in d.labels])
-            for toks, d in zip(corpus, self._docs)
-        ]
+        if fast is not None:
+            seqs = [(s, [self._label_index[l] for l in d.labels])
+                    for s, d in zip(fast_seqs, self._docs)]
+        else:
+            seqs = [
+                (np.asarray([self.vocab.index_of(t) for t in toks
+                             if self.vocab.contains_word(t)], np.int32),
+                 [self._label_index[l] for l in d.labels])
+                for toks, d in zip(corpus, self._docs)
+            ]
         # Train doc vectors jointly with words: treat doc ids as rows of a
         # combined embedding table [L + V, D]; doc rows use DBOW/DM pairing.
         combined = jnp.concatenate([self.doc_vectors, self.syn0], axis=0)

@@ -1,0 +1,26 @@
+"""Examples stay runnable: execute the two fastest examples as real
+subprocesses (the dl4j-examples analog of doc-snippet CI). The rest share
+the same APIs, which the main suites cover."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.parametrize("name,expect", [
+    ("csv_graph_multi_io", b"final score"),
+    ("data_parallel", b"accuracy"),
+])
+def test_example_runs(name, expect):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", f"{name}.py")],
+        capture_output=True, timeout=420, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert expect in out.stdout
