@@ -157,7 +157,7 @@ def alexnet(n_classes: int = 1000, seed: int = 123, image: int = 224,
 def transformer_lm(vocab_size: int, *, t: int = 64, d_model: int = 64,
                    n_heads: int = 4, n_blocks: int = 2, moe: bool = False,
                    n_experts: int = 4, seed: int = 123, lr: float = 3e-3,
-                   dtype: str = "float32"):
+                   dtype: str = "float32", decode_cache_length=None):
     """Decoder-only transformer language model built through the config DSL
     (ComputationGraph: residual adds around causal SelfAttentionLayer and
     an FFN — DenseLayer pair, or MoELayer when `moe`).
@@ -168,6 +168,10 @@ def transformer_lm(vocab_size: int, *, t: int = 64, d_model: int = 64,
     extensions: the same config trains sequence-sharded
     (`ParallelWrapper(..., seq_axis=...)` -> ring attention) or
     expert-parallel (`expert_axis=...`) with zero model changes.
+
+    `decode_cache_length=N` sizes every attention layer's KV cache (and
+    the positional table) for O(1)-per-token stateful generation via
+    `ComputationGraph.rnn_time_step` / `generate_lm(use_cache=True)`.
     """
     from deeplearning4j_tpu.nn.conf.graph import ElementWiseVertex
     from deeplearning4j_tpu.nn.conf.layers import (
@@ -185,15 +189,17 @@ def transformer_lm(vocab_size: int, *, t: int = 64, d_model: int = 64,
           .add_inputs("tokens")
           .add_layer("emb", EmbeddingLayer(n_out=d_model, has_bias=False,
                                            activation="identity"), "tokens")
-          .add_layer("pos", PositionalEmbeddingLayer(max_length=max(t, 16)),
-                     "emb"))
+          .add_layer("pos", PositionalEmbeddingLayer(
+              max_length=max(t, 16, decode_cache_length or 0)), "emb"))
     prev = "pos"
     for i in range(n_blocks):
         # Pre-LN block: x + Attn(LN(x)); x + FFN(LN(x)).
         gb.add_layer(f"ln_a{i}", LayerNormalization(), prev)
         gb.add_layer(f"attn{i}",
-                     SelfAttentionLayer(n_out=d_model, n_heads=n_heads,
-                                        causal=True), f"ln_a{i}")
+                     SelfAttentionLayer(
+                         n_out=d_model, n_heads=n_heads, causal=True,
+                         decode_cache_length=decode_cache_length),
+                     f"ln_a{i}")
         gb.add_vertex(f"res_a{i}", ElementWiseVertex(op="add"),
                       prev, f"attn{i}")
         gb.add_layer(f"ln_f{i}", LayerNormalization(), f"res_a{i}")
@@ -222,15 +228,22 @@ def transformer_lm(vocab_size: int, *, t: int = 64, d_model: int = 64,
 
 
 def generate_lm(cg, prompt_ids, n_steps: int, *, window: int,
-                temperature: float = 1.0, seed: int = 0):
+                temperature: float = 1.0, seed: int = 0,
+                use_cache: bool = False):
     """Autoregressive sampling from a `transformer_lm` ComputationGraph
     (reference analog: GravesLSTMCharModellingExample's
-    sampleCharactersFromNetwork — there the RNN steps statefully via
-    rnnTimeStep; a causal transformer re-reads its window instead).
+    sampleCharactersFromNetwork).
 
-    One compiled shape: the context is right-padded to `window` (the
-    model's training T) and the next-token distribution read at the last
-    real position — causal masking makes the padding invisible to it.
+    Two modes:
+    - `use_cache=False`: re-read the window each token — the context is
+      right-padded to `window` (one compiled shape) and the next-token
+      distribution read at the last real position; O(window) attention
+      per token.
+    - `use_cache=True` (model built with `decode_cache_length`): stateful
+      O(1)-per-token decode via `ComputationGraph.rnn_time_step` — prime
+      once with the prompt, then single-token steps against the KV cache,
+      exactly like the reference's RNN sampling loop.
+
     `temperature=0` is greedy argmax. Returns prompt + generated ids.
     """
     import numpy as np
@@ -239,18 +252,45 @@ def generate_lm(cg, prompt_ids, n_steps: int, *, window: int,
     ids = list(int(i) for i in prompt_ids)
     if not ids:
         raise ValueError("need at least one prompt token")
+
+    def pick(probs):
+        probs = np.asarray(probs, np.float64)
+        if temperature <= 0:
+            return int(probs.argmax())
+        logits = np.log(np.maximum(probs, 1e-12)) / temperature
+        p = np.exp(logits - logits.max())
+        p /= p.sum()
+        return int(rng.choice(len(p), p=p))
+
+    if use_cache:
+        cache_lens = [
+            v.layer.decode_cache_length
+            for v in cg.layer_vertices.values()
+            if type(v.layer).__name__ == "SelfAttentionLayer"
+        ]
+        if not cache_lens or any(c is None for c in cache_lens):
+            raise ValueError(
+                "use_cache=True needs a model built with "
+                "transformer_lm(..., decode_cache_length=N)")
+        if len(ids) + n_steps > min(cache_lens):
+            raise ValueError(
+                f"prompt ({len(ids)}) + n_steps ({n_steps}) exceeds the "
+                f"decode cache capacity {min(cache_lens)}")
+        cg.rnn_clear_previous_state()
+        out = cg.rnn_time_step(
+            np.asarray(ids, np.float32)[None, :, None])[0]  # [1, Tp, V]
+        nxt = pick(out[0, -1])
+        ids.append(nxt)
+        for _ in range(n_steps - 1):
+            out = cg.rnn_time_step(
+                np.asarray([[[float(ids[-1])]]], np.float32))[0]
+            ids.append(pick(out[0, -1] if out.ndim == 3 else out[0]))
+        return ids
+
     for _ in range(n_steps):
         ctx = ids[-window:]
         x = np.zeros((1, window), np.float32)
         x[0, : len(ctx)] = ctx
         out = cg.output_single(x)  # [1, T, V] per-step softmax
-        probs = np.asarray(out[0, len(ctx) - 1], np.float64)
-        if temperature <= 0:
-            nxt = int(probs.argmax())
-        else:
-            logits = np.log(np.maximum(probs, 1e-12)) / temperature
-            p = np.exp(logits - logits.max())
-            p /= p.sum()
-            nxt = int(rng.choice(len(p), p=p))
-        ids.append(nxt)
+        ids.append(pick(out[0, len(ctx) - 1]))
     return ids

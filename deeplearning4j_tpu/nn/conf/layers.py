@@ -595,6 +595,11 @@ class SelfAttentionLayer(BaseRecurrentLayer):
     # "auto" (Pallas flash; ring when seq-sharded) | "dense" (XLA oracle) |
     # "ulysses" (all-to-all head sharding when seq-sharded; flash otherwise)
     attention_impl: str = "auto"
+    # KV-cache capacity for stateful decode via `rnn_time_step` (None
+    # disables). The layer always emits its cache as undeclared state; the
+    # engines persist it only on the stateful path, and XLA dead-code-
+    # eliminates it everywhere else, so training cost is zero.
+    decode_cache_length: Optional[int] = None
     activation: Any = "identity"
 
     def param_shapes(self):

@@ -715,6 +715,53 @@ class ComputationGraph:
     def output_single(self, *inputs, **kw) -> np.ndarray:
         return self.output(*inputs, **kw)[0]
 
+    # ----------------------------------------------------------------- rnn
+
+    def _declared_state(self):
+        return {
+            name: tuple(v.layer.state_shapes())
+            for name, v in self.layer_vertices.items()
+        }
+
+    def rnn_time_step(self, *inputs) -> List[np.ndarray]:
+        """Stateful single/multi-step inference (reference:
+        `ComputationGraph.rnnTimeStep:1386` — same contract as
+        `MultiLayerNetwork.rnn_time_step`): hidden state (LSTM carries,
+        attention KV caches, positional cursors) persists across calls.
+        Accepts [b, f] (one step) or [b, t, f] per input."""
+        arrs = []
+        squeeze = False
+        for x in inputs:
+            x = np.asarray(x)
+            if x.ndim == 2:
+                x = x[:, None, :]
+                squeeze = True
+            arrs.append(x)
+        fn = self._get_jit("output", train=False, keep_rnn_state=True)
+        state = dict(self.state)
+        for name, s in self._rnn_state.items():
+            merged = dict(state.get(name, {}))
+            merged.update(s)
+            state[name] = merged
+        outs, new_state = fn(self.params_tree, state,
+                             [jnp.asarray(x) for x in arrs], None,
+                             jax.random.PRNGKey(0))
+        declared = self._declared_state()
+        self._rnn_state = {
+            name: {k: v for k, v in s.items()
+                   if k not in dict(declared).get(name, ())}
+            for name, s in new_state.items()
+        }
+        self._rnn_state = {n: s for n, s in self._rnn_state.items() if s}
+        result = []
+        for o in outs:
+            o = np.asarray(o)
+            result.append(o[:, 0] if squeeze and o.ndim == 3 else o)
+        return result
+
+    def rnn_clear_previous_state(self):
+        self._rnn_state = {}
+
     def score(self, data, labels=None) -> float:
         mds = _as_mds(data, labels)
         fn = self._get_jit("score")

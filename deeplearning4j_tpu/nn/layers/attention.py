@@ -23,6 +23,7 @@ semantics identical to every other layer.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.nn import activations
@@ -52,16 +53,46 @@ def _masked_dense_attention(q, k, v, mask, causal, scale):
     return jnp.swapaxes(o, 1, 2).astype(q.dtype)
 
 
+def _cached_decode_attention(q, kc, vc, pos, causal):
+    """Decode-step attention against a fixed-size KV cache. q: [B, T, H, D]
+    (the NEW positions, globally at [pos, pos+T)); kc/vc: [B, L, H, D] with
+    valid keys in [0, pos+T). Causal: query i sees keys <= pos+i."""
+    B, T, H, D = q.shape
+    L = kc.shape[1]
+    acc = jnp.promote_types(q.dtype, jnp.float32)
+    qt = jnp.swapaxes(q, 1, 2).astype(acc) * (D ** -0.5)
+    kt = jnp.swapaxes(kc, 1, 2).astype(acc)
+    vt = jnp.swapaxes(vc, 1, 2).astype(acc)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt)
+    kpos = jnp.arange(L)
+    if causal:
+        limit = pos + 1 + jnp.arange(T)          # query i sees < pos+i+1
+    else:
+        limit = jnp.full((T,), pos + T)
+    s = jnp.where(kpos[None, None, None, :] < limit[None, None, :, None],
+                  s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, vt)
+    return jnp.swapaxes(o, 1, 2).astype(q.dtype)
+
+
 def self_attention_apply(conf, params, state, x, *, rng=None, train=False,
                          mask=None):
     """x: [B, T, n_in] -> [B, T, n_out] multi-head self-attention.
 
     Path selection (trace-time, static):
-    1. active ParallelContext with a >1 sequence axis -> ring attention
+    1. KV cache present in `state` (stateful decode via rnn_time_step,
+       `conf.decode_cache_length`) -> fixed-size cached attention;
+    2. active ParallelContext with a >1 sequence axis -> ring attention
        (sequence-sharded exact attention; requires causal or no mask);
-    2. features mask present -> XLA dense with key masking;
-    3. otherwise -> `parallel.sequence.attention` (Pallas flash kernel for
+    3. features mask present -> XLA dense with key masking;
+    4. otherwise -> `parallel.sequence.attention` (Pallas flash kernel for
        `impl="auto"`, dense oracle for `impl="dense"`).
+
+    With `decode_cache_length` set the layer ALWAYS returns its cache
+    entries (k_cache/v_cache/kv_pos) as undeclared state: the engines
+    persist them only on the stateful-inference path and XLA eliminates
+    the dead outputs everywhere else.
     """
     from deeplearning4j_tpu.parallel import sequence as seq_mod
 
@@ -85,6 +116,22 @@ def self_attention_apply(conf, params, state, x, *, rng=None, train=False,
     v = proj("Wv", "vB")
     scale = Dh ** -0.5
 
+    L = conf.decode_cache_length
+    if L and "kv_pos" in state:
+        # Stateful decode step: fold the new k/v into the cache at the
+        # cursor, attend against the valid prefix.
+        pos = state["kv_pos"]
+        zero = jnp.zeros((), jnp.int32)
+        kc = jax.lax.dynamic_update_slice(state["k_cache"], k,
+                                          (zero, pos, zero, zero))
+        vc = jax.lax.dynamic_update_slice(state["v_cache"], v,
+                                          (zero, pos, zero, zero))
+        o = _cached_decode_attention(q, kc, vc, pos, conf.causal)
+        out = o.reshape(B, T, conf.n_out) @ params["Wo"] + params["oB"]
+        out = activations.resolve(conf.activation)(out)
+        return out, {"k_cache": kc, "v_cache": vc,
+                     "kv_pos": pos + jnp.int32(T)}, mask
+
     ctx = current_context()
     if ctx is not None and ctx.seq_axis is not None and ctx.axis_size("seq") > 1:
         if mask is not None and not conf.causal:
@@ -105,4 +152,16 @@ def self_attention_apply(conf, params, state, x, *, rng=None, train=False,
                               impl=conf.attention_impl)
     out = o.reshape(B, T, conf.n_out) @ params["Wo"] + params["oB"]
     out = activations.resolve(conf.activation)(out)
-    return out, state, mask
+    new_state = state
+    if L:
+        if T > L:
+            raise ValueError(
+                f"priming length {T} exceeds decode_cache_length {L}")
+        # Prime the decode cache (undeclared state: persists only via
+        # rnn_time_step; dead code elsewhere).
+        pad = [(0, 0), (0, L - T), (0, 0), (0, 0)]
+        new_state = {
+            "k_cache": jnp.pad(k, pad), "v_cache": jnp.pad(v, pad),
+            "kv_pos": jnp.int32(T),
+        }
+    return out, new_state, mask

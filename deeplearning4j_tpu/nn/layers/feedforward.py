@@ -153,11 +153,19 @@ def rbm_apply(conf, params, state, x, *, rng=None, train=False, mask=None):
 
 def positional_embedding_apply(conf, params, state, x, *, rng=None,
                                train=False, mask=None):
-    """x: [B, T, F] -> x + P[:T] (learned GPT-style position table,
-    `nn/conf/layers.py::PositionalEmbeddingLayer`)."""
+    """x: [B, T, F] -> x + P[pos:pos+T] (learned GPT-style position table,
+    `nn/conf/layers.py::PositionalEmbeddingLayer`).
+
+    The position cursor rides undeclared state: a fresh forward starts at
+    0 (== P[:T]); stateful decode via `rnn_time_step` resumes where the
+    previous call stopped, so single-token steps get the RIGHT position
+    rows. Cursor output is dead code on every non-stateful path."""
     T = x.shape[1]
     if T > conf.max_length:
         raise ValueError(
             f"sequence length {T} exceeds PositionalEmbeddingLayer "
             f"max_length {conf.max_length}")
-    return x + params["P"][:T], state, mask
+    start = state.get("pos", jnp.int32(0))
+    rows = jax.lax.dynamic_slice(
+        params["P"], (start, jnp.int32(0)), (T, params["P"].shape[1]))
+    return x + rows, {"pos": start + jnp.int32(T)}, mask

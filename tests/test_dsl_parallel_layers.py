@@ -328,3 +328,92 @@ def test_generate_lm_samples_learned_pattern(rng):
 
     out = generate_lm(cg, [2, 3], 6, window=t, temperature=0)
     assert out == [2, 3, 4, 5, 0, 1, 2, 3]
+
+
+class TestKVCacheDecode:
+    """KV-cache stateful decoding: transformer_lm(decode_cache_length=N)
+    steps one token at a time via ComputationGraph.rnn_time_step with
+    outputs equal to the full forward at every position."""
+
+    def _model(self, rng, v=10, t=12):
+        from deeplearning4j_tpu.models.zoo import transformer_lm
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        conf = transformer_lm(vocab_size=v, t=t, d_model=16, n_heads=2,
+                              n_blocks=2, decode_cache_length=t)
+        return ComputationGraph(conf).init()
+
+    def test_cached_stepping_matches_full_forward(self, rng):
+        v, t = 10, 12
+        cg = self._model(rng, v, t)
+        idx = rng.randint(0, v, (2, t)).astype("float32")
+        full = cg.output_single(idx)  # [2, t, v]
+
+        cg.rnn_clear_previous_state()
+        prime = cg.rnn_time_step(idx[:, :4, None])[0]  # [2, 4, v]
+        np.testing.assert_allclose(prime, full[:, :4], rtol=2e-4, atol=2e-5)
+        for pos in range(4, t):
+            step = cg.rnn_time_step(idx[:, pos:pos + 1, None])[0]
+            np.testing.assert_allclose(
+                step[:, 0], full[:, pos], rtol=2e-4, atol=2e-5,
+                err_msg=f"position {pos}")
+
+    def test_generate_cached_equals_windowed(self, rng):
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+        from deeplearning4j_tpu.models.zoo import generate_lm
+
+        v, t = 6, 16
+        cg = self._model(rng, v, t)
+        starts = rng.randint(0, v, 16)
+        idx = (starts[:, None] + np.arange(t)[None]) % v
+        mds = MultiDataSet(features=[idx.astype("float32")],
+                           labels=[np.eye(v, dtype="float32")[(idx + 1) % v]])
+        for _ in range(120):
+            cg.fit(mds)
+
+        windowed = generate_lm(cg, [1, 2], 8, window=t, temperature=0)
+        cached = generate_lm(cg, [1, 2], 8, window=t, temperature=0,
+                             use_cache=True)
+        assert cached == windowed
+        assert cached[:6] == [1, 2, 3, 4, 5, 0]
+
+    def test_cache_capacity_guard(self, rng):
+        from deeplearning4j_tpu.models.zoo import generate_lm
+
+        cg = self._model(rng, v=6, t=8)
+        with pytest.raises(ValueError, match="cache capacity"):
+            generate_lm(cg, [1], 20, window=8, temperature=0,
+                        use_cache=True)
+
+
+class TestGraphRnnTimeStep:
+    """ComputationGraph.rnn_time_step (reference:
+    `ComputationGraph.rnnTimeStep:1386`): stepping one timestep at a time
+    with carried hidden state equals the full-sequence forward."""
+
+    def test_lstm_graph_stepping_matches_full(self, rng):
+        from deeplearning4j_tpu.nn.conf.layers import GravesLSTM
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        gb = (NeuralNetConfiguration.builder()
+              .seed(3).learning_rate(0.1).updater("sgd")
+              .graph_builder()
+              .add_inputs("in")
+              .add_layer("lstm", GravesLSTM(n_out=8, activation="tanh"), "in")
+              .add_layer("out", RnnOutputLayer(n_out=3, activation="softmax",
+                                               loss_function="mcxent"),
+                         "lstm")
+              .set_outputs("out"))
+        gb.set_input_types(InputType.recurrent(4, 6))
+        cg = ComputationGraph(gb.build()).init()
+        X = rng.randn(2, 6, 4).astype("float32")
+        full = cg.output_single(X)
+
+        cg.rnn_clear_previous_state()
+        steps = [cg.rnn_time_step(X[:, t])[0] for t in range(6)]
+        np.testing.assert_allclose(np.stack(steps, axis=1), full,
+                                   rtol=1e-5, atol=1e-6)
+        # Clearing state restarts the sequence.
+        cg.rnn_clear_previous_state()
+        again = cg.rnn_time_step(X[:, 0])[0]
+        np.testing.assert_allclose(again, steps[0], rtol=1e-6)

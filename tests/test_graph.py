@@ -126,3 +126,4 @@ class TestDeepWalk:
     def test_initialize_from_degrees(self):
         dw = DeepWalk(vector_size=4).initialize(np.array([3, 2, 1, 1]))
         assert dw._syn0.shape == (4, 4)
+
