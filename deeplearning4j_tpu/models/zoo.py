@@ -190,7 +190,8 @@ def transformer_lm(vocab_size: int, *, t: int = 64, d_model: int = 64,
           .add_layer("emb", EmbeddingLayer(n_out=d_model, has_bias=False,
                                            activation="identity"), "tokens")
           .add_layer("pos", PositionalEmbeddingLayer(
-              max_length=max(t, 16, decode_cache_length or 0)), "emb"))
+              max_length=max(t, 16, decode_cache_length or 0),
+              stateful=decode_cache_length is not None), "emb"))
     prev = "pos"
     for i in range(n_blocks):
         # Pre-LN block: x + Attn(LN(x)); x + FFN(LN(x)).
@@ -276,11 +277,12 @@ def generate_lm(cg, prompt_ids, n_steps: int, *, window: int,
             raise ValueError(
                 f"prompt ({len(ids)}) + n_steps ({n_steps}) exceeds the "
                 f"decode cache capacity {min(cache_lens)}")
+        if n_steps == 0:
+            return ids
         cg.rnn_clear_previous_state()
         out = cg.rnn_time_step(
             np.asarray(ids, np.float32)[None, :, None])[0]  # [1, Tp, V]
-        nxt = pick(out[0, -1])
-        ids.append(nxt)
+        ids.append(pick(out[0, -1]))
         for _ in range(n_steps - 1):
             out = cg.rnn_time_step(
                 np.asarray([[[float(ids[-1])]]], np.float32))[0]

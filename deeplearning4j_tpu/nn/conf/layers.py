@@ -558,9 +558,16 @@ class PositionalEmbeddingLayer(FeedForwardLayer):
 
     No reference equivalent (predates transformers); feeds
     `models/zoo.transformer_lm`. `max_length` rows are allocated; forward
-    slices the first T (T <= max_length enforced at trace time)."""
+    slices the first T (T <= max_length enforced at trace time).
+
+    `stateful=True` adds a position cursor to the layer's (undeclared)
+    state, so single-token decode steps via `rnn_time_step` get the right
+    position rows (set by `transformer_lm(decode_cache_length=...)`).
+    Default False: every forward starts at position 0, preserving plain /
+    tBPTT semantics."""
 
     max_length: int = 512
+    stateful: bool = False
     activation: Any = "identity"
 
     def get_output_type(self, input_type: InputType) -> InputType:

@@ -586,6 +586,13 @@ class ComputationGraph:
     def _fit_tbptt(self, mds: MultiDataSet):
         """Truncated BPTT over a DAG (reference: `ComputationGraph` tBPTT path):
         chunk all sequence arrays along time; rnn state carries across chunks."""
+        if any(getattr(v.layer, "decode_cache_length", None)
+               for v in self.layer_vertices.values()):
+            raise ValueError(
+                "truncated BPTT carries undeclared layer state across "
+                "chunks, which would thread attention KV caches into "
+                "training; unset decode_cache_length (it is an inference "
+                "feature) or use standard backprop")
         fwd = self.conf.tbptt_fwd_length
         t = max(f.shape[1] for f in mds.features if f.ndim == 3)
         saved_state = self.state
@@ -729,6 +736,8 @@ class ComputationGraph:
         `MultiLayerNetwork.rnn_time_step`): hidden state (LSTM carries,
         attention KV caches, positional cursors) persists across calls.
         Accepts [b, f] (one step) or [b, t, f] per input."""
+        from deeplearning4j_tpu.nn import rnn_state as rnn_mod
+
         arrs = []
         squeeze = False
         for x in inputs:
@@ -737,22 +746,17 @@ class ComputationGraph:
                 x = x[:, None, :]
                 squeeze = True
             arrs.append(x)
+        self._rnn_pos = rnn_mod.check_decode_budget(
+            getattr(self, "_rnn_pos", 0), arrs[0].shape[1],
+            rnn_mod.decode_capacity(
+                v.layer for v in self.layer_vertices.values()))
         fn = self._get_jit("output", train=False, keep_rnn_state=True)
-        state = dict(self.state)
-        for name, s in self._rnn_state.items():
-            merged = dict(state.get(name, {}))
-            merged.update(s)
-            state[name] = merged
+        state = rnn_mod.merge_rnn_state(self.state, self._rnn_state)
         outs, new_state = fn(self.params_tree, state,
                              [jnp.asarray(x) for x in arrs], None,
                              jax.random.PRNGKey(0))
-        declared = self._declared_state()
-        self._rnn_state = {
-            name: {k: v for k, v in s.items()
-                   if k not in dict(declared).get(name, ())}
-            for name, s in new_state.items()
-        }
-        self._rnn_state = {n: s for n, s in self._rnn_state.items() if s}
+        self._rnn_state = rnn_mod.split_rnn_state(new_state,
+                                                  self._declared_state())
         result = []
         for o in outs:
             o = np.asarray(o)
@@ -761,6 +765,7 @@ class ComputationGraph:
 
     def rnn_clear_previous_state(self):
         self._rnn_state = {}
+        self._rnn_pos = 0
 
     def score(self, data, labels=None) -> float:
         mds = _as_mds(data, labels)

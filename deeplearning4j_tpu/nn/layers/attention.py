@@ -153,12 +153,11 @@ def self_attention_apply(conf, params, state, x, *, rng=None, train=False,
     out = o.reshape(B, T, conf.n_out) @ params["Wo"] + params["oB"]
     out = activations.resolve(conf.activation)(out)
     new_state = state
-    if L:
-        if T > L:
-            raise ValueError(
-                f"priming length {T} exceeds decode_cache_length {L}")
+    if L and T <= L:
         # Prime the decode cache (undeclared state: persists only via
-        # rnn_time_step; dead code elsewhere).
+        # rnn_time_step; dead code elsewhere). T > L skips priming — the
+        # plain forward must keep working on sequences longer than the
+        # cache; the engines' rnn_time_step guards capacity host-side.
         pad = [(0, 0), (0, L - T), (0, 0), (0, 0)]
         new_state = {
             "k_cache": jnp.pad(k, pad), "v_cache": jnp.pad(v, pad),

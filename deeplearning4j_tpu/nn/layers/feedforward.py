@@ -156,15 +156,20 @@ def positional_embedding_apply(conf, params, state, x, *, rng=None,
     """x: [B, T, F] -> x + P[pos:pos+T] (learned GPT-style position table,
     `nn/conf/layers.py::PositionalEmbeddingLayer`).
 
-    The position cursor rides undeclared state: a fresh forward starts at
-    0 (== P[:T]); stateful decode via `rnn_time_step` resumes where the
-    previous call stopped, so single-token steps get the RIGHT position
-    rows. Cursor output is dead code on every non-stateful path."""
+    With `conf.stateful`, a position cursor rides undeclared state: a
+    fresh forward starts at 0 (== P[:T]); stateful decode via
+    `rnn_time_step` resumes where the previous call stopped, so
+    single-token steps get the RIGHT position rows. Stateless (default)
+    always adds P[:T] — the cursor must be OPT-IN because tBPTT's
+    carry_rnn path would otherwise advance it across chunks, silently
+    changing existing models' training."""
     T = x.shape[1]
     if T > conf.max_length:
         raise ValueError(
             f"sequence length {T} exceeds PositionalEmbeddingLayer "
             f"max_length {conf.max_length}")
+    if not getattr(conf, "stateful", False):
+        return x + params["P"][:T], state, mask
     start = state.get("pos", jnp.int32(0))
     rows = jax.lax.dynamic_slice(
         params["P"], (start, jnp.int32(0)), (T, params["P"].shape[1]))

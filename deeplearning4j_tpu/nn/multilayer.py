@@ -724,6 +724,12 @@ class MultiLayerNetwork:
         """Truncated BPTT (reference: `doTruncatedBPTT:1138`): chunk the time
         axis; rnn state carries across chunks as data (implicit gradient
         truncation at chunk boundaries)."""
+        if any(getattr(l, "decode_cache_length", None) for l in self.layers):
+            raise ValueError(
+                "truncated BPTT carries undeclared layer state across "
+                "chunks, which would thread attention KV caches into "
+                "training; unset decode_cache_length (it is an inference "
+                "feature) or use standard backprop")
         fwd = self.conf.tbptt_fwd_length
         t = ds.features.shape[1]
         n_chunks = math.ceil(t / fwd)
@@ -841,29 +847,27 @@ class MultiLayerNetwork:
     def rnn_time_step(self, x) -> np.ndarray:
         """Stateful single/multi-step inference (reference: `rnnTimeStep:2230`).
         Accepts [b, f] (one step) or [b, t, f]; hidden state persists across calls."""
+        from deeplearning4j_tpu.nn import rnn_state as rnn_mod
+
         x = np.asarray(x)
         squeeze = x.ndim == 2
         if squeeze:
             x = x[:, None, :]
+        self._rnn_pos = rnn_mod.check_decode_budget(
+            getattr(self, "_rnn_pos", 0), x.shape[1],
+            rnn_mod.decode_capacity(self.layers))
         fn = self._get_jit("output", train=False, keep_rnn_state=True)
-        state = dict(self.state)
-        for lk, s in self._rnn_state.items():
-            merged = dict(state.get(lk, {}))
-            merged.update(s)
-            state[lk] = merged
+        state = rnn_mod.merge_rnn_state(self.state, self._rnn_state)
         out, new_state = fn(self.params_tree, state, jnp.asarray(x), None,
                             jax.random.PRNGKey(0))
-        declared = self._declared_state()
-        self._rnn_state = {
-            lk: {k: v for k, v in s.items() if k not in dict(declared).get(lk, ())}
-            for lk, s in new_state.items()
-        }
-        self._rnn_state = {lk: s for lk, s in self._rnn_state.items() if s}
+        self._rnn_state = rnn_mod.split_rnn_state(new_state,
+                                                  self._declared_state())
         out = np.asarray(out)
         return out[:, 0] if squeeze and out.ndim == 3 else out
 
     def rnn_clear_previous_state(self):
         self._rnn_state = {}
+        self._rnn_pos = 0
 
     # ------------------------------------------------------------ eval misc
 

@@ -11,7 +11,8 @@ from deeplearning4j_tpu.nn.graph import ComputationGraph
 
 V, T = 8, 16
 conf = transformer_lm(vocab_size=V, t=T, d_model=32, n_heads=4,
-                      n_blocks=2, moe=True, n_experts=4)
+                      n_blocks=2, moe=True, n_experts=4,
+                      decode_cache_length=32)
 cg = ComputationGraph(conf).init()
 
 rng = np.random.RandomState(0)
@@ -26,3 +27,5 @@ for step in range(200):
 
 print("greedy continuation of [3, 4]:",
       generate_lm(cg, [3, 4], 8, window=T, temperature=0))
+print("same, KV-cached (O(1)/token):",
+      generate_lm(cg, [3, 4], 8, window=T, temperature=0, use_cache=True))

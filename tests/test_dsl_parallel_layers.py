@@ -417,3 +417,86 @@ class TestGraphRnnTimeStep:
         cg.rnn_clear_previous_state()
         again = cg.rnn_time_step(X[:, 0])[0]
         np.testing.assert_allclose(again, steps[0], rtol=1e-6)
+
+
+class TestKVCacheGuards:
+    """Review-driven guards: plain forward works past the cache size,
+    decode overflow fails fast host-side, n_steps=0 parity, tBPTT rejects
+    cached models, stateless positional default."""
+
+    def test_plain_forward_beyond_cache_length(self, rng):
+        from deeplearning4j_tpu.models.zoo import transformer_lm
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        conf = transformer_lm(vocab_size=6, t=8, d_model=16, n_heads=2,
+                              n_blocks=1, decode_cache_length=4)
+        cg = ComputationGraph(conf).init()
+        out = cg.output_single(rng.randint(0, 6, (2, 8)).astype("float32"))
+        assert out.shape == (2, 8, 6) and np.isfinite(out).all()
+
+    def test_decode_overflow_raises(self, rng):
+        from deeplearning4j_tpu.models.zoo import transformer_lm
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        conf = transformer_lm(vocab_size=6, t=8, d_model=16, n_heads=2,
+                              n_blocks=1, decode_cache_length=4)
+        cg = ComputationGraph(conf).init()
+        cg.rnn_clear_previous_state()
+        x = rng.randint(0, 6, (1, 3, 1)).astype("float32")
+        cg.rnn_time_step(x)
+        with pytest.raises(ValueError, match="decode cache capacity"):
+            cg.rnn_time_step(rng.randint(0, 6, (1, 2, 1)).astype("float32"))
+        cg.rnn_clear_previous_state()  # resets the budget
+        cg.rnn_time_step(x)
+
+    def test_generate_zero_steps_parity(self, rng):
+        from deeplearning4j_tpu.models.zoo import generate_lm, transformer_lm
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        conf = transformer_lm(vocab_size=6, t=8, d_model=16, n_heads=2,
+                              n_blocks=1, decode_cache_length=8)
+        cg = ComputationGraph(conf).init()
+        assert generate_lm(cg, [1, 2], 0, window=8) == [1, 2]
+        assert generate_lm(cg, [1, 2], 0, window=8, use_cache=True) == [1, 2]
+
+    def test_tbptt_rejects_cached_model(self, rng):
+        from deeplearning4j_tpu.nn.conf.layers import (
+            RnnOutputLayer, SelfAttentionLayer,
+        )
+
+        conf = (NeuralNetConfiguration.builder()
+                .seed(1).learning_rate(0.1).updater("sgd")
+                .list()
+                .layer(SelfAttentionLayer(n_out=8, n_heads=2,
+                                          decode_cache_length=16))
+                .layer(RnnOutputLayer(n_out=3, activation="softmax",
+                                      loss_function="mcxent"))
+                .backprop_type("truncatedbptt")
+                .t_bptt_forward_length(4).t_bptt_backward_length(4)
+                .set_input_type(InputType.recurrent(8, 12))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        X = rng.randn(2, 12, 8).astype("float32")
+        Y = np.eye(3)[rng.randint(0, 3, (2, 12))].astype("float32")
+        with pytest.raises(ValueError, match="truncated BPTT"):
+            net.fit(DataSet(X, Y))
+
+    def test_positional_default_is_stateless(self, rng):
+        """Without stateful=True the positional layer ignores carried
+        state — pre-round-5 semantics for every existing model."""
+        from deeplearning4j_tpu.nn.conf.layers import (
+            PositionalEmbeddingLayer, RnnOutputLayer,
+        )
+
+        conf = (_builder().list()
+                .layer(PositionalEmbeddingLayer(max_length=8))
+                .layer(RnnOutputLayer(n_out=3, activation="softmax",
+                                      loss_function="mcxent"))
+                .set_input_type(InputType.recurrent(4, 4))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        X = rng.randn(2, 4, 4).astype("float32")
+        net.rnn_clear_previous_state()
+        a = net.rnn_time_step(X)
+        b = net.rnn_time_step(X)  # cursor must NOT advance
+        np.testing.assert_allclose(a, b, rtol=1e-6)
