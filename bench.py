@@ -511,6 +511,55 @@ def bench_flash_triangular(steps, warmup):
     return e
 
 
+def bench_transformer(steps, warmup):
+    """Round-5 config: decoder-only transformer LM (DSL-built:
+    SelfAttentionLayer w/ Pallas flash + pre-LN blocks) — training
+    tokens/sec on device-resident batches. No BASELINE row (the reference
+    predates attention); anchors at its first record."""
+    import ml_dtypes
+
+    from deeplearning4j_tpu.models.zoo import transformer_lm
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    V, T = 8192, 1024
+    B = int(os.environ.get("BENCH_BATCH_TRANSFORMER", "8"))
+    net = ComputationGraph(transformer_lm(
+        vocab_size=V, t=T, d_model=512, n_heads=8, n_blocks=4,
+        dtype="bfloat16")).init()
+
+    import jax
+
+    from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+
+    rng = np.random.RandomState(0)
+
+    def mk():
+        idx = rng.randint(0, V, (B, T))
+        y = np.zeros((B, T, V), np.float32)
+        y[np.arange(B)[:, None], np.arange(T)[None, :],
+          np.roll(idx, -1, axis=1)] = 1.0
+        # Device-resident batch (the [B, T, V] one-hot is ~134 MB — stream
+        # it once, not per step; cached metrics are the framework number).
+        return MultiDataSet(
+            features=[jax.device_put(idx.astype("float32"))],
+            labels=[jax.device_put(y.astype(ml_dtypes.bfloat16))])
+
+    pool = [mk() for _ in range(2)]
+    for _ in range(max(2, warmup)):
+        net.fit(pool[0])
+    _ = net.score_value
+    n = max(8, steps)
+    t0 = time.perf_counter()
+    for i in range(n):
+        net.fit(pool[i % 2])
+    _ = net.score_value
+    dt = time.perf_counter() - t0
+    e = _entry("transformer_lm_train_tokens_per_sec", B * T * n / dt,
+               "tokens/sec")
+    e["ms_per_step"] = round(dt / n * 1e3, 1)
+    return e
+
+
 def bench_resnet50(steps, warmup):
     import ml_dtypes
 
@@ -583,7 +632,7 @@ def main():
     configs = os.environ.get(
         "BENCH_CONFIGS",
         "resnet50,lenet,char_rnn,lenet_step,word2vec,vgg16,flash_attn,"
-        "flash_tri"
+        "flash_tri,transformer"
     ).split(",")
 
     head, extra = None, {}
@@ -615,6 +664,9 @@ def main():
         extra[e["metric"]] = e
     if "flash_tri" in configs:
         e = bench_flash_triangular(steps, warmup)
+        extra[e["metric"]] = e
+    if "transformer" in configs:
+        e = bench_transformer(steps, warmup)
         extra[e["metric"]] = e
     if head is None:  # resnet50 excluded: promote the first extra metric
         if not extra:
