@@ -500,3 +500,58 @@ class TestKVCacheGuards:
         a = net.rnn_time_step(X)
         b = net.rnn_time_step(X)  # cursor must NOT advance
         np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+class TestAxisComposition:
+    """Parallel axes compose through ONE DSL model: attention under
+    tensor parallelism, and a 3-axis data x seq x expert mesh driving
+    ring attention and expert-parallel MoE in the same jitted step."""
+
+    def test_attention_with_tensor_parallel(self, rng):
+        X, Y = _seq_data(rng)
+        net0 = MultiLayerNetwork(_attention_conf(impl="dense")).init()
+        for _ in range(4):
+            net0.fit(DataSet(X, Y))
+
+        net1 = MultiLayerNetwork(_attention_conf(impl="dense")).init()
+        mesh = mesh_mod.create_mesh((2, 4), axis_names=("data", "model"))
+        pw = ParallelWrapper(net1, mesh=mesh, model_axis="model")
+        for _ in range(4):
+            pw.fit(DataSet(X, Y))
+        for lk in net0.params_tree:
+            for pk in net0.params_tree[lk]:
+                np.testing.assert_allclose(
+                    np.asarray(net0.params_tree[lk][pk]),
+                    np.asarray(net1.params_tree[lk][pk]),
+                    rtol=5e-4, atol=5e-5, err_msg=f"{lk}/{pk}")
+
+    def test_three_axis_mesh_attention_plus_moe(self, rng):
+        def make():
+            conf = (_builder().list()
+                    .layer(SelfAttentionLayer(n_out=16, n_heads=4,
+                                              causal=True))
+                    .layer(MoELayer(n_out=16, n_experts=2, expert_hidden=32,
+                                    top_k=2))
+                    .layer(RnnOutputLayer(n_out=5, activation="softmax",
+                                          loss_function="mcxent"))
+                    .set_input_type(InputType.recurrent(8, 12))
+                    .build())
+            return MultiLayerNetwork(conf).init()
+
+        X, Y = _seq_data(rng)
+        net0 = make()
+        net0.fit(DataSet(X, Y))
+
+        net1 = make()
+        mesh = mesh_mod.create_mesh((2, 2, 2),
+                                    axis_names=("data", "seq", "expert"))
+        pw = ParallelWrapper(net1, mesh=mesh, seq_axis="seq",
+                             expert_axis="expert")
+        pw.fit(DataSet(X, Y))
+        assert net1.params_tree["layer_1"]["w1"].sharding.spec[0] == "expert"
+        for lk in net0.params_tree:
+            for pk in net0.params_tree[lk]:
+                np.testing.assert_allclose(
+                    np.asarray(net0.params_tree[lk][pk]),
+                    np.asarray(net1.params_tree[lk][pk]),
+                    rtol=5e-4, atol=5e-5, err_msg=f"{lk}/{pk}")
