@@ -522,7 +522,7 @@ def bench_transformer(steps, warmup):
     from deeplearning4j_tpu.nn.graph import ComputationGraph
 
     V, T = 8192, 1024
-    B = int(os.environ.get("BENCH_BATCH_TRANSFORMER", "8"))
+    B = int(os.environ.get("BENCH_BATCH_TRANSFORMER", "16"))
     net = ComputationGraph(transformer_lm(
         vocab_size=V, t=T, d_model=512, n_heads=8, n_blocks=4,
         dtype="bfloat16")).init()
@@ -535,14 +535,13 @@ def bench_transformer(steps, warmup):
 
     def mk():
         idx = rng.randint(0, V, (B, T))
-        y = np.zeros((B, T, V), np.float32)
-        y[np.arange(B)[:, None], np.arange(T)[None, :],
-          np.roll(idx, -1, axis=1)] = 1.0
-        # Device-resident batch (the [B, T, V] one-hot is ~134 MB — stream
-        # it once, not per step; cached metrics are the framework number).
+        # Sparse class-id labels (round 5): [B, T] int32 instead of the
+        # [B, T, V] one-hot (134 MB at these dims) — the format real LM
+        # training uses. Device-resident batches either way.
         return MultiDataSet(
             features=[jax.device_put(idx.astype("float32"))],
-            labels=[jax.device_put(y.astype(ml_dtypes.bfloat16))])
+            labels=[jax.device_put(
+                np.roll(idx, -1, axis=1).astype(np.int32))])
 
     pool = [mk() for _ in range(2)]
     for _ in range(max(2, warmup)):

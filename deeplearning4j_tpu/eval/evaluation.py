@@ -48,9 +48,15 @@ class Evaluation:
 
     def eval(self, labels, predictions, mask=None):
         """Accumulate a batch. labels/predictions: [b, c] or [b, t, c]
-        (one-hot labels, probability predictions); mask: [b, t]."""
+        (one-hot labels, probability predictions); mask: [b, t]. Integer
+        class-id labels ([b] / [b, t], the sparse-label training format)
+        are accepted and one-hot-expanded against the prediction width."""
         labels = np.asarray(labels)
         predictions = np.asarray(predictions)
+        if (np.issubdtype(labels.dtype, np.integer)
+                and labels.ndim == predictions.ndim - 1):
+            labels = np.eye(predictions.shape[-1],
+                            dtype=np.float32)[labels]
         if labels.ndim == 3:
             if mask is not None:
                 keep = np.asarray(mask).reshape(-1) > 0

@@ -437,7 +437,9 @@ class ComputationGraph:
             if type(layer).__name__ == "CenterLossOutputLayer":
                 feats = aux[f"center_loss_input:{name}"].astype(self._loss_dtype)
                 centers = aux[f"centers:{name}"]
-                cls = jnp.argmax(y, axis=-1)
+                cls = (jnp.asarray(y, jnp.int32)
+                       if jnp.issubdtype(jnp.asarray(y).dtype, jnp.integer)
+                       else jnp.argmax(y, axis=-1))
                 c = centers[cls]
                 # Row weights: labels mask excludes data-parallel padding rows
                 # from the center-loss term and the center updates.

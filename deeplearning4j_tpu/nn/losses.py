@@ -48,6 +48,33 @@ def compute_per_example(
     key = loss.value if isinstance(loss, LossFunction) else str(loss).lower()
     act = _act_name(activation)
 
+    if (jnp.issubdtype(jnp.asarray(labels).dtype, jnp.integer)
+            and jnp.ndim(labels) == jnp.ndim(preout) - 1):
+        # SPARSE class-id labels ([B] / [B, T] ints) — a TPU-native
+        # extension beyond the reference's one-hot-only contract: at LM
+        # vocabulary sizes the one-hot [B, T, V] tensor is the dominant
+        # batch payload (B=8, T=1024, V=50k fp32 = 1.6 GB), while ids are
+        # KBs. Cross-entropy only; other losses need dense targets.
+        if key not in (LossFunction.MCXENT.value,
+                       LossFunction.NEGATIVELOGLIKELIHOOD.value):
+            raise ValueError(
+                f"integer class-id labels are only supported for "
+                f"mcxent/negativeloglikelihood, not {key!r}")
+        ids = jnp.asarray(labels, jnp.int32)[..., None]
+        if act == Activation.SOFTMAX.value:
+            # -log p[id] = logsumexp(z) - z[id]: gathers ONE logit per
+            # position instead of materializing the full [.., V]
+            # log-softmax intermediate.
+            picked = jnp.take_along_axis(preout, ids, axis=-1)[..., 0]
+            per = jax.scipy.special.logsumexp(preout, axis=-1) - picked
+        else:
+            out = activations.resolve(activation)(preout)
+            logp = jnp.log(jnp.clip(out, _EPS, 1.0))
+            per = -jnp.take_along_axis(logp, ids, axis=-1)[..., 0]
+        if mask is not None:
+            per = per * mask
+        return per
+
     if key in (LossFunction.MCXENT.value, LossFunction.NEGATIVELOGLIKELIHOOD.value):
         if act == Activation.SOFTMAX.value:
             logp = jax.nn.log_softmax(preout, axis=-1)

@@ -429,7 +429,9 @@ class MultiLayerNetwork:
         if isinstance(layer, CenterLossOutputLayer):
             feats = aux["center_loss_input"].astype(self._loss_dtype)
             centers = aux["centers"]
-            cls = jnp.argmax(y, axis=-1)
+            cls = (jnp.asarray(y, jnp.int32)
+                   if jnp.issubdtype(jnp.asarray(y).dtype, jnp.integer)
+                   else jnp.argmax(y, axis=-1))
             c = centers[cls]
             # Row weights: the labels mask excludes data-parallel padding rows
             # from both the center-loss term and the center updates.
@@ -739,9 +741,15 @@ class MultiLayerNetwork:
         eb = jax.device_put(np.float32(
             losses_mod.effective_batch_size(ds.features, ds.labels_mask)
         ))
-        if ds.labels is None or np.ndim(ds.labels) != 3:
+        sparse_labels = (ds.labels is not None
+                         and np.issubdtype(np.asarray(ds.labels).dtype,
+                                           np.integer)
+                         and np.ndim(ds.labels) == 2)
+        if ds.labels is None or (np.ndim(ds.labels) != 3
+                                 and not sparse_labels):
             raise ValueError(
-                "Truncated BPTT requires 3-D per-timestep labels [b, t, c] "
+                "Truncated BPTT requires per-timestep labels: [b, t, c] "
+                "one-hot or [b, t] integer class ids "
                 "(reference doTruncatedBPTT semantics)"
             )
         if not self._collect_stats:

@@ -179,5 +179,8 @@ def _full_labels_mask(labels: np.ndarray, lmask):
     (so the pad can zero the appended rows)."""
     if lmask is not None:
         return np.asarray(lmask)
-    shape = (labels.shape[0],) if labels.ndim == 2 else labels.shape[:2]
-    return np.ones(shape, np.result_type(labels, np.float32))
+    if labels.ndim == 2 and np.issubdtype(labels.dtype, np.integer):
+        shape = labels.shape  # sparse [b, t] class ids: per-timestep mask
+    else:
+        shape = (labels.shape[0],) if labels.ndim == 2 else labels.shape[:2]
+    return np.ones(shape, np.float32)

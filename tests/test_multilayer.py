@@ -457,3 +457,68 @@ class TestUint8DeviceScaling:
         np.testing.assert_allclose(cg.output_single(Xb),
                                    cg.output_single(Xf),
                                    rtol=1e-6, atol=1e-7)
+
+
+class TestSparseLabels:
+    """Integer class-id labels ([b] / [b, t]) for the cross-entropy
+    losses — a TPU-native extension past the reference's one-hot-only
+    contract (at LM vocab sizes the one-hot tensor dominates the batch
+    payload). Must train bit-identically to one-hot."""
+
+    def test_mlp_sparse_equals_onehot(self, rng):
+        X, Y = make_classification_data(rng)
+        ids = Y.argmax(-1).astype(np.int32)
+        n1 = MultiLayerNetwork(mlp_conf(updater="adam", lr=0.05)).init()
+        n2 = MultiLayerNetwork(mlp_conf(updater="adam", lr=0.05)).init()
+        for _ in range(5):
+            n1.fit(DataSet(X, Y))
+            n2.fit(DataSet(X, ids))
+        np.testing.assert_allclose(n1.params(), n2.params(), rtol=1e-5)
+        assert abs(n1.score(DataSet(X, Y)) - n2.score(DataSet(X, ids))) < 1e-5
+        # Evaluation accepts ids too.
+        assert n2.evaluate(DataSet(X, ids)).accuracy() == \
+            n1.evaluate(DataSet(X, Y)).accuracy()
+
+    def test_rnn_sequence_sparse_equals_onehot(self, rng):
+        from deeplearning4j_tpu.nn.conf.layers import GravesLSTM, RnnOutputLayer
+
+        conf_b = (NeuralNetConfiguration.builder()
+                  .seed(3).learning_rate(0.1).updater("sgd")
+                  .list()
+                  .layer(GravesLSTM(n_out=8, activation="tanh"))
+                  .layer(RnnOutputLayer(n_out=4, activation="softmax",
+                                        loss_function="mcxent"))
+                  .set_input_type(InputType.recurrent(5, 6)))
+        X = rng.randn(3, 6, 5).astype("float32")
+        ids = rng.randint(0, 4, (3, 6)).astype(np.int32)
+        Y = np.eye(4, dtype="float32")[ids]
+        n1 = MultiLayerNetwork(conf_b.build()).init()
+        n2 = n1.clone()
+        n1.fit(DataSet(X, Y))
+        n2.fit(DataSet(X, ids))
+        np.testing.assert_allclose(n1.params(), n2.params(), rtol=1e-5)
+
+    def test_sparse_rejected_for_non_xent(self, rng):
+        from deeplearning4j_tpu.nn import losses
+
+        with pytest.raises(ValueError, match="integer class-id"):
+            losses.score("mse", np.zeros(4, np.int32), np.zeros((4, 3)),
+                         "identity")
+
+    def test_transformer_trains_on_sparse_ids(self, rng):
+        """The motivating case: LM training feeds [B, T] ids directly."""
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+        from deeplearning4j_tpu.models.zoo import transformer_lm
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        v, t = 12, 10
+        cg = ComputationGraph(transformer_lm(
+            vocab_size=v, t=t, d_model=16, n_heads=2, n_blocks=1)).init()
+        idx = rng.randint(0, v, (4, t))
+        mds = MultiDataSet(
+            features=[idx.astype("float32")],
+            labels=[np.roll(idx, -1, axis=1).astype(np.int32)])
+        s0 = cg.score(mds)
+        for _ in range(20):
+            cg.fit(mds)
+        assert cg.score(mds) < s0
