@@ -53,16 +53,25 @@ class Evaluation:
         are accepted and one-hot-expanded against the prediction width."""
         labels = np.asarray(labels)
         predictions = np.asarray(predictions)
-        if (np.issubdtype(labels.dtype, np.integer)
-                and labels.ndim == predictions.ndim - 1):
-            labels = np.eye(predictions.shape[-1],
-                            dtype=np.float32)[labels]
-        if labels.ndim == 3:
+        sparse = (np.issubdtype(labels.dtype, np.integer)
+                  and labels.ndim == predictions.ndim - 1)
+        if sparse:
+            # Ids ARE the argmax — no one-hot expansion (np.eye(V) is V x V,
+            # 10 GB at V=50k, the regime sparse labels exist for). Range-
+            # check loudly: the jitted training path clamps silently.
+            C = predictions.shape[-1]
+            if labels.size and (labels.min() < 0 or labels.max() >= C):
+                raise ValueError(
+                    f"class ids must be in [0, {C}); got "
+                    f"[{labels.min()}, {labels.max()}]")
+        if predictions.ndim == 3:
             if mask is not None:
                 keep = np.asarray(mask).reshape(-1) > 0
             else:
-                keep = np.ones(labels.shape[0] * labels.shape[1], bool)
-            labels = labels.reshape(-1, labels.shape[-1])[keep]
+                keep = np.ones(predictions.shape[0] * predictions.shape[1],
+                               bool)
+            labels = (labels.reshape(-1)[keep] if sparse
+                      else labels.reshape(-1, labels.shape[-1])[keep])
             predictions = predictions.reshape(-1, predictions.shape[-1])[keep]
         elif mask is not None:
             # Per-example mask on 2-D labels (e.g. padded batches): drop
@@ -70,8 +79,9 @@ class Evaluation:
             keep = np.asarray(mask).reshape(-1) > 0
             labels = labels[keep]
             predictions = predictions[keep]
-        self._ensure(labels.shape[-1])
-        actual = np.argmax(labels, axis=-1)
+        self._ensure(predictions.shape[-1])
+        actual = labels.astype(np.int64) if sparse \
+            else np.argmax(labels, axis=-1)
         pred = np.argmax(predictions, axis=-1)
         for a, p in zip(actual, pred):
             self.confusion.add(int(a), int(p))

@@ -342,7 +342,9 @@ class ComputationGraph:
                     out = []
                     for a in lst:
                         seq = a is not None and a.shape[1:2] == (t,) and (
-                            a.ndim == 3 or (is_mask and a.ndim == 2))
+                            a.ndim == 3 or (a.ndim == 2 and (
+                                is_mask
+                                or jnp.issubdtype(a.dtype, jnp.integer))))
                         out.append(slicer(a) if seq else a)
                     return out
 
@@ -610,20 +612,26 @@ class ComputationGraph:
             for i, l in enumerate(mds.labels)
         )
         for lab in mds.labels:
-            if lab.ndim != 3:
+            sparse = (np.issubdtype(np.asarray(lab).dtype, np.integer)
+                      and lab.ndim == 2)
+            if lab.ndim != 3 and not sparse:
                 raise ValueError(
-                    "Truncated BPTT requires 3-D per-timestep labels [b, t, c]"
+                    "Truncated BPTT requires per-timestep labels: [b, t, c] "
+                    "one-hot or [b, t] integer class ids"
                 )
 
         def time_slice(a, sl, is_mask=False):
-            # Only 3-D [b, t, f] arrays (and, explicitly, 2-D [b, t] masks)
-            # are sequences; a static 2-D input whose feature dim happens to
-            # equal t must pass through untouched.
+            # Only 3-D [b, t, f] arrays (and, explicitly, 2-D [b, t] masks
+            # or [b, t] integer class-id labels) are sequences; a static
+            # 2-D float input whose feature dim happens to equal t must
+            # pass through untouched.
             if a is None:
                 return None
             if a.ndim == 3 and a.shape[1] == t:
                 return a[:, sl]
-            if is_mask and a.ndim == 2 and a.shape[1] == t:
+            if a.ndim == 2 and a.shape[1] == t and (
+                    is_mask or np.issubdtype(np.asarray(a).dtype,
+                                             np.integer)):
                 return a[:, sl]
             return a
 

@@ -55,6 +55,9 @@ def compute_per_example(
         # vocabulary sizes the one-hot [B, T, V] tensor is the dominant
         # batch payload (B=8, T=1024, V=50k fp32 = 1.6 GB), while ids are
         # KBs. Cross-entropy only; other losses need dense targets.
+        # Ids MUST be in [0, V): under jit the gather clamps out-of-range
+        # ids silently (no data-dependent errors in XLA); `Evaluation`
+        # range-checks loudly on host, so run eval on new data pipelines.
         if key not in (LossFunction.MCXENT.value,
                        LossFunction.NEGATIVELOGLIKELIHOOD.value):
             raise ValueError(

@@ -28,25 +28,29 @@ from deeplearning4j_tpu.parallel import mesh as mesh_mod
 from deeplearning4j_tpu.parallel import wrapper as wrapper_mod
 
 
-@partial(jax.jit, static_argnums=(3, 4))
-def _batch_counts(out, y, lmask, num_classes, top_n):
+@partial(jax.jit, static_argnums=(3, 4, 5))
+def _batch_counts(out, y, lmask, num_classes, top_n, sparse=False):
     """Confusion counts + top-N correct + total for one batch, on device.
 
-    out/y: [b, c] or [b, t, c]; lmask: [b]/[b, t] weights or None.
+    out: [b, c] or [b, t, c]; y one-hot like out, or — with `sparse` —
+    integer class ids [b]/[b, t]; lmask: [b]/[b, t] weights or None.
     Matches `Evaluation.eval` semantics: masked rows dropped, argmax
     decisions, top-N by the N largest predictions."""
     C = num_classes
-    if y.ndim == 3:
-        w = (jnp.ones(y.shape[:2]) if lmask is None else lmask).reshape(-1)
-        y = y.reshape(-1, C)
+    if out.ndim == 3:
+        t_shape = out.shape[:2]
+        w = (jnp.ones(t_shape) if lmask is None else lmask).reshape(-1)
+        y = y.reshape(-1) if sparse else y.reshape(-1, C)
         out = out.reshape(-1, C)
     else:
-        w = jnp.ones(y.shape[0]) if lmask is None else lmask.reshape(-1)
+        w = jnp.ones(out.shape[0]) if lmask is None else lmask.reshape(-1)
+        if sparse:
+            y = y.reshape(-1)
     # Host-path semantics (`Evaluation.eval`): any mask > 0 counts the row
     # fully — masks are keep/drop flags here, not fractional weights.
     w = (w > 0).astype(jnp.float64 if jax.config.jax_enable_x64
                        else jnp.float32)
-    actual = jnp.argmax(y, axis=-1)
+    actual = y.astype(jnp.int32) if sparse else jnp.argmax(y, axis=-1)
     pred = jnp.argmax(out, axis=-1)
     conf = jax.ops.segment_sum(w, actual * C + pred,
                                num_segments=C * C).reshape(C, C)
@@ -109,8 +113,11 @@ def sharded_evaluate(net, iterator, mesh=None, top_n: int = 1,
         if padded != b:
             # Padded rows are excluded via a zeroed labels mask.
             if lmask is None:
-                lmask = np.ones((b,) + np.shape(labels)[1:-1][:1], "float32") \
-                    if np.ndim(labels) == 3 else np.ones((b,), "float32")
+                has_time = np.ndim(labels) == 3 or (
+                    np.ndim(labels) == 2
+                    and np.issubdtype(np.asarray(labels).dtype, np.integer))
+                lmask = np.ones(np.shape(labels)[:2], "float32") \
+                    if has_time else np.ones((b,), "float32")
             feats, labels = _pad_to(feats, padded), _pad_to(labels, padded)
             fmask, lmask = _pad_to(fmask, padded), _pad_to(lmask, padded)
         sh = lambda a: None if a is None else jax.device_put(
@@ -123,7 +130,10 @@ def sharded_evaluate(net, iterator, mesh=None, top_n: int = 1,
             out = outs[0]
         else:
             out, _ = out_fn(net.params_tree, net.state, x, fm, None)
-        C = num_classes or ev.num_classes or int(y.shape[-1])
-        conf, tn_c, total = _batch_counts(out, y, lm, C, top_n)
+        sparse = (jnp.issubdtype(y.dtype, jnp.integer)
+                  and y.ndim == out.ndim - 1)
+        C = num_classes or ev.num_classes or int(
+            out.shape[-1] if sparse else y.shape[-1])
+        conf, tn_c, total = _batch_counts(out, y, lm, C, top_n, sparse)
         ev.add_counts(np.asarray(conf), float(tn_c), float(total))
     return ev

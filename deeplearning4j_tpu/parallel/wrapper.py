@@ -76,7 +76,8 @@ class ParallelWrapper:
         labels = None if ds.labels is None else np.asarray(ds.labels)
         lmask = ds.labels_mask
         if labels is not None:
-            lmask = _full_labels_mask(labels, lmask)
+            lmask = _full_labels_mask(labels, lmask,
+                                      sequence=self._seq_output())
         return DataSet(
             _pad_rows(np.asarray(ds.features), pad),
             _pad_rows(labels, pad),
@@ -93,7 +94,9 @@ class ParallelWrapper:
         pad = self.n_devices - rem
         labels = [np.asarray(l) for l in mds.labels]
         lmasks = list(mds.labels_masks) if mds.labels_masks is not None else [None] * len(labels)
-        lmasks = [_full_labels_mask(l, m) for l, m in zip(labels, lmasks)]
+        seq = self._seq_output()
+        lmasks = [_full_labels_mask(l, m, sequence=seq)
+                  for l, m in zip(labels, lmasks)]
         fmasks = mds.features_masks
         return MultiDataSet(
             features=[_pad_rows(np.asarray(f), pad) for f in mds.features],
@@ -102,6 +105,17 @@ class ParallelWrapper:
             else [_pad_rows(m, pad, fill_last=False) for m in fmasks],
             labels_masks=[_pad_rows(m, pad, fill_last=False) for m in lmasks],
         )
+
+    def _seq_output(self) -> bool:
+        """Whether the net's output layer(s) emit per-timestep labels —
+        disambiguates 2-D INTEGER labels ([b, t] sparse ids vs [b, c]
+        integer one-hot) when padding."""
+        layers = getattr(self.net, "layers", None)
+        if layers is not None:
+            return type(layers[-1]).__name__ == "RnnOutputLayer"
+        lv = getattr(self.net, "layer_vertices", {})
+        return any(type(v.layer).__name__ == "RnnOutputLayer"
+                   for v in lv.values())
 
     def _shard(self, a):
         if a is None:
@@ -174,12 +188,17 @@ def _pad_rows(a, pad: int, fill_last: bool = True):
     return np.concatenate([a, tail], axis=0)
 
 
-def _full_labels_mask(labels: np.ndarray, lmask):
+def _full_labels_mask(labels: np.ndarray, lmask, sequence: bool = False):
     """An explicit all-ones labels mask matching the labels' batch/time shape
-    (so the pad can zero the appended rows)."""
+    (so the pad can zero the appended rows). `sequence` disambiguates 2-D
+    integer labels: per-timestep [b, t] ids need a [b, t] mask, while
+    integer-dtype one-hot [b, c] needs the per-example [b] mask — the
+    label array alone can't tell them apart, so the caller decides from
+    the net's output-layer type."""
     if lmask is not None:
         return np.asarray(lmask)
-    if labels.ndim == 2 and np.issubdtype(labels.dtype, np.integer):
+    if (labels.ndim == 2 and sequence
+            and np.issubdtype(labels.dtype, np.integer)):
         shape = labels.shape  # sparse [b, t] class ids: per-timestep mask
     else:
         shape = (labels.shape[0],) if labels.ndim == 2 else labels.shape[:2]

@@ -522,3 +522,104 @@ class TestSparseLabels:
         for _ in range(20):
             cg.fit(mds)
         assert cg.score(mds) < s0
+
+
+class TestSparseLabelsReviewFixes:
+    def test_eval_sparse_no_giant_expansion_and_range_check(self, rng):
+        """Sparse eval uses ids directly (no np.eye(V)); out-of-range ids
+        fail loudly."""
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+
+        V = 50000
+        ids = rng.randint(0, V, (4, 6))
+        preds = rng.rand(4, 6, V).astype("float32")
+        ev = Evaluation()
+        ev.eval(ids, preds)  # must complete without a [V, V] eye
+        assert ev.total == 24
+        with pytest.raises(ValueError, match="class ids"):
+            Evaluation().eval(np.asarray([V + 1]), rng.rand(1, V))
+
+    def test_sharded_evaluate_sparse_matches_host(self, rng):
+        from deeplearning4j_tpu.parallel import mesh as mesh_mod
+        from deeplearning4j_tpu.parallel.evaluation import sharded_evaluate
+
+        net = MultiLayerNetwork(mlp_conf()).init()
+        X, Y = make_classification_data(rng)
+        ids = Y.argmax(-1).astype(np.int32)
+        host = net.evaluate(DataSet(X, ids))
+        sharded = sharded_evaluate(net, DataSet(X, ids),
+                                   mesh=mesh_mod.create_mesh((4,)))
+        assert sharded.accuracy() == host.accuracy()
+        np.testing.assert_array_equal(sharded.confusion.matrix,
+                                      host.confusion.matrix)
+
+    def test_graph_tbptt_sparse_equals_onehot(self, rng):
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+        from deeplearning4j_tpu.nn.conf.layers import (
+            GravesLSTM, RnnOutputLayer,
+        )
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        def make():
+            gb = (NeuralNetConfiguration.builder()
+                  .seed(2).learning_rate(0.1).updater("sgd")
+                  .graph_builder()
+                  .add_inputs("in")
+                  .add_layer("l", GravesLSTM(n_out=8, activation="tanh"),
+                             "in")
+                  .add_layer("out", RnnOutputLayer(
+                      n_out=4, activation="softmax",
+                      loss_function="mcxent"), "l")
+                  .set_outputs("out"))
+            gb.set_input_types(InputType.recurrent(5, 12))
+            gb.backprop_type("truncatedbptt")
+            gb.t_bptt_forward_length(4).t_bptt_backward_length(4)
+            return ComputationGraph(gb.build()).init()
+
+        X = rng.randn(3, 12, 5).astype("float32")
+        ids = rng.randint(0, 4, (3, 12)).astype(np.int32)
+        Y = np.eye(4, dtype="float32")[ids]
+        g1, g2 = make(), make()
+        g1.fit(MultiDataSet(features=[X], labels=[Y]))
+        g2.fit(MultiDataSet(features=[X], labels=[ids]))
+        for lk in g1.params_tree:
+            for pk in g1.params_tree[lk]:
+                np.testing.assert_allclose(
+                    np.asarray(g1.params_tree[lk][pk]),
+                    np.asarray(g2.params_tree[lk][pk]), rtol=1e-5)
+
+    def test_ragged_batch_integer_onehot_still_works(self, rng):
+        """Integer-dtype ONE-HOT labels through ParallelWrapper's padding
+        (the ambiguity case): per-example mask, correct loss."""
+        from deeplearning4j_tpu.parallel import mesh as mesh_mod
+        from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+        net = MultiLayerNetwork(mlp_conf(updater="sgd", lr=0.1)).init()
+        X, Y = make_classification_data(rng, n=13)  # ragged vs 4 devices
+        Y_int = Y.astype(np.int32)
+        pw = ParallelWrapper(net, mesh=mesh_mod.create_mesh((4,)))
+        pw.fit(DataSet(X, Y_int))
+        assert np.isfinite(net.score_value)
+
+    def test_ragged_batch_sparse_sequence_ids(self, rng):
+        """Sparse [b, t] ids through the wrapper's padding on an RNN net."""
+        from deeplearning4j_tpu.nn.conf.layers import (
+            GravesLSTM, RnnOutputLayer,
+        )
+        from deeplearning4j_tpu.parallel import mesh as mesh_mod
+        from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+        conf = (NeuralNetConfiguration.builder()
+                .seed(2).learning_rate(0.1).updater("sgd")
+                .list()
+                .layer(GravesLSTM(n_out=8, activation="tanh"))
+                .layer(RnnOutputLayer(n_out=4, activation="softmax",
+                                      loss_function="mcxent"))
+                .set_input_type(InputType.recurrent(5, 6))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        X = rng.randn(5, 6, 5).astype("float32")  # ragged vs 4 devices
+        ids = rng.randint(0, 4, (5, 6)).astype(np.int32)
+        pw = ParallelWrapper(net, mesh=mesh_mod.create_mesh((4,)))
+        pw.fit(DataSet(X, ids))
+        assert np.isfinite(net.score_value)
