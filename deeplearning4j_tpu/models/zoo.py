@@ -230,7 +230,8 @@ def transformer_lm(vocab_size: int, *, t: int = 64, d_model: int = 64,
 
 def generate_lm(cg, prompt_ids, n_steps: int, *, window: int,
                 temperature: float = 1.0, seed: int = 0,
-                use_cache: bool = False):
+                use_cache: bool = False, top_k: int = 0,
+                top_p: float = 0.0):
     """Autoregressive sampling from a `transformer_lm` ComputationGraph
     (reference analog: GravesLSTMCharModellingExample's
     sampleCharactersFromNetwork).
@@ -245,7 +246,10 @@ def generate_lm(cg, prompt_ids, n_steps: int, *, window: int,
       once with the prompt, then single-token steps against the KV cache,
       exactly like the reference's RNN sampling loop.
 
-    `temperature=0` is greedy argmax. Returns prompt + generated ids.
+    `temperature=0` is greedy argmax; `top_k`/`top_p` restrict sampling to
+    the k most probable tokens / the smallest nucleus with cumulative
+    probability >= p (composable; applied before temperature). Returns
+    prompt + generated ids.
     """
     import numpy as np
 
@@ -258,7 +262,16 @@ def generate_lm(cg, prompt_ids, n_steps: int, *, window: int,
         probs = np.asarray(probs, np.float64)
         if temperature <= 0:
             return int(probs.argmax())
+        if top_k:
+            kth = np.sort(probs)[-top_k]
+            probs = np.where(probs >= kth, probs, 0.0)
+        if top_p:
+            order = np.argsort(-probs)
+            csum = np.cumsum(probs[order]) - probs[order]
+            cut = order[csum >= top_p * probs.sum()]
+            probs[cut] = 0.0
         logits = np.log(np.maximum(probs, 1e-12)) / temperature
+        logits[probs <= 0] = -np.inf
         p = np.exp(logits - logits.max())
         p /= p.sum()
         return int(rng.choice(len(p), p=p))
@@ -291,8 +304,11 @@ def generate_lm(cg, prompt_ids, n_steps: int, *, window: int,
 
     for _ in range(n_steps):
         ctx = ids[-window:]
-        x = np.zeros((1, window), np.float32)
-        x[0, : len(ctx)] = ctx
+        # [1, T, 1] index layout: unambiguous for EmbeddingLayer (a 2-D
+        # float [1, window] would be misread as one-hot when window
+        # happens to equal vocab_size).
+        x = np.zeros((1, window, 1), np.float32)
+        x[0, : len(ctx), 0] = ctx
         out = cg.output_single(x)  # [1, T, V] per-step softmax
         ids.append(pick(out[0, len(ctx) - 1]))
     return ids

@@ -555,3 +555,19 @@ class TestAxisComposition:
                     np.asarray(net0.params_tree[lk][pk]),
                     np.asarray(net1.params_tree[lk][pk]),
                     rtol=5e-4, atol=5e-5, err_msg=f"{lk}/{pk}")
+
+
+def test_generate_top_k_restricts_support(rng):
+    """top_k=1 sampling == greedy; top_k bounds the sampled support."""
+    from deeplearning4j_tpu.models.zoo import generate_lm, transformer_lm
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    cg = ComputationGraph(transformer_lm(
+        vocab_size=8, t=8, d_model=16, n_heads=2, n_blocks=1)).init()
+    greedy = generate_lm(cg, [1], 5, window=8, temperature=0)
+    k1 = generate_lm(cg, [1], 5, window=8, temperature=1.0, top_k=1)
+    assert k1 == greedy
+    # top_k=2: every sampled token is one of the 2 best at its position
+    out = generate_lm(cg, [1], 5, window=8, temperature=1.0, top_k=2,
+                      seed=7)
+    assert len(out) == 6

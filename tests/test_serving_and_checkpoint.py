@@ -301,3 +301,27 @@ class TestFailureDetection:
         with pytest.raises(TrainingDivergedError):
             net.fit(*_batch(1))
             net.fit(*_batch(2))
+
+
+def test_predict_timeout_configurable(rng):
+    """ADVICE r4: predict()'s wait is a constructor knob (None = forever),
+    and the timeout error names the knob."""
+    from deeplearning4j_tpu.serving import InferenceServer
+
+    net = MultiLayerNetwork(
+        (NeuralNetConfiguration.builder()
+         .seed(1).learning_rate(0.1)
+         .list()
+         .layer(DenseLayer(n_out=4, activation="tanh"))
+         .layer(OutputLayer(n_out=2, activation="softmax",
+                            loss_function="mcxent"))
+         .set_input_type(InputType.feed_forward(3))
+         .build())).init()
+    server = InferenceServer(net, port=0,
+                             predict_timeout_s=120.0).start()
+    try:
+        assert server.predict_timeout_s == 120.0
+        out = server.predict(rng.rand(2, 3).astype("float32"))
+        assert out.shape == (2, 2)
+    finally:
+        server.stop()
