@@ -571,3 +571,59 @@ def test_generate_top_k_restricts_support(rng):
     out = generate_lm(cg, [1], 5, window=8, temperature=1.0, top_k=2,
                       seed=7)
     assert len(out) == 6
+
+
+def test_transformer_checkpoint_roundtrip(rng, tmp_path):
+    """save_model/load_model over a transformer graph (SelfAttention + MoE
+    + LayerNorm + positional layers): the zip format that failure-recovery
+    rollback depends on must cover the round-5 layer types."""
+    from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+    from deeplearning4j_tpu.models.zoo import transformer_lm
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.util.model_serializer import (
+        load_model, save_model,
+    )
+
+    v, t = 8, 12
+    cg = ComputationGraph(transformer_lm(
+        vocab_size=v, t=t, d_model=16, n_heads=2, n_blocks=1,
+        moe=True, decode_cache_length=t)).init()
+    idx = rng.randint(0, v, (4, t))
+    mds = MultiDataSet(features=[idx.astype("float32")],
+                       labels=[np.roll(idx, -1, axis=1).astype(np.int32)])
+    for _ in range(3):
+        cg.fit(mds)
+
+    path = str(tmp_path / "tf.zip")
+    save_model(cg, path)
+    back = load_model(path)
+    x = idx.astype("float32")
+    np.testing.assert_allclose(back.output_single(x), cg.output_single(x),
+                               rtol=1e-5, atol=1e-6)
+    # The restored model keeps training and decoding.
+    back.fit(mds)
+    assert np.isfinite(back.score_value)
+    from deeplearning4j_tpu.models.zoo import generate_lm
+    out = generate_lm(back, [1], 3, window=t, temperature=0, use_cache=True)
+    assert len(out) == 4
+
+
+def test_lbfgs_solver_over_attention(rng):
+    """The full-batch solver path (LBFGS as one jitted loop) composes with
+    the attention layer."""
+    conf = (NeuralNetConfiguration.builder()
+            .seed(4).learning_rate(0.1)
+            .optimization_algo("lbfgs").iterations(5)
+            .list()
+            .layer(SelfAttentionLayer(n_out=8, n_heads=2, causal=True,
+                                      attention_impl="dense"))
+            .layer(RnnOutputLayer(n_out=3, activation="softmax",
+                                  loss_function="mcxent"))
+            .set_input_type(InputType.recurrent(4, 6))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    X = rng.randn(6, 6, 4).astype("float32")
+    Y = np.eye(3)[rng.randint(0, 3, (6, 6))].astype("float32")
+    s0 = net.score(DataSet(X, Y))
+    net.fit(DataSet(X, Y))
+    assert net.score(DataSet(X, Y)) < s0
