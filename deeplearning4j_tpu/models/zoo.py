@@ -312,3 +312,57 @@ def generate_lm(cg, prompt_ids, n_steps: int, *, window: int,
         out = cg.output_single(x)  # [1, T, V] per-step softmax
         ids.append(pick(out[0, len(ctx) - 1]))
     return ids
+
+
+def transformer_classifier(vocab_size: int, n_classes: int, *, t: int = 64,
+                           d_model: int = 64, n_heads: int = 4,
+                           n_blocks: int = 2, seed: int = 123,
+                           lr: float = 3e-3, dtype: str = "float32"):
+    """Bidirectional transformer encoder + mean-pool + softmax head — the
+    sequence-classification sibling of `transformer_lm` (BERT-shaped:
+    non-causal attention over the whole sequence). Feature masks flow
+    through attention (key masking) and the mask-aware global pooling, so
+    ragged sequences classify correctly.
+    """
+    from deeplearning4j_tpu.nn.conf.graph import ElementWiseVertex
+    from deeplearning4j_tpu.nn.conf.layers import (
+        EmbeddingLayer,
+        GlobalPoolingLayer,
+        LayerNormalization,
+        PositionalEmbeddingLayer,
+        SelfAttentionLayer,
+    )
+
+    gb = (NeuralNetConfiguration.builder()
+          .seed(seed).learning_rate(lr).updater(Updater.ADAM).dtype(dtype)
+          .weight_init("xavier")
+          .graph_builder()
+          .add_inputs("tokens")
+          .add_layer("emb", EmbeddingLayer(n_out=d_model, has_bias=False,
+                                           activation="identity"), "tokens")
+          .add_layer("pos", PositionalEmbeddingLayer(max_length=max(t, 16)),
+                     "emb"))
+    prev = "pos"
+    for i in range(n_blocks):
+        gb.add_layer(f"ln_a{i}", LayerNormalization(), prev)
+        gb.add_layer(f"attn{i}",
+                     SelfAttentionLayer(n_out=d_model, n_heads=n_heads,
+                                        causal=False), f"ln_a{i}")
+        gb.add_vertex(f"res_a{i}", ElementWiseVertex(op="add"),
+                      prev, f"attn{i}")
+        gb.add_layer(f"ln_f{i}", LayerNormalization(), f"res_a{i}")
+        gb.add_layer(f"ff1_{i}", DenseLayer(n_out=4 * d_model,
+                                            activation="relu"), f"ln_f{i}")
+        gb.add_layer(f"ffn{i}", DenseLayer(n_out=d_model,
+                                           activation="identity"),
+                     f"ff1_{i}")
+        gb.add_vertex(f"res_f{i}", ElementWiseVertex(op="add"),
+                      f"res_a{i}", f"ffn{i}")
+        prev = f"res_f{i}"
+    gb.add_layer("ln_out", LayerNormalization(), prev)
+    gb.add_layer("pool", GlobalPoolingLayer(pooling_type="avg"), "ln_out")
+    gb.add_layer("out", OutputLayer(n_out=n_classes, activation="softmax",
+                                    loss_function="mcxent"), "pool")
+    gb.set_outputs("out")
+    gb.set_input_types(InputType.recurrent(vocab_size, t))
+    return gb.build()

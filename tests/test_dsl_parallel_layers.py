@@ -627,3 +627,38 @@ def test_lbfgs_solver_over_attention(rng):
     s0 = net.score(DataSet(X, Y))
     net.fit(DataSet(X, Y))
     assert net.score(DataSet(X, Y)) < s0
+
+
+def test_transformer_classifier_learns_with_masks(rng):
+    """zoo.transformer_classifier: bidirectional encoder + mean pool
+    classifies ragged token sequences (class = which token dominates),
+    with feature masks excluding the padding."""
+    from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+    from deeplearning4j_tpu.models.zoo import transformer_classifier
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    v, t, c = 12, 16, 3
+    cg = ComputationGraph(transformer_classifier(
+        vocab_size=v, n_classes=c, t=t, d_model=32, n_heads=4,
+        n_blocks=1, lr=5e-3)).init()
+    n = 48
+    cls = rng.randint(0, c, n)
+    lens = rng.randint(6, t + 1, n)
+    idx = rng.randint(0, v, (n, t))
+    mask = np.zeros((n, t), np.float32)
+    for i in range(n):
+        mask[i, :lens[i]] = 1.0
+        # make ~60% of the VALID tokens the class-identifying token
+        sel = rng.rand(lens[i]) < 0.6
+        idx[i, :lens[i]][sel] = cls[i]
+        idx[i, lens[i]:] = 0  # padding garbage the mask must hide
+    mds = MultiDataSet(features=[idx.astype("float32")],
+                       labels=[cls.astype(np.int32)],
+                       features_masks=[mask])
+    s0 = cg.score(mds)
+    for _ in range(60):
+        cg.fit(mds)
+    assert cg.score(mds) < 0.5 * s0
+    out = cg.output_single(idx.astype("float32"), features_masks=[mask])
+    acc = (out.argmax(-1) == cls).mean()
+    assert acc > 0.85, acc
