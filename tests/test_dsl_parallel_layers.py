@@ -662,3 +662,33 @@ def test_transformer_classifier_learns_with_masks(rng):
     out = cg.output_single(idx.astype("float32"), features_masks=[mask])
     acc = (out.argmax(-1) == cls).mean()
     assert acc > 0.85, acc
+
+
+def test_early_stopping_with_transformer_graph(rng, tmp_path):
+    """EarlyStoppingTrainer drives a ComputationGraph transformer: score
+    calculators and savers are engine-agnostic (fit/score surface)."""
+    from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+    from deeplearning4j_tpu.earlystopping import (
+        EarlyStoppingConfiguration, EarlyStoppingTrainer,
+        LocalFileModelSaver, MaxEpochsTerminationCondition,
+    )
+    from deeplearning4j_tpu.earlystopping.scorecalc import (
+        DataSetLossCalculator,
+    )
+    from deeplearning4j_tpu.models.zoo import transformer_lm
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    v, t = 8, 10
+    cg = ComputationGraph(transformer_lm(
+        vocab_size=v, t=t, d_model=16, n_heads=2, n_blocks=1)).init()
+    idx = rng.randint(0, v, (8, t))
+    mds = MultiDataSet(features=[idx.astype("float32")],
+                       labels=[np.roll(idx, -1, axis=1).astype(np.int32)])
+    conf = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(4)],
+        score_calculator=DataSetLossCalculator([mds]),
+        model_saver=LocalFileModelSaver(str(tmp_path)),
+    )
+    result = EarlyStoppingTrainer(conf, cg, [mds]).fit()
+    assert result.total_epochs >= 1
+    assert np.isfinite(result.best_model_score)
