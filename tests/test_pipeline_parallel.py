@@ -149,3 +149,46 @@ class TestPipelineApply:
                 stack_stage_params(stages))
         assert all(np.all(np.isfinite(np.asarray(v)))
                    for v in jax.tree.leaves(g))
+
+
+def test_shard_inputs_matches_replicated(rng):
+    """shard_inputs=True (microbatch stack sharded over the pipe axis,
+    owner-psum feed) computes the identical pipeline output and
+    gradients as the replicated-input default."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.parallel import mesh as mesh_mod
+    from deeplearning4j_tpu.parallel.pipeline import (
+        pipeline_apply, stack_stage_params,
+    )
+
+    S, M, f = 4, 8, 6
+    mesh = mesh_mod.create_mesh((2, S), axis_names=("data", "pipe"))
+    stages = stack_stage_params([
+        {"w": jnp.asarray(rng.rand(f, f).astype("float32") * 0.3),
+         "b": jnp.zeros((f,), "float32")} for _ in range(S)])
+    x = jnp.asarray(rng.rand(16, f).astype("float32"))
+
+    def stage_fn(p, h):
+        return jnp.tanh(h @ p["w"] + p["b"])
+
+    def loss(p, x, shard):
+        out = pipeline_apply(stage_fn, p, x, mesh, n_microbatches=M,
+                             shard_inputs=shard)
+        return jnp.mean(out ** 2), out
+
+    (l0, o0), g0 = jax.value_and_grad(
+        lambda p, x: loss(p, x, False), has_aux=True)(stages, x)
+    (l1, o1), g1 = jax.value_and_grad(
+        lambda p, x: loss(p, x, True), has_aux=True)(stages, x)
+    np.testing.assert_allclose(np.asarray(o0), np.asarray(o1),
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+    with pytest.raises(ValueError, match="divisible"):
+        pipeline_apply(stage_fn, stages, x, mesh, n_microbatches=6,
+                       shard_inputs=True)
