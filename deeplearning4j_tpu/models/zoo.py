@@ -154,6 +154,41 @@ def alexnet(n_classes: int = 1000, seed: int = 123, image: int = 224,
     )
 
 
+
+def _add_transformer_block(gb, prev, i, d_model, n_heads, *, causal,
+                           moe=False, n_experts=4,
+                           decode_cache_length=None):
+    """One pre-LN transformer block: x + Attn(LN(x)); x + FFN(LN(x)).
+    Shared by `transformer_lm` (causal, optional MoE/KV cache) and
+    `transformer_classifier` (bidirectional)."""
+    from deeplearning4j_tpu.nn.conf.graph import ElementWiseVertex
+    from deeplearning4j_tpu.nn.conf.layers import (
+        LayerNormalization, MoELayer, SelfAttentionLayer,
+    )
+
+    gb.add_layer(f"ln_a{i}", LayerNormalization(), prev)
+    gb.add_layer(f"attn{i}",
+                 SelfAttentionLayer(
+                     n_out=d_model, n_heads=n_heads, causal=causal,
+                     decode_cache_length=decode_cache_length), f"ln_a{i}")
+    gb.add_vertex(f"res_a{i}", ElementWiseVertex(op="add"), prev, f"attn{i}")
+    gb.add_layer(f"ln_f{i}", LayerNormalization(), f"res_a{i}")
+    if moe:
+        gb.add_layer(f"ffn{i}",
+                     MoELayer(n_out=d_model, n_experts=n_experts,
+                              expert_hidden=4 * d_model, top_k=2,
+                              router_jitter=1e-2), f"ln_f{i}")
+    else:
+        gb.add_layer(f"ff1_{i}", DenseLayer(n_out=4 * d_model,
+                                            activation="relu"), f"ln_f{i}")
+        gb.add_layer(f"ffn{i}", DenseLayer(n_out=d_model,
+                                           activation="identity"),
+                     f"ff1_{i}")
+    gb.add_vertex(f"res_f{i}", ElementWiseVertex(op="add"),
+                  f"res_a{i}", f"ffn{i}")
+    return f"res_f{i}"
+
+
 def transformer_lm(vocab_size: int, *, t: int = 64, d_model: int = 64,
                    n_heads: int = 4, n_blocks: int = 2, moe: bool = False,
                    n_experts: int = 4, seed: int = 123, lr: float = 3e-3,
@@ -188,37 +223,16 @@ def transformer_lm(vocab_size: int, *, t: int = 64, d_model: int = 64,
           .graph_builder()
           .add_inputs("tokens")
           .add_layer("emb", EmbeddingLayer(n_out=d_model, has_bias=False,
+                                           input_format="ids",
                                            activation="identity"), "tokens")
           .add_layer("pos", PositionalEmbeddingLayer(
               max_length=max(t, 16, decode_cache_length or 0),
               stateful=decode_cache_length is not None), "emb"))
     prev = "pos"
     for i in range(n_blocks):
-        # Pre-LN block: x + Attn(LN(x)); x + FFN(LN(x)).
-        gb.add_layer(f"ln_a{i}", LayerNormalization(), prev)
-        gb.add_layer(f"attn{i}",
-                     SelfAttentionLayer(
-                         n_out=d_model, n_heads=n_heads, causal=True,
-                         decode_cache_length=decode_cache_length),
-                     f"ln_a{i}")
-        gb.add_vertex(f"res_a{i}", ElementWiseVertex(op="add"),
-                      prev, f"attn{i}")
-        gb.add_layer(f"ln_f{i}", LayerNormalization(), f"res_a{i}")
-        if moe:
-            gb.add_layer(f"ffn{i}",
-                         MoELayer(n_out=d_model, n_experts=n_experts,
-                                  expert_hidden=4 * d_model, top_k=2,
-                                  router_jitter=1e-2), f"ln_f{i}")
-        else:
-            gb.add_layer(f"ff1_{i}", DenseLayer(n_out=4 * d_model,
-                                                activation="relu"),
-                         f"ln_f{i}")
-            gb.add_layer(f"ffn{i}", DenseLayer(n_out=d_model,
-                                               activation="identity"),
-                         f"ff1_{i}")
-        gb.add_vertex(f"res_f{i}", ElementWiseVertex(op="add"),
-                      f"res_a{i}", f"ffn{i}")
-        prev = f"res_f{i}"
+        prev = _add_transformer_block(
+            gb, prev, i, d_model, n_heads, causal=True, moe=moe,
+            n_experts=n_experts, decode_cache_length=decode_cache_length)
     gb.add_layer("ln_out", LayerNormalization(), prev)
     gb.add_layer("out", RnnOutputLayer(n_out=vocab_size,
                                        activation="softmax",
@@ -263,7 +277,7 @@ def generate_lm(cg, prompt_ids, n_steps: int, *, window: int,
         if temperature <= 0:
             return int(probs.argmax())
         if top_k:
-            kth = np.sort(probs)[-top_k]
+            kth = np.sort(probs)[-min(top_k, len(probs))]
             probs = np.where(probs >= kth, probs, 0.0)
         if top_p:
             order = np.argsort(-probs)
@@ -339,26 +353,14 @@ def transformer_classifier(vocab_size: int, n_classes: int, *, t: int = 64,
           .graph_builder()
           .add_inputs("tokens")
           .add_layer("emb", EmbeddingLayer(n_out=d_model, has_bias=False,
+                                           input_format="ids",
                                            activation="identity"), "tokens")
           .add_layer("pos", PositionalEmbeddingLayer(max_length=max(t, 16)),
                      "emb"))
     prev = "pos"
     for i in range(n_blocks):
-        gb.add_layer(f"ln_a{i}", LayerNormalization(), prev)
-        gb.add_layer(f"attn{i}",
-                     SelfAttentionLayer(n_out=d_model, n_heads=n_heads,
-                                        causal=False), f"ln_a{i}")
-        gb.add_vertex(f"res_a{i}", ElementWiseVertex(op="add"),
-                      prev, f"attn{i}")
-        gb.add_layer(f"ln_f{i}", LayerNormalization(), f"res_a{i}")
-        gb.add_layer(f"ff1_{i}", DenseLayer(n_out=4 * d_model,
-                                            activation="relu"), f"ln_f{i}")
-        gb.add_layer(f"ffn{i}", DenseLayer(n_out=d_model,
-                                           activation="identity"),
-                     f"ff1_{i}")
-        gb.add_vertex(f"res_f{i}", ElementWiseVertex(op="add"),
-                      f"res_a{i}", f"ffn{i}")
-        prev = f"res_f{i}"
+        prev = _add_transformer_block(gb, prev, i, d_model, n_heads,
+                                      causal=False)
     gb.add_layer("ln_out", LayerNormalization(), prev)
     gb.add_layer("pool", GlobalPoolingLayer(pooling_type="avg"), "ln_out")
     gb.add_layer("out", OutputLayer(n_out=n_classes, activation="softmax",

@@ -289,9 +289,15 @@ class EmbeddingLayer(FeedForwardLayer):
 
     Input: integer indices `[batch]` or one-hot `[batch, n_in]`. TPU-native
     implementation is a gather (`take`), not a onehot-matmul.
+
+    `input_format` pins the interpretation: "auto" (float with last dim
+    == n_in reads as one-hot, everything else as indices — ambiguous when
+    the sequence length equals n_in), "ids" (always indices), "onehot"
+    (always one-hot). The transformer zoo builders pin "ids".
     """
 
     has_bias: bool = True
+    input_format: str = "auto"  # "auto" | "ids" | "onehot"
 
     def param_shapes(self):
         shapes = {"W": (self.n_in, self.n_out)}

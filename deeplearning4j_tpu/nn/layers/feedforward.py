@@ -45,7 +45,11 @@ def embedding_apply(conf, params, state, x, *, rng=None, train=False, mask=None)
     TPU-native: a gather instead of the reference's onehot-matmul. Accepts
     integer indices [b], [b,1], [b,t] or one-hot [..., n_in].
     """
-    if jnp.issubdtype(x.dtype, jnp.floating) and x.shape[-1] == conf.n_in:
+    fmt = getattr(conf, "input_format", "auto")
+    onehot = (fmt == "onehot" if fmt != "auto"
+              else jnp.issubdtype(x.dtype, jnp.floating)
+              and x.shape[-1] == conf.n_in)
+    if onehot:
         idx = jnp.argmax(x, axis=-1)
     else:
         idx = x.astype(jnp.int32)

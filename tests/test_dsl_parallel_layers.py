@@ -692,3 +692,25 @@ def test_early_stopping_with_transformer_graph(rng, tmp_path):
     result = EarlyStoppingTrainer(conf, cg, [mds]).fit()
     assert result.total_epochs >= 1
     assert np.isfinite(result.best_model_score)
+
+
+def test_classifier_t_equals_vocab_unambiguous(rng):
+    """input_format='ids' pins the embedding interpretation: a [n, t] float
+    id matrix with t == vocab_size must NOT be misread as one-hot."""
+    from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+    from deeplearning4j_tpu.models.zoo import transformer_classifier
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    v = t = 12  # the ambiguous shape
+    cg = ComputationGraph(transformer_classifier(
+        vocab_size=v, n_classes=2, t=t, d_model=16, n_heads=2,
+        n_blocks=1)).init()
+    idx = rng.randint(0, v, (4, t)).astype("float32")
+    out = cg.output_single(idx)
+    assert out.shape == (4, 2)
+    # Changing a token must change the logits (one-hot misread would
+    # collapse each row to argmax-over-time, often ignoring this edit).
+    idx2 = idx.copy()
+    idx2[0, 3] = (idx2[0, 3] + 1) % v
+    out2 = cg.output_single(idx2)
+    assert not np.allclose(out[0], out2[0])
