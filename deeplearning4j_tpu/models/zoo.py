@@ -368,3 +368,54 @@ def transformer_classifier(vocab_size: int, n_classes: int, *, t: int = 64,
     gb.set_outputs("out")
     gb.set_input_types(InputType.recurrent(vocab_size, t))
     return gb.build()
+
+
+def generate_lm_batch(cg, prompts, n_steps: int, *, temperature: float = 1.0,
+                      seed: int = 0, top_k: int = 0):
+    """KV-cached batched generation: `prompts` is [B, Tp] (equal-length
+    int prompts); every sequence decodes in the SAME single-token steps,
+    so the per-token cost is one dispatch for the whole batch — the
+    serving shape of the decode path. Returns [B, Tp + n_steps] ids.
+
+    Requires a model built with `decode_cache_length >= Tp + n_steps`.
+    """
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    prompts = np.asarray(prompts, np.int64)
+    if prompts.ndim != 2 or prompts.shape[1] < 1:
+        raise ValueError("prompts must be [B, Tp] with Tp >= 1")
+    B, Tp = prompts.shape
+    cache_lens = [v.layer.decode_cache_length
+                  for v in cg.layer_vertices.values()
+                  if type(v.layer).__name__ == "SelfAttentionLayer"]
+    if not cache_lens or any(c is None for c in cache_lens):
+        raise ValueError("generate_lm_batch needs decode_cache_length")
+    if Tp + n_steps > min(cache_lens):
+        raise ValueError(
+            f"Tp ({Tp}) + n_steps ({n_steps}) exceeds the decode cache "
+            f"capacity {min(cache_lens)}")
+
+    def pick(probs):  # probs: [B, V] -> [B]
+        probs = np.asarray(probs, np.float64)
+        if temperature <= 0:
+            return probs.argmax(-1)
+        if top_k:
+            kth = np.sort(probs, axis=-1)[:, -min(top_k, probs.shape[-1])]
+            probs = np.where(probs >= kth[:, None], probs, 0.0)
+        logits = np.log(np.maximum(probs, 1e-12)) / temperature
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        return np.asarray([rng.choice(p.shape[-1], p=p[i])
+                           for i in range(p.shape[0])])
+
+    out = [prompts]
+    cg.rnn_clear_previous_state()
+    step_out = cg.rnn_time_step(
+        prompts.astype(np.float32)[:, :, None])[0]  # [B, Tp, V]
+    for _ in range(n_steps):
+        nxt = pick(step_out[:, -1])
+        out.append(nxt[:, None])
+        step_out = cg.rnn_time_step(
+            nxt.astype(np.float32)[:, None, None])[0]  # [B, 1, V]
+    return np.concatenate(out, axis=1)

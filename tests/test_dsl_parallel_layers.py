@@ -714,3 +714,34 @@ def test_classifier_t_equals_vocab_unambiguous(rng):
     idx2[0, 3] = (idx2[0, 3] + 1) % v
     out2 = cg.output_single(idx2)
     assert not np.allclose(out[0], out2[0])
+
+
+def test_generate_lm_batch_matches_per_prompt(rng):
+    """Batched KV-cached decode == per-prompt decode, row for row
+    (greedy; the whole batch shares each single-token dispatch)."""
+    from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+    from deeplearning4j_tpu.models.zoo import (
+        generate_lm, generate_lm_batch, transformer_lm,
+    )
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    v, t = 6, 16
+    cg = ComputationGraph(transformer_lm(
+        vocab_size=v, t=t, d_model=16, n_heads=2, n_blocks=1,
+        decode_cache_length=t)).init()
+    starts = rng.randint(0, v, 16)
+    idx = (starts[:, None] + np.arange(t)[None]) % v
+    mds = MultiDataSet(features=[idx.astype("float32")],
+                       labels=[np.roll(idx, -1, axis=1).astype(np.int32)])
+    for _ in range(120):
+        cg.fit(mds)
+
+    prompts = np.asarray([[1, 2], [4, 5], [0, 1]])
+    batch = generate_lm_batch(cg, prompts, 6, temperature=0)
+    assert batch.shape == (3, 8)
+    for i, p in enumerate(prompts):
+        single = generate_lm(cg, list(p), 6, window=t, temperature=0,
+                             use_cache=True)
+        assert batch[i].tolist() == single, f"row {i}"
+    with pytest.raises(ValueError, match="cache capacity"):
+        generate_lm_batch(cg, prompts, 30)
