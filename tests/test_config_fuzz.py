@@ -121,3 +121,62 @@ def test_random_config(i):
     assert np.isfinite(net.score_value), f"config {i} non-finite loss"
     out = net.output(X)
     assert np.isfinite(out).all(), f"config {i} non-finite output"
+
+
+@pytest.mark.parametrize("i", range(12))
+def test_random_graph_topology(i):
+    """Random DAGs: 1-2 inputs, branch + merge/elementwise vertices,
+    random layer types at the nodes — build, validate, train a step."""
+    from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+    from deeplearning4j_tpu.nn.conf.graph import (
+        ElementWiseVertex, MergeVertex,
+    )
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    rng = np.random.RandomState(2000 + i)
+    f, b, width = 5, 4, 8
+    n_inputs = int(rng.randint(1, 3))
+    inputs = [f"in{k}" for k in range(n_inputs)]
+    gb = (NeuralNetConfiguration.builder()
+          .seed(int(rng.randint(1 << 16))).learning_rate(0.05)
+          .updater(str(rng.choice(["sgd", "adam"])))
+          .graph_builder()
+          .add_inputs(*inputs))
+    # Every node: a dense layer on 1-2 existing nodes (merged or summed).
+    nodes = list(inputs)
+    widths = {n: f for n in inputs}
+    for j in range(rng.randint(2, 6)):
+        k = int(rng.randint(1, 3))
+        srcs = [nodes[int(rng.randint(len(nodes)))] for _ in range(k)]
+        if len(srcs) == 2:
+            if widths[srcs[0]] == widths[srcs[1]] and rng.randint(2):
+                vname = f"ew{j}"
+                gb.add_vertex(vname, ElementWiseVertex(op="add"), *srcs)
+                widths[vname] = widths[srcs[0]]
+            else:
+                vname = f"mg{j}"
+                gb.add_vertex(vname, MergeVertex(), *srcs)
+                widths[vname] = widths[srcs[0]] + widths[srcs[1]]
+            src = vname
+            nodes.append(vname)
+        else:
+            src = srcs[0]
+        lname = f"d{j}"
+        gb.add_layer(lname, DenseLayer(n_out=width,
+                                       activation=str(rng.choice(ACTS))),
+                     src)
+        widths[lname] = width
+        nodes.append(lname)
+    head_src = nodes[-1]
+    gb.add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                    loss_function="mcxent"), head_src)
+    gb.set_outputs("out")
+    gb.set_input_types(*[InputType.feed_forward(f)] * n_inputs)
+    cg = ComputationGraph(gb.build()).init()
+
+    X = [rng.randn(b, f).astype("float32") for _ in range(n_inputs)]
+    Y = np.eye(3)[rng.randint(0, 3, b)].astype("float32")
+    cg.fit(MultiDataSet(features=X, labels=[Y]))
+    assert np.isfinite(cg.score_value), f"graph {i} non-finite loss"
+    out = cg.output_single(*X)
+    assert np.isfinite(out).all(), f"graph {i} non-finite output"
