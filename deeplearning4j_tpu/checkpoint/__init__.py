@@ -1,0 +1,43 @@
+"""Sharded elastic checkpoint store (SURVEY §5: "orbax-style sharded
+checkpoint of a params pytree + opt state", "elastic checkpoint-resume").
+
+Three layers:
+
+- `array_store`: each device shard of every leaf is its own raw chunk file;
+  `index.json` maps chunks to global coordinates — save I/O parallelizes
+  per shard, nothing materializes the full array on one host;
+- `store`: atomic commit protocol (`step_N.tmp/` + fsync + COMMIT manifest
+  + rename) and elastic restore (assemble chunks straight into the TARGET
+  mesh's sharding, whatever shape saved them);
+- `manager`: `CheckpointManager` — step naming, keep-last-k / keep-every-m
+  retention, async off-thread saves, `latest()` that only ever sees
+  committed, validating steps.
+
+`legacy.load_any` opens either this format or the old `model_serializer`
+ZIPs; `legacy.migrate_zip` converts old checkpoints forward.
+"""
+
+from deeplearning4j_tpu.checkpoint.array_store import (
+    CheckpointCorruptError,
+    CheckpointError,
+)
+from deeplearning4j_tpu.checkpoint.legacy import load_any, migrate_zip
+from deeplearning4j_tpu.checkpoint.manager import CheckpointManager
+from deeplearning4j_tpu.checkpoint.store import (
+    is_sharded_checkpoint,
+    restore_checkpoint,
+    save_checkpoint,
+    verify_checkpoint,
+)
+
+__all__ = [
+    "CheckpointCorruptError",
+    "CheckpointError",
+    "CheckpointManager",
+    "is_sharded_checkpoint",
+    "load_any",
+    "migrate_zip",
+    "restore_checkpoint",
+    "save_checkpoint",
+    "verify_checkpoint",
+]

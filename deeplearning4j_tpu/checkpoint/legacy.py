@@ -1,0 +1,61 @@
+"""Compat between the sharded store and the legacy `model_serializer` ZIP
+format: one loader that opens either, plus a one-shot migrator.
+
+The legacy format (`util/model_serializer.py`) is a single ZIP holding the
+FULL flattened float64 param/updater buffers — fine on one host, a wall at
+scale. Everything new writes the sharded format; this module keeps every
+old checkpoint loadable and offers `migrate_zip` to convert in place-ish
+(the ZIP is left untouched; a committed sharded step appears next to it).
+"""
+
+from __future__ import annotations
+
+import os
+import zipfile
+from typing import Optional
+
+from deeplearning4j_tpu.checkpoint import store
+from deeplearning4j_tpu.checkpoint.array_store import CheckpointError
+
+
+def _latest_step_dir(root: str) -> Optional[str]:
+    from deeplearning4j_tpu.checkpoint.manager import CheckpointManager
+
+    return CheckpointManager(root).latest_path()
+
+
+def load_any(path, **restore_kwargs):
+    """Open a checkpoint at `path`, whatever it is: a committed sharded
+    step directory, a manager root full of steps (picks the latest
+    committed), or a legacy `model_serializer`/`util.checkpoint` ZIP.
+    Restore kwargs (`mesh`, `context`, ...) apply to the sharded path."""
+    path = str(path)
+    if os.path.isdir(path):
+        if store.is_sharded_checkpoint(path):
+            return store.restore_checkpoint(path, **restore_kwargs)
+        latest = _latest_step_dir(path)
+        if latest is not None:
+            return store.restore_checkpoint(latest, **restore_kwargs)
+        raise CheckpointError(
+            f"{path} is a directory but holds no committed sharded "
+            "checkpoint (no COMMIT manifest; half-written .tmp saves are "
+            "ignored)")
+    if zipfile.is_zipfile(path):
+        from deeplearning4j_tpu.util import checkpoint as zip_ckpt
+
+        return zip_ckpt.load_checkpoint(path)
+    raise CheckpointError(
+        f"{path} is neither a sharded checkpoint directory nor a model ZIP")
+
+
+def migrate_zip(zip_path: str, directory: str,
+                step: Optional[int] = None) -> str:
+    """Convert a legacy ZIP checkpoint into a committed sharded step under
+    `directory` (default step: the ZIP's iteration counter). Returns the
+    new step path; the ZIP is not modified."""
+    from deeplearning4j_tpu.checkpoint.manager import CheckpointManager
+    from deeplearning4j_tpu.util import checkpoint as zip_ckpt
+
+    net = zip_ckpt.load_checkpoint(zip_path)
+    mgr = CheckpointManager(directory, keep_last=0, async_save=False)
+    return mgr.save(net, step=step)

@@ -1,0 +1,147 @@
+"""CheckpointManager: step-named sharded checkpoints with retention and
+async off-thread saves.
+
+Directory layout under the manager root:
+
+    root/
+      step_00000005/   <- committed (has COMMIT)
+      step_00000010/
+      step_00000015.tmp/  <- half-written save (crash): never listed
+
+`latest()`/`all_steps()` only ever see COMMITTED steps whose manifest
+validates (`store.verify_checkpoint` — existence + byte sizes), so a
+truncated chunk, a missing COMMIT, or a half-written `.tmp` directory all
+degrade to "that step doesn't exist" and the manager falls back to the last
+good one; `restore()` of an explicitly named bad step raises the clean
+`CheckpointCorruptError` instead.
+
+Retention = keep-last-k AND keep-every-m: the newest `keep_last` steps
+always survive; with `keep_every=m > 0`, steps divisible by m are kept
+forever (the long-horizon audit trail). Saves snapshot on the caller's
+thread (donated buffers) and write on a single background worker, bounded
+to one in-flight snapshot — same discipline as `util/checkpoint.py`'s
+`CheckpointListener`.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import threading
+from typing import List, Optional
+
+from deeplearning4j_tpu.checkpoint import store
+from deeplearning4j_tpu.checkpoint.array_store import CheckpointError
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, keep_last: int = 3,
+                 keep_every: int = 0, async_save: bool = True,
+                 mesh=None, model_axis: Optional[str] = None, context=None):
+        self.directory = str(directory)
+        self.keep_last = int(keep_last)
+        self.keep_every = int(keep_every)
+        self.async_save = bool(async_save)
+        self.mesh = mesh
+        self.model_axis = model_axis
+        self.context = context
+        os.makedirs(self.directory, exist_ok=True)
+        self._inflight: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    # ----------------------------------------------------------- discovery
+
+    def step_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"step_{int(step):08d}")
+
+    def all_steps(self) -> List[int]:
+        """Committed, validating steps, ascending."""
+        steps = []
+        for name in os.listdir(self.directory):
+            m = _STEP_RE.match(name)
+            if not m:
+                continue
+            try:
+                store.verify_checkpoint(os.path.join(self.directory, name))
+            except CheckpointError:
+                continue
+            steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def latest(self) -> Optional[int]:
+        """Newest committed step (None if nothing committed yet). A newer
+        corrupt/uncommitted save never shadows an older good one."""
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def latest_path(self) -> Optional[str]:
+        step = self.latest()
+        return None if step is None else self.step_path(step)
+
+    # ---------------------------------------------------------------- save
+
+    def save(self, net, step: Optional[int] = None) -> str:
+        """Checkpoint `net` at `step` (default: its iteration counter).
+        The device->host snapshot happens here, synchronously; the chunk
+        writes + commit run on the background worker unless
+        `async_save=False`. Returns the (future) committed path."""
+        self.flush()  # bound to one in-flight snapshot; surface old errors
+        step = int(net.iteration if step is None else step)
+        snap = store.snapshot_net(net)
+        path = self.step_path(step)
+
+        def work():
+            try:
+                store.write_snapshot(snap, path)
+                self._apply_retention()
+            except BaseException as e:  # surfaced on next save()/flush()
+                self._error = e
+
+        if self.async_save:
+            self._inflight = threading.Thread(target=work, daemon=True)
+            self._inflight.start()
+        else:
+            store.write_snapshot(snap, path)
+            self._apply_retention()
+        return path
+
+    def flush(self) -> None:
+        """Wait for the in-flight save; re-raise any background failure."""
+        if self._inflight is not None:
+            self._inflight.join()
+            self._inflight = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+    def _apply_retention(self) -> None:
+        steps = self.all_steps()
+        if self.keep_last <= 0:
+            return
+        keep = set(steps[-self.keep_last:])
+        if self.keep_every > 0:
+            keep.update(s for s in steps if s % self.keep_every == 0)
+        for s in steps:
+            if s not in keep:
+                shutil.rmtree(self.step_path(s), ignore_errors=True)
+
+    # ------------------------------------------------------------- restore
+
+    def restore(self, step: Optional[int] = None, net=None,
+                load_updater: bool = True):
+        """Restore `step` (default: latest committed) onto the manager's
+        mesh/context — the ELASTIC path: the mesh here may be any shape,
+        not the one that saved."""
+        self.flush()
+        if step is None:
+            step = self.latest()
+            if step is None:
+                raise CheckpointError(
+                    f"no committed checkpoint under {self.directory}")
+        return store.restore_checkpoint(
+            self.step_path(step), net=net, mesh=self.mesh,
+            model_axis=self.model_axis, context=self.context,
+            load_updater=load_updater)

@@ -1,0 +1,362 @@
+"""Net-level sharded checkpoint: snapshot -> atomic directory commit ->
+elastic restore.
+
+On-disk layout of one committed checkpoint step:
+
+    step_00000042/
+      COMMIT        <- commit manifest: format/version/step + {file: size};
+                       its presence IS the commit — written last, after
+                       every other file is fsynced
+      meta.json     <- engine kind, full config JSON, iteration/epoch,
+                       train-RNG continuation
+      index.json    <- per-leaf global shape/dtype + shard->chunk mapping
+      chunks/*.bin  <- raw little-endian shard regions (array_store.py)
+
+Atomic commit protocol: everything is written into `step_N.tmp/` and
+fsynced, the COMMIT manifest is written (also into the tmp dir, also
+fsynced), then ONE `os.rename(step_N.tmp, step_N)` publishes the
+checkpoint. A crash at any point leaves either a committed `step_N/` or a
+`.tmp` directory that readers ignore — never a readable-looking torn
+checkpoint. The manifest records every file's byte size, so a chunk
+truncated AFTER commit (disk fault, partial copy) is also detected before
+any data is deserialized.
+
+Elastic restore: leaves are assembled from chunks per the index and placed
+directly into the sharding the TARGET mesh/`ParallelContext` wants
+(`jax.make_array_from_callback` — each device reads only its own region),
+so a checkpoint saved on an N-way mesh restores onto an M-way mesh or a
+single CPU device without ever building the full model on one host.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.checkpoint.array_store import (
+    CHUNK_DIR,
+    CheckpointCorruptError,
+    CheckpointError,
+    leaf_chunks,
+    read_full,
+    read_region,
+    write_leaf,
+    _fsync_write,
+)
+
+COMMIT = "COMMIT"
+META = "meta.json"
+INDEX = "index.json"
+FORMAT = "deeplearning4j_tpu/sharded-checkpoint"
+VERSION = 1
+
+# Pytree roots captured per checkpoint, keyed by index prefix.
+_PARAMS, _UPDATER, _STATE = "params", "updater", "state"
+
+
+def _path_str(path) -> str:
+    """Deterministic string form of a tree_flatten_with_path key path —
+    restore matches leaves by this key against the TARGET net's tree, so
+    the treedef itself never needs serializing."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        else:  # pragma: no cover - future key types
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def _flat_items(tree, prefix: str) -> List[Tuple[str, Any]]:
+    import jax
+
+    if tree is None:
+        return []
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [(f"{prefix}/{_path_str(p)}", leaf) for p, leaf in flat]
+
+
+def _current_rng_key(net) -> np.ndarray:
+    """Live RNG continuation (same rule as `util/checkpoint.py`): the
+    on-device clock once training has stepped, else the host attribute."""
+    if getattr(net, "_clock", None) is not None:
+        return np.asarray(net._clock[1])
+    return np.asarray(net._train_rng)
+
+
+# ------------------------------------------------------------------- save
+
+
+def snapshot_net(net) -> Dict[str, Any]:
+    """Host-side snapshot of full training state, taken on the caller's
+    thread (it must be — the train step donates its buffers, so the arrays
+    are gone one step later). Device->host copies are started async for
+    every leaf before any is materialized. The returned dict is pure host
+    data; `write_snapshot` can run it on any thread."""
+    import jax
+
+    trees = [(_PARAMS, net.params_tree), (_UPDATER, net.opt_state),
+             (_STATE, net.state or None)]
+    for _, tree in trees:
+        for leaf in jax.tree_util.tree_leaves(tree):
+            try:
+                leaf.copy_to_host_async()
+            except AttributeError:
+                pass
+    leaves = []
+    for prefix, tree in trees:
+        for key, leaf in _flat_items(tree, prefix):
+            chunks = list(leaf_chunks(leaf))
+            leaves.append({
+                "key": key,
+                "shape": tuple(np.shape(leaf)),
+                "dtype": str(chunks[0][1].dtype),
+                "chunks": chunks,
+            })
+    return {
+        "leaves": leaves,
+        "meta": {
+            "format": FORMAT,
+            "version": VERSION,
+            "engine": type(net).__name__,
+            "conf_json": net.conf.to_json(),
+            "iteration": int(net.iteration),
+            "epoch": int(net.epoch),
+            "rng": np.asarray(_current_rng_key(net)).tolist(),
+        },
+    }
+
+
+def _fsync_dir(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def write_snapshot(snap: Dict[str, Any], final_dir: str) -> str:
+    """Write a snapshot as a committed checkpoint directory (the atomic
+    protocol in the module docstring). Returns `final_dir`."""
+    tmp = final_dir + ".tmp"
+    if os.path.isdir(tmp):  # stale half-write from a crashed save
+        shutil.rmtree(tmp)
+    os.makedirs(os.path.join(tmp, CHUNK_DIR))
+    files: Dict[str, int] = {}
+    index = {"format": FORMAT, "version": VERSION, "leaves": {}}
+    for leaf_id, leaf in enumerate(snap["leaves"]):
+        index["leaves"][leaf["key"]] = write_leaf(
+            tmp, leaf_id, leaf["key"], leaf["chunks"], leaf["shape"],
+            leaf["dtype"], files)
+    meta = dict(snap["meta"])
+    meta["step"] = _step_of(final_dir)
+    files[META] = _fsync_write(os.path.join(tmp, META),
+                               json.dumps(meta).encode())
+    files[INDEX] = _fsync_write(os.path.join(tmp, INDEX),
+                                json.dumps(index).encode())
+    _fsync_write(os.path.join(tmp, COMMIT), json.dumps({
+        "format": FORMAT, "version": VERSION, "step": meta["step"],
+        "files": files,
+    }).encode())
+    _fsync_dir(os.path.join(tmp, CHUNK_DIR))
+    _fsync_dir(tmp)
+    if os.path.isdir(final_dir):
+        # Re-checkpointing the same step (failure-recovery replay): the old
+        # committed dir must go before rename; the fully-committed tmp dir
+        # survives a crash in between.
+        shutil.rmtree(final_dir)
+    os.rename(tmp, final_dir)
+    _fsync_dir(os.path.dirname(final_dir) or ".")
+    return final_dir
+
+
+def _step_of(path: str) -> Optional[int]:
+    import re
+
+    m = re.match(r"^step_(\d+)$", os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def save_checkpoint(net, path: str) -> str:
+    """Synchronous sharded save of `net` into the checkpoint directory
+    `path` (committed atomically; `CheckpointManager` adds step naming,
+    retention, and async writes on top of this)."""
+    return write_snapshot(snapshot_net(net), path)
+
+
+# ---------------------------------------------------------------- restore
+
+
+def is_sharded_checkpoint(path) -> bool:
+    """True if `path` is a COMMITTED sharded checkpoint directory."""
+    return os.path.isdir(str(path)) and os.path.isfile(
+        os.path.join(str(path), COMMIT))
+
+
+def verify_checkpoint(path: str) -> dict:
+    """Validate commit + file sizes (no array data is read); returns the
+    COMMIT manifest. Clean `CheckpointCorruptError` for a missing COMMIT
+    (half-written save) or any missing/truncated file."""
+    path = str(path)
+    if not os.path.isdir(path):
+        raise CheckpointError(f"no checkpoint directory at {path}")
+    commit_path = os.path.join(path, COMMIT)
+    if not os.path.isfile(commit_path):
+        raise CheckpointCorruptError(
+            f"{path} has no COMMIT manifest — the save never committed "
+            "(crash mid-write?); use an earlier committed step")
+    try:
+        with open(commit_path) as f:
+            commit = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptError(f"unreadable COMMIT in {path}: {e}") from e
+    for rel, size in commit.get("files", {}).items():
+        full = os.path.join(path, rel)
+        try:
+            actual = os.path.getsize(full)
+        except OSError:
+            raise CheckpointCorruptError(f"{path}: missing file {rel}")
+        if actual != size:
+            raise CheckpointCorruptError(
+                f"{path}: {rel} is {actual} bytes, manifest says {size} "
+                "(truncated or corrupt)")
+    return commit
+
+
+def read_meta(path: str) -> dict:
+    with open(os.path.join(str(path), META)) as f:
+        return json.load(f)
+
+
+def read_index(path: str) -> dict:
+    with open(os.path.join(str(path), INDEX)) as f:
+        return json.load(f)
+
+
+def _build_net(meta: dict):
+    """Fresh engine from the checkpoint's own config (mirrors
+    `model_serializer.load_model`)."""
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf.neural_net import (
+        ComputationGraphConfiguration,
+        MultiLayerConfiguration,
+    )
+
+    if meta["engine"] == "ComputationGraph":
+        conf = ComputationGraphConfiguration.from_json(meta["conf_json"])
+        return ComputationGraph(conf).init()
+    conf = MultiLayerConfiguration.from_json(meta["conf_json"])
+    return MultiLayerNetwork(conf).init()
+
+
+def _make_leaf(base: str, entry: dict, like, sharding):
+    """One restored leaf, cast to the target leaf's dtype and placed in the
+    target sharding. With a sharding, each device's region is read straight
+    from the overlapping chunks; without one, the leaf is assembled on host
+    and handed to the default device."""
+    import jax
+    import jax.numpy as jnp
+
+    shape = tuple(entry["shape"])
+    if tuple(np.shape(like)) != shape:
+        raise CheckpointError(
+            f"leaf shape mismatch: checkpoint has {shape}, target net has "
+            f"{tuple(np.shape(like))} — config/topology differs")
+    dtype = np.dtype(str(getattr(like, "dtype", entry["dtype"])))
+    if sharding is not None and shape:
+        return jax.make_array_from_callback(
+            shape, sharding,
+            lambda idx: np.ascontiguousarray(
+                read_region(base, entry, idx).astype(dtype)))
+    arr = read_full(base, entry).astype(dtype)
+    if sharding is not None:
+        return jax.device_put(arr, sharding)
+    return jnp.asarray(arr)
+
+
+def _restore_tree(tree, prefix: str, index: dict, base: str, shardings):
+    """Fill `tree`'s leaves from the index by key; `shardings` is a
+    matching pytree of target shardings (or None for host assembly)."""
+    import jax
+
+    if tree is None:
+        return None
+    entries = index["leaves"]
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    flat_sh = (jax.tree_util.tree_leaves(shardings)
+               if shardings is not None else [None] * len(flat))
+    out = []
+    for (path, like), sh in zip(flat, flat_sh):
+        key = f"{prefix}/{_path_str(path)}"
+        if key not in entries:
+            raise CheckpointError(
+                f"checkpoint at {base} has no leaf {key!r} — was it saved "
+                "from a different model config?")
+        out.append(_make_leaf(base, entries[key], like, sh))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def restore_checkpoint(path: str, net=None, mesh=None,
+                       model_axis: Optional[str] = None, context=None,
+                       load_updater: bool = True):
+    """Restore a committed sharded checkpoint, elastically.
+
+    `net=None` builds the engine from the checkpoint's own config. `mesh`
+    (or a `ParallelContext` via `context`) names the TARGET placement —
+    which may be a different shape than the mesh that saved: params/opt
+    state get the same sharding rules `parallel/mesh.py` applies at train
+    time (`param_shardings`; replicated unless `model_axis` splits them),
+    state is replicated. With no mesh, leaves restore onto the default
+    device — the single-host / CPU case.
+    """
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.parallel import mesh as mesh_mod
+
+    path = str(path)
+    verify_checkpoint(path)
+    meta = read_meta(path)
+    index = read_index(path)
+    if context is not None:
+        mesh = context.mesh
+        model_axis = context.model_axis
+    if net is None:
+        net = _build_net(meta)
+    elif not net._initialized:
+        net.init()
+
+    p_sh = u_sh = s_sh = None
+    if mesh is not None:
+        p_sh = mesh_mod.param_shardings(net.params_tree, mesh, model_axis)
+        if net.opt_state is not None:
+            u_sh = mesh_mod.param_shardings(net.opt_state, mesh, model_axis)
+        if net.state:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            repl = NamedSharding(mesh, P())
+            s_sh = jax.tree_util.tree_map(lambda _: repl, net.state)
+
+    net.params_tree = _restore_tree(net.params_tree, _PARAMS, index, path,
+                                    p_sh)
+    has_updater = any(k.startswith(_UPDATER + "/") for k in index["leaves"])
+    if load_updater and net.opt_state is not None and has_updater:
+        net.opt_state = _restore_tree(net.opt_state, _UPDATER, index, path,
+                                      u_sh)
+    if net.state:
+        net.state = _restore_tree(net.state, _STATE, index, path, s_sh)
+    net.iteration = int(meta.get("iteration", 0))
+    net.epoch = int(meta.get("epoch", 0))
+    if meta.get("rng") is not None:
+        net._train_rng = jnp.asarray(np.asarray(meta["rng"], np.uint32))
+        net._clock = None
+    return net

@@ -4,7 +4,6 @@ InMemoryModelSaver, LocalFileModelSaver / LocalFileGraphSaver)."""
 from __future__ import annotations
 
 import os
-from typing import Optional
 
 
 class InMemoryModelSaver:
@@ -26,33 +25,60 @@ class InMemoryModelSaver:
 
 
 class LocalFileModelSaver:
-    """Persist best/latest checkpoints via ModelSerializer zips."""
+    """Persist best/latest checkpoints via ModelSerializer zips
+    (`format="zip"`) or sharded checkpoint directories (`format="sharded"`,
+    per-shard chunk I/O + atomic COMMIT — `deeplearning4j_tpu/checkpoint/`).
 
-    def __init__(self, directory: str):
+    Both backends commit atomically: the ZIP path writes to `*.tmp` and
+    `os.replace`s into place (a crash mid-save can't corrupt the previous
+    `bestModel.zip`); the sharded store renames a fully-fsynced directory.
+    """
+
+    def __init__(self, directory: str, format: str = "zip"):
+        if format not in ("zip", "sharded"):
+            raise ValueError(f"format must be 'zip' or 'sharded', got {format!r}")
         self.directory = directory
+        self.format = format
         os.makedirs(directory, exist_ok=True)
 
     def _path(self, name: str) -> str:
-        return os.path.join(self.directory, name)
+        ext = ".zip" if self.format == "zip" else ""
+        return os.path.join(self.directory, name + ext)
+
+    def _save(self, net, name: str) -> None:
+        path = self._path(name)
+        if self.format == "sharded":
+            from deeplearning4j_tpu.checkpoint import save_checkpoint
+
+            save_checkpoint(net, path)
+            return
+        from deeplearning4j_tpu.util.model_serializer import save_model
+
+        tmp = path + ".tmp"
+        save_model(net, tmp)
+        os.replace(tmp, path)
+
+    def _load(self, name: str):
+        path = self._path(name)
+        if self.format == "sharded":
+            from deeplearning4j_tpu.checkpoint import (
+                is_sharded_checkpoint,
+                restore_checkpoint,
+            )
+
+            return restore_checkpoint(path) if is_sharded_checkpoint(path) else None
+        from deeplearning4j_tpu.util.model_serializer import load_model
+
+        return load_model(path) if os.path.exists(path) else None
 
     def save_best_model(self, net, score: float) -> None:
-        from deeplearning4j_tpu.util.model_serializer import save_model
-
-        save_model(net, self._path("bestModel.zip"))
+        self._save(net, "bestModel")
 
     def save_latest_model(self, net, score: float) -> None:
-        from deeplearning4j_tpu.util.model_serializer import save_model
-
-        save_model(net, self._path("latestModel.zip"))
+        self._save(net, "latestModel")
 
     def get_best_model(self):
-        from deeplearning4j_tpu.util.model_serializer import load_model
-
-        path = self._path("bestModel.zip")
-        return load_model(path) if os.path.exists(path) else None
+        return self._load("bestModel")
 
     def get_latest_model(self):
-        from deeplearning4j_tpu.util.model_serializer import load_model
-
-        path = self._path("latestModel.zip")
-        return load_model(path) if os.path.exists(path) else None
+        return self._load("latestModel")

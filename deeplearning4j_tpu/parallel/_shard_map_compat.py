@@ -1,0 +1,23 @@
+"""shard_map import/kwarg compatibility across jax versions.
+
+Newer jax exposes ``jax.shard_map`` whose replication-check knob is spelled
+``check_vma=``; older releases (<= 0.4.x) only have
+``jax.experimental.shard_map.shard_map`` where the same knob is
+``check_rep=``.  Call sites in this package use the new-style spelling; on
+old jax we translate the kwarg.
+"""
+
+from __future__ import annotations
+
+import functools
+
+try:  # jax >= 0.5
+    from jax import shard_map  # type: ignore[attr-defined]
+except ImportError:  # jax <= 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    @functools.wraps(_shard_map)
+    def shard_map(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(*args, **kwargs)

@@ -176,6 +176,39 @@ class ParallelWrapper:
         return sharded_evaluate(self.net, iterator, mesh=self.mesh,
                                 top_n=top_n)
 
+    # ------------------------------------------------------- checkpointing
+
+    def checkpoint_manager(self, directory: str, **kwargs):
+        """A `CheckpointManager` bound to THIS wrapper's mesh and axis
+        roles: saves shard per-device over the mesh, restores elastically
+        onto it — including a checkpoint written by a different mesh shape
+        (the elastic-resume path: save on 8 chips, resume on 4, or on CPU).
+        """
+        from deeplearning4j_tpu.checkpoint import CheckpointManager
+
+        return CheckpointManager(directory, context=self.context, **kwargs)
+
+    def save_checkpoint(self, directory: str, step=None) -> str:
+        """Committed sharded checkpoint of the wrapped net (synchronous;
+        use `checkpoint_manager()` for async saves + retention)."""
+        return self.checkpoint_manager(directory, keep_last=0,
+                                       async_save=False).save(self.net, step)
+
+    def restore_checkpoint(self, directory: str, step=None):
+        """Restore the latest (or named) committed step INTO the wrapped
+        net, placed per this wrapper's mesh, whatever shape saved it."""
+        ctx = self.context
+        net = self.checkpoint_manager(directory).restore(step=step,
+                                                         net=self.net)
+        if ctx.expert_axis is not None:
+            # The elastic restore places per param_shardings (replicated /
+            # model-sharded); MoE expert tables additionally shard over the
+            # expert axis — re-apply the full placement rules.
+            mesh_mod.shard_params(net, self.mesh, model_axis=ctx.model_axis,
+                                  expert_axis=ctx.expert_axis)
+        self.net = net
+        return net
+
 
 def _pad_rows(a, pad: int, fill_last: bool = True):
     """Append `pad` rows: copies of the last row (features/labels — keeps

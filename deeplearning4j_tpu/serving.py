@@ -61,6 +61,16 @@ class InferenceServer:
         self._batcher: Optional[threading.Thread] = None
         self._serve_thread: Optional[threading.Thread] = None
 
+    @classmethod
+    def from_checkpoint(cls, path, **kwargs) -> "InferenceServer":
+        """Serve straight from a checkpoint on disk: a sharded checkpoint
+        directory (a committed step or a `CheckpointManager` root — latest
+        committed step wins) or a legacy model ZIP. The deploy path is one
+        call: train anywhere, point the server at the checkpoint store."""
+        from deeplearning4j_tpu.checkpoint import load_any
+
+        return cls(load_any(path), **kwargs)
+
     # ------------------------------------------------------------- batching
 
     def _run_batch(self, pending: List[_Pending]) -> None:

@@ -10,8 +10,12 @@ training thread; `load_checkpoint` restores a network whose continued
 `fit()` reproduces the uninterrupted run bit-for-bit (same params, same
 dropout/sampling randomness — the RNG continuation is part of the state).
 
-File format: the `model_serializer` ZIP (so `load_model` can also open a
-checkpoint) plus a `training/rng.npy` entry carrying the PRNG key.
+File formats: the legacy `model_serializer` ZIP (so `load_model` can also
+open a checkpoint) plus a `training/rng.npy` entry carrying the PRNG key —
+or, with `format="sharded"`, the `deeplearning4j_tpu/checkpoint/` store:
+per-shard chunk files + atomic COMMIT, which parallelizes save I/O and
+restores elastically onto any mesh shape. `load_checkpoint` opens both
+(a directory path is a sharded checkpoint, a file path a ZIP).
 """
 
 from __future__ import annotations
@@ -40,23 +44,39 @@ def _current_rng_key(net) -> np.ndarray:
     return np.asarray(net._train_rng)
 
 
-def save_checkpoint(net, path) -> None:
-    """Model ZIP + training RNG: synchronous variant (the listener does the
-    same thing with the write off-thread)."""
+def save_checkpoint(net, path, format: str = "zip") -> str:
+    """Synchronous full-state checkpoint. `format="zip"`: model ZIP +
+    training RNG (the listener does the same thing with the write
+    off-thread). `format="sharded"`: a committed sharded checkpoint
+    directory at `path` (per-shard chunks + COMMIT; see
+    `deeplearning4j_tpu/checkpoint/`)."""
+    if format == "sharded":
+        from deeplearning4j_tpu.checkpoint import store as sharded_store
+
+        return sharded_store.save_checkpoint(net, path)
     model_serializer.save_model(net, path, save_updater=True)
     with zipfile.ZipFile(path, "a") as z:
         buf = io.BytesIO()
         np.save(buf, _current_rng_key(net))
         z.writestr(RNG_ENTRY, buf.getvalue())
+    return str(path)
 
 
-def load_checkpoint(path):
-    """Restore engine + params + updater state + iteration/epoch (via
-    `model_serializer.load_model`) AND the RNG continuation, so the next
-    `fit()` step is identical to what the checkpointed run would have
-    executed."""
+def load_checkpoint(path, mesh=None, context=None):
+    """Restore engine + params + updater state + iteration/epoch AND the
+    RNG continuation, so the next `fit()` step is identical to what the
+    checkpointed run would have executed.
+
+    Opens both formats: a directory is a sharded checkpoint (a committed
+    step, or a `CheckpointManager` root — latest committed step wins), a
+    file the legacy `model_serializer` ZIP. `mesh`/`context` name a target
+    placement for the sharded path (elastic restore)."""
     import jax.numpy as jnp
 
+    if os.path.isdir(str(path)):
+        from deeplearning4j_tpu.checkpoint import legacy
+
+        return legacy.load_any(path, mesh=mesh, context=context)
     net = model_serializer.load_model(path, load_updater=True)
     with zipfile.ZipFile(path) as z:
         if RNG_ENTRY in z.namelist():
@@ -72,20 +92,30 @@ class CheckpointListener(IterationListener):
 
     The device->host snapshot happens at the iteration boundary (it must —
     the train step donates its buffers, so the arrays the checkpoint needs
-    are gone one step later); the ZIP encode + disk write, which dominate
+    are gone one step later); the encode + disk write, which dominate
     wall time, run on a single background worker. If a write is still in
     flight when the next snapshot fires, the listener waits (bounding
     checkpoint memory to one in-flight snapshot) — with the default
     frequencies that stall is never hit.
+
+    `format="zip"` writes the legacy single-file ZIPs; `format="sharded"`
+    writes committed sharded step directories (`step_{iteration:08d}/`,
+    per-shard chunk I/O + atomic COMMIT — `deeplearning4j_tpu/checkpoint/`).
+    Either way `saved_paths` lists committed checkpoints oldest-first and
+    `load_checkpoint` opens any entry.
     """
 
     def __init__(self, directory: str, frequency: int = 100,
                  keep_last: int = 3,
-                 filename_pattern: str = "checkpoint_iter{iteration}.zip"):
+                 filename_pattern: str = "checkpoint_iter{iteration}.zip",
+                 format: str = "zip"):
+        if format not in ("zip", "sharded"):
+            raise ValueError(f"format must be 'zip' or 'sharded', got {format!r}")
         self.directory = directory
         self.frequency = max(1, int(frequency))
         self.keep_last = int(keep_last)
         self.filename_pattern = filename_pattern
+        self.format = format
         os.makedirs(directory, exist_ok=True)
         self._inflight: Optional[threading.Thread] = None
         self.saved_paths: List[str] = []
@@ -145,10 +175,15 @@ class CheckpointListener(IterationListener):
         os.replace(tmp, path)  # atomic: a crash never leaves a torn file
 
     def _prune(self) -> None:
+        import shutil
+
         while self.keep_last > 0 and len(self.saved_paths) > self.keep_last:
             old = self.saved_paths.pop(0)
             try:
-                os.remove(old)
+                if os.path.isdir(old):
+                    shutil.rmtree(old)
+                else:
+                    os.remove(old)
             except OSError:
                 pass
 
@@ -159,12 +194,21 @@ class CheckpointListener(IterationListener):
             return
         if self._inflight is not None:
             self._inflight.join()  # bound to one in-flight snapshot
-        snap = self._host_snapshot(model)
-        path = os.path.join(self.directory,
-                            self.filename_pattern.format(iteration=iteration))
+        if self.format == "sharded":
+            from deeplearning4j_tpu.checkpoint import store as sharded_store
+
+            snap = sharded_store.snapshot_net(model)
+            path = os.path.join(self.directory, f"step_{iteration:08d}")
+            write = sharded_store.write_snapshot
+        else:
+            snap = self._host_snapshot(model)
+            path = os.path.join(
+                self.directory,
+                self.filename_pattern.format(iteration=iteration))
+            write = self._write
 
         def work():
-            self._write(snap, path)
+            write(snap, path)
             # Record + prune only AFTER the new file is durably in place: a
             # crash mid-write must never have already deleted the previous
             # good checkpoint (keep_last=1 would otherwise leave nothing).

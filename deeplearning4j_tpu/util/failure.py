@@ -51,7 +51,27 @@ def restore_in_place(net, path: str) -> None:
 
 def _checkpoint_healthy(path: str) -> bool:
     """True if every parameter AND updater-state value in the checkpoint
-    zip is finite (format: `model_serializer` float64 raw bytes)."""
+    is finite. Handles both formats: a sharded checkpoint directory
+    (chunks are scanned leaf-by-leaf — never assembling more than one
+    leaf on host) and the `model_serializer` ZIP (float64 raw bytes)."""
+    import os
+
+    if os.path.isdir(path):
+        from deeplearning4j_tpu.checkpoint import store as sharded_store
+
+        try:
+            sharded_store.verify_checkpoint(path)
+            index = sharded_store.read_index(path)
+            for key, entry in index["leaves"].items():
+                if not (key.startswith("params/")
+                        or key.startswith("updater/")):
+                    continue
+                if not np.all(np.isfinite(
+                        sharded_store.read_full(path, entry))):
+                    return False
+            return True
+        except Exception:
+            return False
     try:
         with zipfile.ZipFile(path) as z:
             names = set(z.namelist())
@@ -142,9 +162,15 @@ class FailureDetectionListener(IterationListener):
     def _newer_than(path: str, iteration: int) -> bool:
         try:
             import json
+            import os
 
-            with zipfile.ZipFile(path) as z:
-                manifest = json.loads(z.read(model_serializer.MANIFEST))
+            if os.path.isdir(path):
+                from deeplearning4j_tpu.checkpoint import store as sstore
+
+                manifest = sstore.read_meta(path)
+            else:
+                with zipfile.ZipFile(path) as z:
+                    manifest = json.loads(z.read(model_serializer.MANIFEST))
             return int(manifest.get("iteration", -1)) > iteration
         except Exception:
             return True  # unreadable: treat as stale and drop

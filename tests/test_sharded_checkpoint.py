@@ -1,0 +1,374 @@
+"""Sharded elastic checkpoint store (`deeplearning4j_tpu/checkpoint/`).
+
+Tier-1 coverage (CPU, 8-device virtual mesh): chunked array store,
+atomic-commit crash safety (truncated chunk / missing COMMIT / half-written
+tmp), keep-last-k + keep-every-m retention, elastic save-on-N-restore-on-M
+round trips (8-way -> 1-way and 4-way, bit-identical), exact continued-fit
+resume through both `CheckpointManager` and the legacy `load_checkpoint`
+compat path, legacy-ZIP migration, and the atomic earlystopping savers.
+The large sweep is marked `slow`.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.checkpoint import (
+    CheckpointCorruptError,
+    CheckpointError,
+    CheckpointManager,
+    is_sharded_checkpoint,
+    load_any,
+    migrate_zip,
+    restore_checkpoint,
+)
+from deeplearning4j_tpu.checkpoint import array_store, store
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.neural_net import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel import mesh as mesh_mod
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+from deeplearning4j_tpu.util.checkpoint import (
+    CheckpointListener,
+    load_checkpoint,
+    save_checkpoint,
+)
+
+
+def _net(seed=3, dropout=None, width=12):
+    b = (NeuralNetConfiguration.builder()
+         .seed(seed).learning_rate(0.1).updater("adam"))
+    if dropout is not None:
+        b = b.drop_out(dropout)
+    conf = (b.list()
+            .layer(DenseLayer(n_out=width, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss_function="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _batch(step, n=16):
+    r = np.random.RandomState(500 + step)
+    X = r.randn(n, 4).astype("float32")
+    Y = np.eye(3)[r.randint(0, 3, n)].astype("float32")
+    return X, Y
+
+
+def _flat(net):
+    return np.asarray(net.params())
+
+
+class TestArrayStore:
+    def test_sharded_leaf_chunks_and_region_read(self, tmp_path):
+        """A model-sharded array stores one chunk PER DISTINCT shard (data
+        replicas deduped), and arbitrary regions reassemble exactly."""
+        mesh = mesh_mod.create_mesh((4, 2), ("data", "model"))
+        x = jax.device_put(
+            np.arange(8 * 64, dtype=np.float64).reshape(8, 64),
+            NamedSharding(mesh, P(None, "model")))
+        chunks = list(array_store.leaf_chunks(x))
+        assert len(chunks) == 2  # 8 shards, 2 distinct model-axis regions
+        os.makedirs(tmp_path / array_store.CHUNK_DIR)
+        files = {}
+        entry = array_store.write_leaf(str(tmp_path), 0, "params/l/W",
+                                       chunks, x.shape, str(x.dtype), files)
+        assert len(entry["chunks"]) == 2 and len(files) == 2
+        full = array_store.read_full(str(tmp_path), entry)
+        np.testing.assert_array_equal(full, np.asarray(x))
+        region = array_store.read_region(
+            str(tmp_path), entry, (slice(2, 7), slice(30, 50)))
+        np.testing.assert_array_equal(region, np.asarray(x)[2:7, 30:50])
+
+    def test_replicated_leaf_is_one_chunk(self, tmp_path):
+        mesh = mesh_mod.create_mesh(devices=jax.devices())
+        x = jax.device_put(np.arange(6.0), NamedSharding(mesh, P()))
+        chunks = list(array_store.leaf_chunks(x))
+        assert len(chunks) == 1
+        assert chunks[0][0] == ((0, 6),)
+
+
+class TestAtomicCommitAndCorruption:
+    def _committed(self, tmp_path, steps=(5, 10)):
+        net = _net()
+        net.fit(*_batch(0))
+        mgr = CheckpointManager(str(tmp_path), keep_last=0, async_save=False)
+        for s in steps:
+            mgr.save(net, step=s)
+        return net, mgr
+
+    def test_truncated_chunk_clean_error_and_fallback(self, tmp_path):
+        _, mgr = self._committed(tmp_path)
+        p = mgr.step_path(10)
+        chunk = os.path.join(p, array_store.CHUNK_DIR,
+                             sorted(os.listdir(
+                                 os.path.join(p, array_store.CHUNK_DIR)))[0])
+        with open(chunk, "r+b") as f:
+            f.truncate(3)
+        # Explicit restore of the damaged step: clean, specific error.
+        with pytest.raises(CheckpointCorruptError, match="truncated"):
+            restore_checkpoint(p)
+        # latest() never serves the damaged step — falls back to step 5.
+        assert mgr.latest() == 5
+        assert mgr.restore().iteration == 1
+
+    def test_missing_commit_manifest(self, tmp_path):
+        _, mgr = self._committed(tmp_path)
+        p = mgr.step_path(10)
+        os.remove(os.path.join(p, store.COMMIT))
+        with pytest.raises(CheckpointCorruptError, match="COMMIT"):
+            restore_checkpoint(p)
+        assert mgr.latest() == 5
+
+    def test_half_written_tmp_dir_ignored(self, tmp_path):
+        _, mgr = self._committed(tmp_path)
+        tmp = mgr.step_path(15) + ".tmp"
+        os.makedirs(os.path.join(tmp, array_store.CHUNK_DIR))
+        with open(os.path.join(tmp, "index.json"), "w") as f:
+            f.write("{")  # crashed mid-write
+        assert mgr.latest() == 10
+        with pytest.raises(CheckpointCorruptError):
+            restore_checkpoint(tmp)
+
+    def test_empty_store_raises_clean(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        assert mgr.latest() is None
+        with pytest.raises(CheckpointError, match="no committed"):
+            mgr.restore()
+
+
+class TestRetention:
+    def test_keep_last_plus_keep_every(self, tmp_path):
+        net = _net()
+        net.fit(*_batch(0))
+        mgr = CheckpointManager(str(tmp_path), keep_last=2, keep_every=4,
+                                async_save=False)
+        for s in range(1, 11):
+            mgr.save(net, step=s)
+        # newest 2 survive, plus every 4th forever.
+        assert mgr.all_steps() == [4, 8, 9, 10]
+
+
+class TestElasticRoundTrip:
+    """Acceptance: saved on the 8-device mesh, restores bit-identically
+    onto a different mesh shape (including single-device)."""
+
+    def test_model_sharded_save_restores_on_1way_and_4way(self, tmp_path):
+        mesh42 = mesh_mod.create_mesh((4, 2), ("data", "model"))
+        net = _net(width=512)  # Dense W is 4x512 -> model-sharded in halves
+        w = ParallelWrapper(net, mesh=mesh42, model_axis="model")
+        for s in range(3):
+            w.fit(DataSet(*_batch(s)))
+        lk = net.layer_keys[0]
+        assert net.params_tree[lk]["W"].sharding.spec[-1] == "model"
+        ref_p, ref_u = _flat(net), np.asarray(net.updater_state_flat())
+        path = w.save_checkpoint(str(tmp_path / "c"))
+
+        # 1-way (single device, no mesh): bit-identical params + updater.
+        one = restore_checkpoint(path)
+        np.testing.assert_array_equal(_flat(one), ref_p)
+        np.testing.assert_array_equal(np.asarray(one.updater_state_flat()),
+                                      ref_u)
+        assert one.iteration == net.iteration
+
+        # 4-way data mesh: bit-identical, placed on exactly 4 devices.
+        mesh4 = mesh_mod.create_mesh(devices=jax.devices()[:4])
+        four = restore_checkpoint(path, mesh=mesh4)
+        np.testing.assert_array_equal(_flat(four), ref_p)
+        assert len(four.params_tree[lk]["W"].sharding.device_set) == 4
+
+        # (2, 2) with model axis: bit-identical AND resharded for the new
+        # topology.
+        mesh22 = mesh_mod.create_mesh((2, 2), ("data", "model"))
+        re22 = restore_checkpoint(path, mesh=mesh22, model_axis="model")
+        np.testing.assert_array_equal(_flat(re22), ref_p)
+        assert re22.params_tree[lk]["W"].sharding.mesh.shape["model"] == 2
+
+    def test_wrapper_elastic_resume_on_smaller_mesh(self, tmp_path):
+        mesh8 = mesh_mod.create_mesh(devices=jax.devices())
+        a = _net(seed=11)
+        w8 = ParallelWrapper(a, mesh=mesh8)
+        for s in range(3):
+            w8.fit(DataSet(*_batch(s)))
+        w8.save_checkpoint(str(tmp_path / "c"))
+
+        b = _net(seed=99)  # different init — must be overwritten
+        w4 = ParallelWrapper(b, mesh=mesh_mod.create_mesh(
+            devices=jax.devices()[:4]))
+        restored = w4.restore_checkpoint(str(tmp_path / "c"))
+        np.testing.assert_array_equal(_flat(restored), _flat(a))
+        assert restored.iteration == a.iteration
+        w4.fit(DataSet(*_batch(3)))  # training continues on the new mesh
+        assert np.isfinite(restored.score_value)
+
+
+class TestExactResume:
+    """Acceptance: continued `fit()` after restore matches the
+    uninterrupted run — dropout on, so the RNG continuation is load-bearing
+    — through both `CheckpointManager` and the legacy `load_checkpoint`
+    compat path."""
+
+    def _train_with_manager(self, tmp_path):
+        a = _net(dropout=0.7)
+        mgr = CheckpointManager(str(tmp_path), keep_last=3)
+        for s in range(10):
+            a.fit(*_batch(s))
+            if s == 4:
+                mgr.save(a)  # async; snapshot is taken synchronously here
+        mgr.flush()
+        return a, mgr
+
+    def test_via_checkpoint_manager(self, tmp_path):
+        a, mgr = self._train_with_manager(tmp_path)
+        assert mgr.latest() == 5
+        b = mgr.restore()
+        assert b.iteration == 5
+        for s in range(5, 10):
+            b.fit(*_batch(s))
+        np.testing.assert_array_equal(_flat(a), _flat(b))
+
+    def test_via_legacy_load_checkpoint_compat(self, tmp_path):
+        a, mgr = self._train_with_manager(tmp_path)
+        # Both spellings: the committed step dir, and the manager root
+        # (latest committed step wins).
+        c = load_checkpoint(mgr.step_path(5))
+        root = load_checkpoint(str(tmp_path))
+        assert root.iteration == 5
+        for s in range(5, 10):
+            c.fit(*_batch(s))
+        np.testing.assert_array_equal(_flat(a), _flat(c))
+
+
+class TestShardedListener:
+    def test_listener_sharded_backend_resume(self, tmp_path):
+        net = _net(seed=4, dropout=0.5)
+        lst = CheckpointListener(str(tmp_path), frequency=5, keep_last=2,
+                                 format="sharded")
+        net.set_listeners(lst)
+        for s in range(10):
+            net.fit(*_batch(s))
+        lst.flush()
+        assert [os.path.basename(p) for p in lst.saved_paths] == [
+            "step_00000005", "step_00000010"]
+        assert all(is_sharded_checkpoint(p) for p in lst.saved_paths)
+        b = load_checkpoint(lst.saved_paths[0])
+        assert b.iteration == 5
+        for s in range(5, 10):
+            b.fit(*_batch(s))
+        np.testing.assert_array_equal(_flat(net), _flat(b))
+
+    def test_sharded_checkpoint_health_check(self, tmp_path):
+        from deeplearning4j_tpu.util.failure import _checkpoint_healthy
+
+        net = _net()
+        net.fit(*_batch(0))
+        mgr = CheckpointManager(str(tmp_path), async_save=False)
+        good = mgr.save(net, step=1)
+        assert _checkpoint_healthy(good)
+        net.set_params(np.full(net.num_params(), np.nan))
+        bad = mgr.save(net, step=2)
+        assert not _checkpoint_healthy(bad)
+
+
+class TestLegacyMigration:
+    def test_zip_migrates_and_loads_identically(self, tmp_path):
+        a = _net(dropout=0.3)
+        for s in range(3):
+            a.fit(*_batch(s))
+        z = str(tmp_path / "legacy.zip")
+        save_checkpoint(a, z)
+        step_dir = migrate_zip(z, str(tmp_path / "sharded"))
+        assert is_sharded_checkpoint(step_dir)
+        m = load_any(step_dir)
+        # The ZIP stores float64 upcasts; equality after the same
+        # round-trip `load_checkpoint` applies to the ZIP itself.
+        np.testing.assert_array_equal(_flat(m), _flat(load_checkpoint(z)))
+        assert m.iteration == a.iteration
+        # And both continue training to the same place (full state came
+        # through the migration: params, updater, iteration, RNG).
+        n_zip = load_checkpoint(z)
+        for s in range(3, 6):
+            m.fit(*_batch(s))
+            n_zip.fit(*_batch(s))
+        np.testing.assert_array_equal(_flat(m), _flat(n_zip))
+
+    def test_serving_from_checkpoint(self, tmp_path):
+        from deeplearning4j_tpu.serving import InferenceServer
+
+        net = _net()
+        net.fit(*_batch(0))
+        mgr = CheckpointManager(str(tmp_path), async_save=False)
+        mgr.save(net)
+        server = InferenceServer.from_checkpoint(str(tmp_path))
+        np.testing.assert_array_equal(_flat(server.net), _flat(net))
+
+
+class TestEarlyStoppingSaverAtomic:
+    def test_zip_saver_survives_crash_mid_save(self, tmp_path, monkeypatch):
+        from deeplearning4j_tpu.earlystopping.saver import LocalFileModelSaver
+        from deeplearning4j_tpu.util import model_serializer
+
+        net = _net()
+        net.fit(*_batch(0))
+        saver = LocalFileModelSaver(str(tmp_path))
+        saver.save_best_model(net, 0.5)
+        good = _flat(saver.get_best_model())
+
+        real = model_serializer.save_model
+
+        def crashing(net, path, **kw):
+            real(net, path, **kw)  # bytes hit the tmp file...
+            raise OSError("disk full")  # ...then the writer dies
+
+        monkeypatch.setattr(model_serializer, "save_model", crashing)
+        net.fit(*_batch(1))
+        with pytest.raises(OSError):
+            saver.save_best_model(net, 0.4)
+        # The committed bestModel.zip is the PREVIOUS good save, intact.
+        np.testing.assert_array_equal(_flat(saver.get_best_model()), good)
+
+    def test_sharded_saver_roundtrip(self, tmp_path):
+        from deeplearning4j_tpu.earlystopping.saver import LocalFileModelSaver
+
+        net = _net()
+        net.fit(*_batch(0))
+        saver = LocalFileModelSaver(str(tmp_path), format="sharded")
+        assert saver.get_best_model() is None
+        saver.save_best_model(net, 0.5)
+        saver.save_latest_model(net, 0.5)
+        assert is_sharded_checkpoint(str(tmp_path / "bestModel"))
+        np.testing.assert_array_equal(_flat(saver.get_best_model()),
+                                      _flat(net))
+        np.testing.assert_array_equal(_flat(saver.get_latest_model()),
+                                      _flat(net))
+
+
+@pytest.mark.slow
+class TestLargeSweep:
+    """Wide-model / many-step sweeps — excluded from tier-1."""
+
+    def test_wide_model_many_steps_many_mesh_shapes(self, tmp_path):
+        mesh42 = mesh_mod.create_mesh((4, 2), ("data", "model"))
+        net = _net(width=2048)
+        w = ParallelWrapper(net, mesh=mesh42, model_axis="model")
+        mgr = w.checkpoint_manager(str(tmp_path), keep_last=2, keep_every=10)
+        for s in range(20):
+            w.fit(DataSet(*_batch(s, n=64)))
+            if (s + 1) % 5 == 0:
+                mgr.save(net)
+        mgr.flush()
+        assert mgr.all_steps() == [10, 15, 20]
+        ref = _flat(net)
+        for target in (None, mesh_mod.create_mesh(devices=jax.devices()[:2]),
+                       mesh_mod.create_mesh((2, 4), ("data", "model"))):
+            got = restore_checkpoint(mgr.step_path(20), mesh=target,
+                                     model_axis="model" if target is not None
+                                     and "model" in target.shape else None)
+            np.testing.assert_array_equal(_flat(got), ref)
