@@ -173,50 +173,24 @@ def _timed_fit(net, make_batch, batch, steps, warmup, distinct=4, cached=False):
 
 
 def _step_flops(net, x, y):
-    """XLA cost-analysis FLOPs of the engine's actual jitted train step."""
-    import jax
-    import jax.numpy as jnp
+    """XLA cost-analysis FLOPs of the engine's actual jitted train step
+    (delegates to the observability profiler — same code path StepProfiler
+    uses, so BENCH and live MFU agree by construction)."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+    from deeplearning4j_tpu.observability import estimate_step_flops
 
-    try:
-        fn = net._get_jit("train_step")
-        clock = (jnp.asarray(0.0, jnp.float32), jax.random.PRNGKey(0))
-        if type(net).__name__ == "ComputationGraph":
-            args = (net.params_tree, net.state, net.opt_state,
-                    [jnp.asarray(x)], [jnp.asarray(y)], None, None, clock)
-        else:
-            args = (net.params_tree, net.state, net.opt_state,
-                    jnp.asarray(x), jnp.asarray(y), None, None, clock)
-        lowered = fn.lower(*args)
-        try:
-            cost = lowered.compile().cost_analysis()
-        except Exception:
-            cost = lowered.cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0]
-        flops = float(cost.get("flops", 0.0))
-        return flops if flops > 0 else None
-    except Exception:
-        return None
+    if type(net).__name__ == "ComputationGraph":
+        ds = MultiDataSet(features=[np.asarray(x)], labels=[np.asarray(y)])
+    else:
+        ds = DataSet(np.asarray(x), np.asarray(y))
+    return estimate_step_flops(net, ds)
 
 
 def _chip_peak_flops():
     """Peak bf16 FLOPs/sec for the local chip (override: BENCH_PEAK_FLOPS)."""
-    env = os.environ.get("BENCH_PEAK_FLOPS")
-    if env:
-        return float(env)
-    import jax
+    from deeplearning4j_tpu.observability import chip_peak_flops
 
-    kind = jax.devices()[0].device_kind.lower()
-    table = [
-        ("v5 lite", 197e12), ("v5e", 197e12),
-        ("v5p", 459e12), ("v5", 459e12),
-        ("v6", 918e12), ("trillium", 918e12),
-        ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
-    ]
-    for key, peak in table:
-        if key in kind:
-            return peak
-    return None
+    return chip_peak_flops()
 
 
 # ----------------------------------------------------------------- configs
@@ -594,6 +568,12 @@ def bench_resnet50(steps, warmup):
         mfu = flops / step_time / peak
         extra_metrics["resnet50_train_mfu"] = _entry(
             "resnet50_train_mfu", mfu, "fraction_of_peak")
+        from deeplearning4j_tpu import observability as obs
+
+        obs.metrics.gauge(
+            "dl4j_train_mfu",
+            "Model FLOPs utilization: flops/step / step_time / chip peak"
+        ).set(mfu)
 
     # Streaming variant: every batch crosses the host->device link. Few
     # steps on purpose — the shared tunnel's transfer latency varies by
@@ -626,6 +606,11 @@ def bench_resnet50(steps, warmup):
 
 
 def main():
+    # Compile-time accounting for the self-attribution snapshot in _emit():
+    # every XLA compile during the run lands in dl4j_xla_compile_* counters.
+    from deeplearning4j_tpu import observability as obs
+
+    obs.install_jax_compile_hook()
     steps = int(os.environ.get("BENCH_STEPS", "30"))
     warmup = int(os.environ.get("BENCH_WARMUP", "5"))
     configs = os.environ.get(
@@ -683,6 +668,14 @@ def main():
 
 
 def _emit(out: dict) -> None:
+    # Self-attribution (ISSUE 2): step-latency/dispatch summaries, compile
+    # totals, jit-cache hits, MFU — so a BENCH round explains its own time.
+    try:
+        from deeplearning4j_tpu import observability as obs
+
+        out["observability"] = obs.bench_snapshot()
+    except Exception:
+        pass
     print(json.dumps(out))
     # The full record also lands in a file: stdout-tail capture has
     # truncated the JSON before (BENCH_r05.json came back `parsed: null`,

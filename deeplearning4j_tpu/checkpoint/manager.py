@@ -33,8 +33,31 @@ from typing import List, Optional
 
 from deeplearning4j_tpu.checkpoint import store
 from deeplearning4j_tpu.checkpoint.array_store import CheckpointError
+from deeplearning4j_tpu import observability as _obs
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
+
+_M_SAVES = _obs.metrics.counter(
+    "dl4j_checkpoint_saves_total", "Committed checkpoint saves")
+_M_RESTORES = _obs.metrics.counter(
+    "dl4j_checkpoint_restores_total", "Checkpoint restores")
+_M_BYTES_W = _obs.metrics.counter(
+    "dl4j_checkpoint_bytes_written_total",
+    "Array bytes captured into committed checkpoints")
+_M_BYTES_R = _obs.metrics.counter(
+    "dl4j_checkpoint_bytes_read_total",
+    "Committed checkpoint bytes read by restores (manifest sizes)")
+_M_QUEUE = _obs.metrics.gauge(
+    "dl4j_checkpoint_queue_depth",
+    "In-flight async checkpoint writes (bounded to 1 by design)")
+
+
+def _snap_nbytes(snap) -> int:
+    try:
+        return sum(chunk[1].nbytes for leaf in snap["leaves"]
+                   for chunk in leaf["chunks"])
+    except Exception:
+        return 0
 
 
 class CheckpointManager:
@@ -90,21 +113,35 @@ class CheckpointManager:
         `async_save=False`. Returns the (future) committed path."""
         self.flush()  # bound to one in-flight snapshot; surface old errors
         step = int(net.iteration if step is None else step)
-        snap = store.snapshot_net(net)
+        with _obs.tracer.span("checkpoint.snapshot", cat="checkpoint",
+                              step=step):
+            snap = store.snapshot_net(net)
+        nbytes = _snap_nbytes(snap)
         path = self.step_path(step)
 
         def work():
             try:
-                store.write_snapshot(snap, path)
+                with _obs.tracer.span("checkpoint.write", cat="checkpoint",
+                                      step=step, bytes=nbytes):
+                    store.write_snapshot(snap, path)
+                _M_BYTES_W.inc(nbytes)
+                _M_SAVES.inc()
                 self._apply_retention()
             except BaseException as e:  # surfaced on next save()/flush()
                 self._error = e
+            finally:
+                _M_QUEUE.set(0)
 
         if self.async_save:
+            _M_QUEUE.set(1)
             self._inflight = threading.Thread(target=work, daemon=True)
             self._inflight.start()
         else:
-            store.write_snapshot(snap, path)
+            with _obs.tracer.span("checkpoint.write", cat="checkpoint",
+                                  step=step, bytes=nbytes):
+                store.write_snapshot(snap, path)
+            _M_BYTES_W.inc(nbytes)
+            _M_SAVES.inc()
             self._apply_retention()
         return path
 
@@ -141,7 +178,17 @@ class CheckpointManager:
             if step is None:
                 raise CheckpointError(
                     f"no committed checkpoint under {self.directory}")
-        return store.restore_checkpoint(
-            self.step_path(step), net=net, mesh=self.mesh,
-            model_axis=self.model_axis, context=self.context,
-            load_updater=load_updater)
+        path = self.step_path(step)
+        with _obs.tracer.span("checkpoint.restore", cat="checkpoint",
+                              step=int(step)):
+            result = store.restore_checkpoint(
+                path, net=net, mesh=self.mesh,
+                model_axis=self.model_axis, context=self.context,
+                load_updater=load_updater)
+        try:
+            manifest = store.verify_checkpoint(path)
+            _M_BYTES_R.inc(sum(manifest["files"].values()))
+        except Exception:
+            pass
+        _M_RESTORES.inc()
+        return result

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import copy
 import math
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -36,6 +37,31 @@ from deeplearning4j_tpu.ops import grad_norm as grad_norm_mod
 from deeplearning4j_tpu.ops import schedules as schedules_mod
 from deeplearning4j_tpu.ops import updaters as updaters_mod
 from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu import observability as _obs
+
+# Hot-loop series resolved once at import (observability/metrics.py rule 2).
+_M_ITERS = _obs.metrics.counter(
+    "dl4j_train_iterations_total", "Completed training iterations",
+    label_names=("engine",)).labels(engine="graph")
+_M_EPOCHS = _obs.metrics.counter(
+    "dl4j_train_epochs_total", "Completed fit() epochs",
+    label_names=("engine",)).labels(engine="graph")
+_M_DISPATCH = _obs.metrics.histogram(
+    "dl4j_step_dispatch_seconds",
+    "Host time to dispatch one staged batch (async — completion is NOT "
+    "awaited; see dl4j_step_latency_seconds from StepProfiler for settled "
+    "latency)", label_names=("engine",)).labels(engine="graph")
+_M_H2D = _obs.metrics.counter(
+    "dl4j_host_to_device_bytes_total",
+    "Host-resident bytes staged to device with training batches",
+    label_names=("engine",)).labels(engine="graph")
+_M_JIT_HIT = _obs.metrics.counter(
+    "dl4j_jit_cache_hits_total", "Engine jit-program cache hits",
+    label_names=("engine",)).labels(engine="graph")
+_M_JIT_MISS = _obs.metrics.counter(
+    "dl4j_jit_cache_misses_total",
+    "Engine jit-program cache misses (a new program will trace+compile)",
+    label_names=("engine",)).labels(engine="graph")
 
 
 def _as_mds(data, labels=None) -> MultiDataSet:
@@ -235,7 +261,10 @@ class ComputationGraph:
         # (ring vs flash attention, expert-sharded vs local MoE).
         key = (kind, tuple(sorted(static.items())), context_cache_key())
         if key not in self._jit_cache:
+            _M_JIT_MISS.inc()
             self._jit_cache[key] = self._build_jit(kind, **static)
+        else:
+            _M_JIT_HIT.inc()
         return self._jit_cache[key]
 
     def _build_jit(self, kind: str, train=False, keep_rnn_state=False,
@@ -541,16 +570,32 @@ class ComputationGraph:
                 pass
         for listener in self.listeners:
             listener.on_epoch_start(self)
-        for item in iterator:
-            self._fit_dispatch(_as_mds(item))
+        with _obs.tracer.span("graph.fit", cat="train", epoch=self.epoch):
+            for item in iterator:
+                self._fit_dispatch(_as_mds(item))
         self.epoch += 1
+        _M_EPOCHS.inc()
         for listener in self.listeners:
             listener.on_epoch_end(self)
         return self
 
     def _fit_dispatch(self, mds: MultiDataSet):
         """tBPTT/plain dispatch + iterations loop for one staged batch —
-        shared by `fit()` and `ParallelWrapper`."""
+        shared by `fit()` and `ParallelWrapper`. Observability choke point
+        (see `MultiLayerNetwork._fit_dispatch`); `StepProfiler` patches this
+        method on the instance."""
+        _M_H2D.inc(_obs.host_nbytes(mds.features, mds.labels,
+                                    mds.features_masks, mds.labels_masks))
+        it0 = self.iteration
+        t0 = time.perf_counter()
+        with _obs.iteration_span("graph", it0 + 1):
+            try:
+                return self._fit_dispatch_inner(mds)
+            finally:
+                _M_DISPATCH.observe(time.perf_counter() - t0)
+                _M_ITERS.inc(max(0, self.iteration - it0))
+
+    def _fit_dispatch_inner(self, mds: MultiDataSet):
         g = self.conf.global_conf
         algo = OptimizationAlgorithm.of(g.optimization_algo)
         if algo != OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT:

@@ -43,6 +43,31 @@ from deeplearning4j_tpu.ops import grad_norm as grad_norm_mod
 from deeplearning4j_tpu.ops import schedules as schedules_mod
 from deeplearning4j_tpu.ops import updaters as updaters_mod
 from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu import observability as _obs
+
+# Hot-loop series resolved once at import (observability/metrics.py rule 2).
+_M_ITERS = _obs.metrics.counter(
+    "dl4j_train_iterations_total", "Completed training iterations",
+    label_names=("engine",)).labels(engine="mln")
+_M_EPOCHS = _obs.metrics.counter(
+    "dl4j_train_epochs_total", "Completed fit() epochs",
+    label_names=("engine",)).labels(engine="mln")
+_M_DISPATCH = _obs.metrics.histogram(
+    "dl4j_step_dispatch_seconds",
+    "Host time to dispatch one staged batch (async — completion is NOT "
+    "awaited; see dl4j_step_latency_seconds from StepProfiler for settled "
+    "latency)", label_names=("engine",)).labels(engine="mln")
+_M_H2D = _obs.metrics.counter(
+    "dl4j_host_to_device_bytes_total",
+    "Host-resident bytes staged to device with training batches",
+    label_names=("engine",)).labels(engine="mln")
+_M_JIT_HIT = _obs.metrics.counter(
+    "dl4j_jit_cache_hits_total", "Engine jit-program cache hits",
+    label_names=("engine",)).labels(engine="mln")
+_M_JIT_MISS = _obs.metrics.counter(
+    "dl4j_jit_cache_misses_total",
+    "Engine jit-program cache misses (a new program will trace+compile)",
+    label_names=("engine",)).labels(engine="mln")
 
 
 def _as_dataset(data, labels=None) -> DataSet:
@@ -223,7 +248,9 @@ class MultiLayerNetwork:
         # part of the program identity.
         key = (kind, tuple(sorted(static.items())), context_cache_key())
         if key in self._jit_cache:
+            _M_JIT_HIT.inc()
             return self._jit_cache[key]
+        _M_JIT_MISS.inc()
         fn = self._build_jit(kind, **static)
         self._jit_cache[key] = fn
         return fn
@@ -570,10 +597,12 @@ class MultiLayerNetwork:
                     pass
         for listener in self.listeners:
             listener.on_epoch_start(self)
-        if self.conf.backprop:
-            for ds in iterator:
-                self._fit_dispatch(ds)
+        with _obs.tracer.span("mln.fit", cat="train", epoch=self.epoch):
+            if self.conf.backprop:
+                for ds in iterator:
+                    self._fit_dispatch(ds)
         self.epoch += 1
+        _M_EPOCHS.inc()
         for listener in self.listeners:
             listener.on_epoch_end(self)
         return self
@@ -581,7 +610,22 @@ class MultiLayerNetwork:
     def _fit_dispatch(self, ds: DataSet):
         """tBPTT/plain dispatch + iterations loop for one staged batch —
         shared by `fit()` and `ParallelWrapper` so sharded training honors
-        the same backprop-type config."""
+        the same backprop-type config. Also the engine's observability
+        choke point: every training path (plain / tBPTT / solver, local or
+        sharded) stages batches through here, and `StepProfiler` patches
+        this method on the instance."""
+        _M_H2D.inc(_obs.host_nbytes(ds.features, ds.labels,
+                                    ds.features_mask, ds.labels_mask))
+        it0 = self.iteration
+        t0 = time.perf_counter()
+        with _obs.iteration_span("mln", it0 + 1):
+            try:
+                return self._fit_dispatch_inner(ds)
+            finally:
+                _M_DISPATCH.observe(time.perf_counter() - t0)
+                _M_ITERS.inc(max(0, self.iteration - it0))
+
+    def _fit_dispatch_inner(self, ds: DataSet):
         g = self.conf.global_conf
         algo = OptimizationAlgorithm.of(g.optimization_algo)
         if algo != OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT:

@@ -1,0 +1,198 @@
+"""Production observability core: metrics, tracing, step profiling.
+
+Three parts (see each module's docstring):
+
+- `metrics`   — process-global `MetricsRegistry` (Prometheus text + JSON)
+- `tracer`    — process-global `Tracer` (Chrome trace-event ring buffer)
+- `StepProfiler` — compile/execute/transfer/FLOPs split for one engine
+
+Scrape points: `UIServer` and `InferenceServer` both serve `/metrics`
+(Prometheus text) and the UIServer adds `/api/trace` (Chrome trace JSON —
+save it and open in ui.perfetto.dev). `bench.py` embeds `bench_snapshot()`
+into BENCH_out.json.
+
+Env knobs (read once at import):
+
+- `DL4J_TPU_OBS`              — "0"/"false"/"off" disables both the default
+                                registry and tracer (mutators become one
+                                bool check; spans become a shared no-op).
+- `DL4J_TPU_OBS_SAMPLE_EVERY` — record every Nth iteration span (default 1;
+                                metrics are never sampled, only spans).
+- `DL4J_TPU_TRACE_BUFFER`     — trace ring-buffer capacity (default 16384).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Optional
+
+from deeplearning4j_tpu.observability.metrics import (
+    DEFAULT_BUCKETS, MetricsRegistry, install_builtin_collectors)
+from deeplearning4j_tpu.observability.tracing import NOOP_SPAN, Tracer
+from deeplearning4j_tpu.observability.profiler import (
+    StepProfiler, chip_peak_flops, estimate_step_flops)
+
+__all__ = [
+    "metrics", "tracer", "config", "StepProfiler", "MetricsRegistry",
+    "Tracer", "DEFAULT_BUCKETS", "enable", "disable", "iteration_span",
+    "host_nbytes", "install_jax_compile_hook", "bench_snapshot",
+    "chip_peak_flops", "estimate_step_flops",
+]
+
+OBS_ENABLED = os.environ.get("DL4J_TPU_OBS", "1").lower() not in (
+    "0", "false", "off")
+
+
+class _Config:
+    """Mutable runtime knobs (import-time defaults from the environment)."""
+
+    def __init__(self):
+        try:
+            self.sample_every = max(
+                1, int(os.environ.get("DL4J_TPU_OBS_SAMPLE_EVERY", "1")))
+        except ValueError:
+            self.sample_every = 1
+
+
+config = _Config()
+
+# The process-global instruments. Hot-loop call sites resolve their labeled
+# children from `metrics` once at module import; `enable()`/`disable()` flip
+# both at runtime regardless of the env default.
+metrics = MetricsRegistry(enabled=OBS_ENABLED)
+install_builtin_collectors(metrics)
+tracer = Tracer(enabled=OBS_ENABLED)
+
+
+def enable() -> None:
+    metrics.enable()
+    tracer.enabled = True
+
+
+def disable() -> None:
+    metrics.disable()
+    tracer.enabled = False
+
+
+def iteration_span(engine: str, iteration: int, **args):
+    """Span for one training iteration, honoring `config.sample_every`.
+    Returns the shared no-op for sampled-out iterations so the hot loop
+    never allocates for them."""
+    if not tracer.enabled or iteration % config.sample_every:
+        return NOOP_SPAN
+    return tracer.span(f"{engine}.iteration", cat="train", engine=engine,
+                       iteration=iteration, **args)
+
+
+def host_nbytes(*parts) -> int:
+    """Total bytes of host-resident numpy arrays among `parts` (arrays,
+    lists/tuples of arrays, or None) — the host->device transfer cost of
+    staging them; device-resident jax arrays count 0."""
+    import numpy as np
+
+    total = 0
+    for part in parts:
+        if part is None:
+            continue
+        arrays = part if isinstance(part, (list, tuple)) else [part]
+        for a in arrays:
+            if isinstance(a, np.ndarray):
+                total += a.nbytes
+    return total
+
+
+# ------------------------------------------------------- XLA compile hook
+
+_hook_lock = threading.Lock()
+_hook_installed = False
+_hook_registries: list = []
+
+
+def install_jax_compile_hook(registry: Optional[MetricsRegistry] = None) -> bool:
+    """Feed `jax.monitoring` compile-duration events into the registry as
+    `dl4j_xla_compiles_total` and `dl4j_xla_compile_seconds_total{phase}`
+    (phase = trace / mlir / backend_compile...). The jax listener is
+    installed once per process; additional registries passed on later calls
+    are fanned out to. Returns True when the hook is (now) active."""
+    global _hook_installed
+    reg = registry or metrics
+    with _hook_lock:
+        if reg not in _hook_registries:
+            _hook_registries.append(reg)
+            reg.counter("dl4j_xla_compiles_total",
+                        "XLA backend compiles observed via jax.monitoring")
+            reg.counter("dl4j_xla_compile_seconds_total",
+                        "Seconds in jax compile pipeline phases",
+                        label_names=("phase",))
+        if _hook_installed:
+            return True
+        try:
+            from jax import monitoring
+        except Exception:
+            return False
+
+        def on_event(event: str, duration: float, **kw) -> None:
+            if not event.startswith("/jax/core/compile"):
+                return
+            # '/jax/core/compile/backend_compile_duration' -> 'backend_compile'
+            phase = event.rsplit("/", 1)[-1]
+            if phase.endswith("_duration"):
+                phase = phase[:-len("_duration")]
+            for r in _hook_registries:
+                r.counter("dl4j_xla_compile_seconds_total",
+                          label_names=("phase",)).labels(
+                              phase=phase).inc(duration)
+                if phase == "backend_compile":
+                    r.counter("dl4j_xla_compiles_total").inc()
+
+        try:
+            monitoring.register_event_duration_secs_listener(on_event)
+        except Exception:
+            return False
+        _hook_installed = True
+        return True
+
+
+# ------------------------------------------------------------ bench glue
+
+
+def bench_snapshot(registry: Optional[MetricsRegistry] = None) -> Dict[str, Any]:
+    """Compact observability summary for BENCH_out.json: step-latency
+    histogram summaries, compile totals, MFU, jit-cache hit/miss, transfer
+    and checkpoint byte counters. Safe to call with nothing recorded."""
+    reg = registry or metrics
+    out: Dict[str, Any] = {}
+
+    def family_values(name):
+        fam = reg.get_family(name)
+        if fam is None:
+            return None
+        vals = {}
+        for child in fam.children():
+            key = ",".join(f"{k}={v}" for k, v in child.labels.items()) or "_"
+            vals[key] = child.get()
+        return vals or None
+
+    for hist in ("dl4j_step_latency_seconds", "dl4j_step_dispatch_seconds",
+                 "dl4j_infer_latency_seconds", "dl4j_request_latency_seconds"):
+        fam = reg.get_family(hist)
+        if fam is None:
+            continue
+        for child in fam.children():
+            summary = child.summarize()
+            if not summary.get("count"):
+                continue
+            key = ",".join(f"{k}={v}" for k, v in child.labels.items())
+            out.setdefault(hist, {})[key or "_"] = summary
+    for name in ("dl4j_xla_compiles_total", "dl4j_xla_compile_seconds_total",
+                 "dl4j_jit_cache_hits_total", "dl4j_jit_cache_misses_total",
+                 "dl4j_host_to_device_bytes_total",
+                 "dl4j_checkpoint_bytes_written_total",
+                 "dl4j_profiler_compile_seconds",
+                 "dl4j_profiler_execute_seconds_median",
+                 "dl4j_train_flops_per_step", "dl4j_train_mfu"):
+        vals = family_values(name)
+        if vals:
+            out[name] = vals
+    return out

@@ -1,0 +1,309 @@
+"""StepProfiler: where did this step's milliseconds go?
+
+Wraps a live engine (`MultiLayerNetwork` / `ComputationGraph`) and splits
+the time of every staged-batch dispatch into the pieces BENCH rounds have
+had to eyeball from the outside:
+
+- **compile vs execute**: XLA compile durations are captured through
+  `jax.monitoring`'s event-duration hook (`/jax/core/compile/*` — the
+  lowering/compile pipeline reports itself), cross-checked against the
+  engines' jit-cache hit/miss counters; the first dispatch of each program
+  is recorded separately from steady-state dispatches.
+- **step latency**: each dispatch is (optionally) settled by fetching the
+  loss scalar — the only sync that is honest over high-latency tunneled
+  transports, see PERF.md §1.4 — and observed into the
+  `dl4j_step_latency_seconds` histogram. `sync=False` records dispatch
+  time only (does not perturb async pipelining, but under-reports).
+- **host->device transfer bytes**: counted from the host-resident arrays of
+  every dispatched batch (`dl4j_host_to_device_bytes_total`).
+- **FLOPs + MFU**: `lower().compile().cost_analysis()` on the engine's own
+  jitted train step gives FLOPs/step; divided by steady-state step time and
+  the chip's peak it becomes the `dl4j_train_mfu` gauge. On CPU there is no
+  peak table entry, so MFU is only reported when `DL4J_TPU_PEAK_FLOPS` /
+  `BENCH_PEAK_FLOPS` is set (see PERF.md §11 caveats).
+
+Usage::
+
+    from deeplearning4j_tpu.observability import StepProfiler
+
+    with StepProfiler(net) as prof:
+        net.fit(iterator)
+    print(prof.summary())   # and scrape /metrics for the histograms
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+
+def estimate_step_flops(net, ds) -> Optional[float]:
+    """XLA cost-analysis FLOPs of the engine's actual jitted train step for
+    one staged batch (`bench.py` delegates here). Returns None when the
+    backend does not report flops."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    try:
+        clock = (jnp.asarray(0.0, jnp.float32), jax.random.PRNGKey(0))
+        fn = net._get_jit("train_step")
+        if type(net).__name__ == "ComputationGraph":
+            feats = [jnp.asarray(np.asarray(f)) for f in ds.features]
+            labs = [jnp.asarray(np.asarray(l)) for l in ds.labels]
+            args = (net.params_tree, net.state, net.opt_state, feats, labs,
+                    None, None, clock)
+        else:
+            args = (net.params_tree, net.state, net.opt_state,
+                    jnp.asarray(np.asarray(ds.features)),
+                    jnp.asarray(np.asarray(ds.labels)), None, None, clock)
+        lowered = fn.lower(*args)
+        try:
+            cost = lowered.compile().cost_analysis()
+        except Exception:
+            cost = lowered.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        flops = float(cost.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception:
+        return None
+
+
+def chip_peak_flops() -> Optional[float]:
+    """Peak bf16 FLOPs/sec of the local accelerator (env override:
+    DL4J_TPU_PEAK_FLOPS / BENCH_PEAK_FLOPS). None on CPU / unknown chips —
+    callers must treat MFU as unavailable, not zero."""
+    env = os.environ.get("DL4J_TPU_PEAK_FLOPS") or os.environ.get(
+        "BENCH_PEAK_FLOPS")
+    if env:
+        return float(env)
+    try:
+        import jax
+
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return None
+    table = [
+        ("v5 lite", 197e12), ("v5e", 197e12),
+        ("v5p", 459e12), ("v5", 459e12),
+        ("v6", 918e12), ("trillium", 918e12),
+        ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
+    ]
+    for key, peak in table:
+        if key in kind:
+            return peak
+    return None
+
+
+class StepProfiler:
+    """See module docstring. Patches the engine instance's `_fit_dispatch`
+    (one call per staged batch on every path: plain / tBPTT / solver) and
+    `output` (inference latency) for the lifetime of the `with` block;
+    restores them on exit."""
+
+    def __init__(self, net, registry=None, tracer=None, sync: bool = True,
+                 peak_flops: Optional[float] = None):
+        from deeplearning4j_tpu import observability as obs
+
+        self.net = net
+        self.registry = registry or obs.metrics
+        self.tracer = tracer or obs.tracer
+        self.sync = bool(sync)
+        self.peak_flops = peak_flops
+        self.step_times: List[float] = []      # steady-state dispatches
+        self.first_step_times: List[float] = []  # compile-inclusive firsts
+        self.infer_times: List[float] = []
+        self.h2d_bytes = 0
+        self._last_ds = None
+        self._patched = False
+        reg = self.registry
+        self._m_latency = reg.histogram(
+            "dl4j_step_latency_seconds",
+            "Settled train-step latency measured under StepProfiler "
+            "(first compile-inclusive call excluded)")
+        self._m_first = reg.histogram(
+            "dl4j_step_first_call_seconds",
+            "First (compile-inclusive) dispatch of each jitted program "
+            "under StepProfiler", buckets=(0.1, 0.5, 1, 2.5, 5, 10, 30,
+                                           60, 120, 300))
+        self._m_infer = reg.histogram(
+            "dl4j_infer_latency_seconds",
+            "Settled output() latency measured under StepProfiler")
+        self._m_compile = reg.gauge(
+            "dl4j_profiler_compile_seconds",
+            "XLA compile seconds attributed to the profiled window")
+        self._m_execute = reg.gauge(
+            "dl4j_profiler_execute_seconds_median",
+            "Median steady-state step seconds in the profiled window")
+        self._m_flops = reg.gauge(
+            "dl4j_train_flops_per_step",
+            "XLA cost-analysis FLOPs of one jitted train step")
+        self._m_mfu = reg.gauge(
+            "dl4j_train_mfu",
+            "Model FLOPs utilization: flops/step / step_time / chip peak "
+            "(absent without a known peak — see PERF.md CPU caveats)")
+
+    # ------------------------------------------------------------ patching
+
+    def __enter__(self) -> "StepProfiler":
+        from deeplearning4j_tpu import observability as obs
+
+        obs.install_jax_compile_hook(self.registry)
+        self._compile_s0 = self._compile_seconds()
+        self._jit_known = len(self.net._jit_cache)
+        self._orig_dispatch = self.net._fit_dispatch
+        self._orig_output = self.net.output
+        net = self.net
+
+        def dispatch(ds, *a, **kw):
+            self._last_ds = ds
+            self.h2d_bytes += _host_nbytes(ds)
+            known = len(net._jit_cache)
+            t0 = time.perf_counter()
+            # No extra span here: the engine's own iteration span already
+            # covers the dispatch, and an extra wrapper would usurp its
+            # parentage in the trace.
+            out = self._orig_dispatch(ds, *a, **kw)
+            if self.sync:
+                _settle(net)
+            dt = time.perf_counter() - t0
+            if len(net._jit_cache) > known:
+                # This dispatch traced (and on first real call, compiled) a
+                # new program: keep it out of the steady-state histogram.
+                self.first_step_times.append(dt)
+                self._m_first.observe(dt)
+            else:
+                self.step_times.append(dt)
+                self._m_latency.observe(dt)
+            return out
+
+        def output(*a, **kw):
+            t0 = time.perf_counter()
+            result = self._orig_output(*a, **kw)
+            dt = time.perf_counter() - t0
+            self.infer_times.append(dt)
+            self._m_infer.observe(dt)
+            return result
+
+        self.net._fit_dispatch = dispatch
+        self.net.output = output
+        self._patched = True
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.stop()
+        return False
+
+    def stop(self) -> None:
+        if self._patched:
+            self.net._fit_dispatch = self._orig_dispatch
+            self.net.output = self._orig_output
+            self._patched = False
+        self._finalize()
+
+    # ----------------------------------------------------------- reporting
+
+    def _compile_seconds(self) -> float:
+        fam = self.registry.get_family("dl4j_xla_compile_seconds_total")
+        if fam is None:
+            return 0.0
+        return sum(c.get() for c in fam.children())
+
+    def compile_seconds(self) -> float:
+        """XLA compile seconds that elapsed inside the profiled window."""
+        return max(0.0, self._compile_seconds() - self._compile_s0)
+
+    def execute_seconds_median(self) -> Optional[float]:
+        if not self.step_times:
+            return None
+        return sorted(self.step_times)[len(self.step_times) // 2]
+
+    def _finalize(self) -> None:
+        compile_s = self.compile_seconds()
+        if not compile_s and self.first_step_times and self.step_times:
+            # No monitoring hook on this jax: fall back to first-call-minus-
+            # steady-state (documented as an estimate in summary()).
+            med = self.execute_seconds_median() or 0.0
+            compile_s = max(0.0, sum(self.first_step_times)
+                            - med * len(self.first_step_times))
+        self._m_compile.set(compile_s)
+        med = self.execute_seconds_median()
+        if med is not None:
+            self._m_execute.set(med)
+        flops = None
+        if self._last_ds is not None:
+            flops = estimate_step_flops(self.net, self._last_ds)
+        if flops:
+            self._m_flops.set(flops)
+            peak = self.peak_flops or chip_peak_flops()
+            if peak and med:
+                self._m_mfu.set(flops / med / peak)
+
+    def summary(self) -> Dict[str, Any]:
+        med = self.execute_seconds_median()
+        out: Dict[str, Any] = {
+            "steps": len(self.step_times) + len(self.first_step_times),
+            "first_call_steps": len(self.first_step_times),
+            "compile_seconds": self.compile_seconds() or self._m_compile.get(),
+            "execute_seconds_median": med,
+            "host_to_device_bytes": self.h2d_bytes,
+        }
+        if self.step_times:
+            s = sorted(self.step_times)
+            out["step_latency"] = {
+                "mean": sum(s) / len(s), "p50": s[len(s) // 2],
+                "min": s[0], "max": s[-1],
+                "sync": self.sync,
+            }
+        if self.infer_times:
+            s = sorted(self.infer_times)
+            out["infer_latency"] = {"mean": sum(s) / len(s),
+                                    "p50": s[len(s) // 2], "count": len(s)}
+        flops = self._m_flops.get()
+        if flops:
+            out["flops_per_step"] = flops
+            if med:
+                out["flops_per_sec"] = flops / med
+        mfu = self._m_mfu.get()
+        if mfu:
+            out["mfu"] = mfu
+        return out
+
+
+def _settle(net) -> None:
+    """Force completion of the dispatched step. Fetching the loss scalar is
+    the sync that works over every transport (block_until_ready does not
+    reliably wait on the tunneled TPU path — PERF.md §1.4); params are a
+    fallback for solver paths that leave `_score` as a host float."""
+    score = getattr(net, "_score", None)
+    try:
+        float(score)
+        return
+    except Exception:
+        pass
+    try:
+        import jax
+
+        jax.block_until_ready(net.params_tree)
+    except Exception:
+        pass
+
+
+def _host_nbytes(ds) -> int:
+    """Bytes of host-resident (numpy) arrays in a DataSet / MultiDataSet —
+    the batch's host->device transfer cost; device-resident arrays count 0."""
+    import numpy as np
+
+    total = 0
+    for name in ("features", "labels", "features_mask", "labels_mask",
+                 "features_masks", "labels_masks"):
+        part = getattr(ds, name, None)
+        if part is None:
+            continue
+        arrays = part if isinstance(part, (list, tuple)) else [part]
+        for a in arrays:
+            if isinstance(a, np.ndarray):
+                total += a.nbytes
+    return total

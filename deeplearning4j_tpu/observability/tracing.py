@@ -1,0 +1,150 @@
+"""Span tracing: context manager + decorator, thread-local stack, bounded
+ring buffer, Chrome trace-event JSON export.
+
+Answers the question the metrics registry can't: not "how many / how long on
+average" but "what nested inside what, when" — fit -> iteration ->
+checkpoint.save, or serving.batch next to request spans on another thread.
+The export is the Chrome trace-event format (`ph`/`ts`/`dur`/`pid`/`tid`),
+loadable in Perfetto (ui.perfetto.dev) or `chrome://tracing`; capture it
+live from a running system via the UIServer's `/api/trace` route.
+
+The buffer is a bounded `deque` (ring): a long-running server keeps the most
+recent `max_events` spans and never grows without bound. Span begin/end is a
+perf_counter_ns read + a deque append — cheap enough for per-iteration spans
+at training cadence; `DL4J_TPU_OBS_SAMPLE_EVERY` thins them further (see
+`observability.iteration_span`).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+
+class _NoopSpan:
+    """Shared reusable no-op (disabled tracer / sampled-out iteration)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set_attr(self, **kv):
+        pass
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class _Span:
+    __slots__ = ("_tracer", "name", "cat", "args", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str,
+                 args: Dict[str, Any]):
+        self._tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.args = args
+
+    def set_attr(self, **kv) -> None:
+        self.args.update(kv)
+
+    def __enter__(self) -> "_Span":
+        tls = self._tracer._tls
+        stack = getattr(tls, "stack", None)
+        if stack is None:
+            stack = tls.stack = []
+        stack.append(self.name)
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        dur_ns = time.perf_counter_ns() - self._t0
+        tracer = self._tracer
+        stack = tracer._tls.stack
+        stack.pop()
+        if stack:
+            self.args.setdefault("parent", stack[-1])
+        if exc_type is not None:
+            self.args["error"] = exc_type.__name__
+        tracer._events.append({
+            "name": self.name,
+            "cat": self.cat,
+            "ph": "X",
+            "ts": (self._t0 - tracer._epoch_ns) / 1000.0,  # µs
+            "dur": dur_ns / 1000.0,
+            "pid": tracer._pid,
+            "tid": threading.get_ident() & 0x7FFFFFFF,
+            "args": self.args,
+        })
+        return False
+
+
+class Tracer:
+    """See module docstring."""
+
+    def __init__(self, max_events: Optional[int] = None, enabled: bool = True):
+        if max_events is None:
+            max_events = int(os.environ.get("DL4J_TPU_TRACE_BUFFER", "16384"))
+        self.enabled = bool(enabled)
+        self._events: deque = deque(maxlen=max(16, int(max_events)))
+        self._tls = threading.local()
+        self._epoch_ns = time.perf_counter_ns()
+        self._pid = os.getpid()
+
+    # ------------------------------------------------------------------ api
+
+    def span(self, name: str, cat: str = "dl4j", **args):
+        if not self.enabled:
+            return NOOP_SPAN
+        return _Span(self, name, cat, args)
+
+    def trace(self, name: Optional[str] = None, cat: str = "dl4j"):
+        """Decorator form: `@tracer.trace("checkpoint.write")`."""
+
+        def wrap(fn):
+            span_name = name or fn.__qualname__
+
+            @functools.wraps(fn)
+            def inner(*a, **kw):
+                with self.span(span_name, cat=cat):
+                    return fn(*a, **kw)
+
+            return inner
+
+        return wrap
+
+    def instant(self, name: str, cat: str = "dl4j", **args) -> None:
+        """Point-in-time marker (ph "i"), e.g. a checkpoint COMMIT."""
+        if not self.enabled:
+            return
+        self._events.append({
+            "name": name, "cat": cat, "ph": "i", "s": "t",
+            "ts": (time.perf_counter_ns() - self._epoch_ns) / 1000.0,
+            "pid": self._pid,
+            "tid": threading.get_ident() & 0x7FFFFFFF,
+            "args": args,
+        })
+
+    # --------------------------------------------------------------- export
+
+    def events(self) -> List[dict]:
+        return list(self._events)
+
+    def export_chrome(self) -> Dict[str, Any]:
+        """The dict form of a Chrome trace file: json.dump it and open in
+        Perfetto. `displayTimeUnit` only affects the UI's default zoom."""
+        return {"traceEvents": self.events(), "displayTimeUnit": "ms"}
+
+    def clear(self) -> None:
+        self._events.clear()
+
+    def resize(self, max_events: int) -> None:
+        self._events = deque(self._events, maxlen=max(16, int(max_events)))

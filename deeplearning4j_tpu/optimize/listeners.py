@@ -48,12 +48,23 @@ class ScoreIterationListener(IterationListener):
 
 class PerformanceListener(IterationListener):
     """Samples/sec + batches/sec over the report interval (reference:
-    `PerformanceListener.java:86-102` — the BASELINE.md metric semantics)."""
+    `PerformanceListener.java:86-102` — the BASELINE.md metric semantics).
+
+    JAX dispatch is asynchronous: by default the wall clock here measures
+    DISPATCH rate, which can flatter the numbers while the device still has
+    queued steps. Pass `sync=True` to settle the in-flight step (fetch the
+    loss scalar — the sync that works over every transport, PERF.md §1.4)
+    before sampling the clock; this is honest but serializes the pipeline,
+    so use it for measurement runs, not production training. Rates for an
+    interval with no `record_batch` calls are reported as NaN, never carried
+    over stale from a previous interval."""
 
     def __init__(self, frequency: int = 1, report_score: bool = False,
-                 out: Optional[Callable[[str], None]] = None):
+                 out: Optional[Callable[[str], None]] = None,
+                 sync: bool = False):
         self.frequency = max(1, int(frequency))
         self.report_score = report_score
+        self.sync = bool(sync)
         self.out = out or (lambda s: logger.info(s))
         self._last_time = None
         self._last_iter = 0
@@ -64,7 +75,18 @@ class PerformanceListener(IterationListener):
     def record_batch(self, num_samples: int) -> None:
         self._samples_since += int(num_samples)
 
+    def _settle(self, model) -> None:
+        score = getattr(model, "_score", None)
+        if score is None:
+            return
+        try:
+            float(score)
+        except Exception:
+            pass
+
     def iteration_done(self, model, iteration: int) -> None:
+        if self.sync:
+            self._settle(model)
         now = time.perf_counter()
         if self._last_time is None:
             self._last_time = now
@@ -75,8 +97,9 @@ class PerformanceListener(IterationListener):
         dt = now - self._last_time
         batches = iteration - self._last_iter
         self.last_batches_per_sec = batches / dt if dt > 0 else float("nan")
-        if self._samples_since:
-            self.last_samples_per_sec = self._samples_since / dt if dt > 0 else float("nan")
+        self.last_samples_per_sec = (
+            self._samples_since / dt if self._samples_since and dt > 0
+            else float("nan"))
         msg = (f"iteration {iteration}: {self.last_batches_per_sec:.2f} batches/sec"
                + (f", {self.last_samples_per_sec:.2f} samples/sec" if self._samples_since else ""))
         if self.report_score:

@@ -13,6 +13,7 @@ and no updater-state divergence to repair (`:198-225`).
 
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 import jax
@@ -22,6 +23,18 @@ import numpy as np
 from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.parallel import mesh as mesh_mod
 from deeplearning4j_tpu.parallel.context import parallel_context
+from deeplearning4j_tpu import observability as _obs
+
+_M_BATCHES = _obs.metrics.counter(
+    "dl4j_parallel_batches_total",
+    "Batches sharded and dispatched through ParallelWrapper.fit")
+_M_SHARD_SECONDS = _obs.metrics.counter(
+    "dl4j_parallel_shard_dispatch_seconds_total",
+    "Host seconds spent padding + device_put-sharding batches over the mesh "
+    "(the host-side proxy for data distribution cost; in-step collective "
+    "wait is inside XLA and not host-visible — see PERF.md)")
+_M_DEVICES = _obs.metrics.gauge(
+    "dl4j_parallel_devices", "Mesh size of the active ParallelWrapper")
 
 
 class ParallelWrapper:
@@ -57,6 +70,7 @@ class ParallelWrapper:
         self.context = ParallelContext(
             mesh=mesh, data_axis=self.data_axis, model_axis=model_axis,
             seq_axis=seq_axis, expert_axis=expert_axis)
+        _M_DEVICES.set(self.n_devices)
 
     def _pad_dataset(self, ds: DataSet) -> DataSet:
         """Pad the batch dim up to a multiple of the mesh size (XLA needs the
@@ -141,6 +155,7 @@ class ParallelWrapper:
         if isinstance(iterator, (DataSet, MultiDataSet)):
             iterator = [iterator]
         for ds in iterator:
+            t0 = time.perf_counter()
             if is_graph:
                 mds = MultiDataSet.from_dataset(ds) if isinstance(ds, DataSet) else ds
                 padded = self._pad_mds(mds)
@@ -164,8 +179,13 @@ class ParallelWrapper:
                     self._shard(padded.features_mask),
                     self._shard(padded.labels_mask),
                 )
-            with parallel_context(getattr(self, "context", None)):
-                net._fit_dispatch(sharded)
+            _M_SHARD_SECONDS.inc(time.perf_counter() - t0)
+            _M_BATCHES.inc()
+            with _obs.tracer.span("parallel.batch", cat="parallel",
+                                  devices=self.n_devices,
+                                  data_axis=self.data_axis):
+                with parallel_context(getattr(self, "context", None)):
+                    net._fit_dispatch(sharded)
         return net
 
     def evaluate(self, iterator, top_n: int = 1):

@@ -11,7 +11,9 @@ semantics are:
 - request MICRO-BATCHING: concurrent requests are coalesced and padded to
   one fixed `max_batch_size` so the jitted forward compiles exactly once
   and the MXU sees full batches (the TPU reason to batch at all);
-- `GET /health` liveness probe.
+- `GET /health` liveness probe;
+- `GET /metrics` Prometheus scrape of the process-global registry
+  (request-latency + batch-size histograms, queue-depth gauge — PERF.md §11).
 """
 
 from __future__ import annotations
@@ -19,10 +21,27 @@ from __future__ import annotations
 import json
 import queue
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional, Tuple
 
 import numpy as np
+
+from deeplearning4j_tpu import observability as _obs
+
+_M_REQUESTS = _obs.metrics.counter(
+    "dl4j_serving_requests_total", "predict() requests",
+    label_names=("outcome",))
+_M_REQ_LATENCY = _obs.metrics.histogram(
+    "dl4j_request_latency_seconds",
+    "End-to-end predict() latency (queue wait + batch + forward)")
+_M_BATCH_SIZE = _obs.metrics.histogram(
+    "dl4j_serving_batch_size",
+    "Real (pre-padding) rows per coalesced inference batch",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512))
+_M_QUEUE_DEPTH = _obs.metrics.gauge(
+    "dl4j_serving_queue_depth",
+    "Requests waiting in the batcher queue (scrape-time)")
 
 
 class _Pending:
@@ -78,19 +97,23 @@ class InferenceServer:
         counts = [r.shape[0] for r in rows]
         x = np.concatenate(rows, axis=0)
         n = x.shape[0]
+        _M_BATCH_SIZE.observe(n)
         if n < self.max_batch_size:
             # Pad to the fixed compile shape; padded rows are discarded.
             pad = np.zeros((self.max_batch_size - n,) + x.shape[1:], x.dtype)
             x = np.concatenate([x, pad], axis=0)
-        try:
-            preds = np.asarray(self.net.output(x))[:n]
-            off = 0
-            for p, c in zip(pending, counts):
-                p.result = preds[off:off + c]
-                off += c
-        except Exception as e:  # surface the failure to every caller
-            for p in pending:
-                p.error = f"{type(e).__name__}: {e}"
+        with _obs.tracer.span("serving.batch", cat="serving",
+                              requests=len(pending), rows=n,
+                              padded_to=int(x.shape[0])):
+            try:
+                preds = np.asarray(self.net.output(x))[:n]
+                off = 0
+                for p, c in zip(pending, counts):
+                    p.result = preds[off:off + c]
+                    off += c
+            except Exception as e:  # surface the failure to every caller
+                for p in pending:
+                    p.error = f"{type(e).__name__}: {e}"
         for p in pending:
             p.event.set()
 
@@ -128,12 +151,24 @@ class InferenceServer:
             self._run_batch(batch)
 
     def predict(self, data) -> np.ndarray:
-        """In-process entry (the HTTP handler calls this too)."""
-        arr = np.asarray(data, np.float32)
+        """In-process entry (the HTTP handler calls this too). Observed once
+        per caller request into `dl4j_request_latency_seconds`, however many
+        server-sized chunks it splits into."""
+        t0 = time.perf_counter()
+        try:
+            result = self._predict_rows(np.asarray(data, np.float32))
+        except Exception:
+            _M_REQUESTS.labels(outcome="error").inc()
+            raise
+        _M_REQUESTS.labels(outcome="ok").inc()
+        _M_REQ_LATENCY.observe(time.perf_counter() - t0)
+        return result
+
+    def _predict_rows(self, arr: np.ndarray) -> np.ndarray:
         if arr.shape[0] > self.max_batch_size:
             # Split oversized requests into server-sized chunks.
             return np.concatenate([
-                self.predict(arr[i:i + self.max_batch_size])
+                self._predict_rows(arr[i:i + self.max_batch_size])
                 for i in range(0, arr.shape[0], self.max_batch_size)])
         p = _Pending(arr)
         self._queue.put(p)
@@ -168,8 +203,18 @@ class InferenceServer:
                 if self.path == "/health":
                     self._json({"status": "ok",
                                 "model": type(server.net).__name__})
+                elif self.path == "/metrics":
+                    body = _obs.metrics.to_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 else:
-                    self._json({"error": "not found"}, 404)
+                    self._json({"error": "not found",
+                                "routes": ["/health", "/metrics",
+                                           "/predict"]}, 404)
 
             def do_POST(self):
                 if self.path != "/predict":
@@ -187,6 +232,7 @@ class InferenceServer:
         return Handler
 
     def start(self) -> "InferenceServer":
+        _M_QUEUE_DEPTH.set_function(self._queue.qsize)
         self._batcher = threading.Thread(target=self._batch_loop, daemon=True)
         self._batcher.start()
         self._httpd = ThreadingHTTPServer((self.host, self.port),
@@ -202,6 +248,7 @@ class InferenceServer:
         return f"http://{self.host}:{self.port}"
 
     def stop(self) -> None:
+        _M_QUEUE_DEPTH.set_function(None)
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()

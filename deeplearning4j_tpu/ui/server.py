@@ -28,6 +28,14 @@ attaches to a `StatsStorage` and serves
 - `/activations`         — convolutional activation grids from the latest
                            `ConvolutionalListener` sample (reference
                            `ui/module/convolutional/ConvolutionalListenerModule`)
+- `/metrics`             — Prometheus text scrape of the process-global
+                           observability registry (no reference equivalent;
+                           PERF.md §11)
+- `/api/trace`           — the span tracer's ring buffer as Chrome
+                           trace-event JSON: save the body to a file and
+                           open it in ui.perfetto.dev
+- `/api`                 — route index (machine-readable version of this
+                           docstring)
 - `POST /remote`         — remote-receiver endpoint for
                            `RemoteStatsStorageRouter` (reference
                            `RemoteReceiverModule`); enable with
@@ -574,8 +582,32 @@ class _Handler(BaseHTTPRequestHandler):
         elif url.path == "/api/updates":
             ups = storage.get_updates(sid) if storage and sid else []
             self._json(ups)
+        elif url.path == "/metrics":
+            from deeplearning4j_tpu import observability as obs
+
+            body = obs.metrics.to_prometheus().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif url.path == "/api/trace":
+            from deeplearning4j_tpu import observability as obs
+
+            self._json(obs.tracer.export_chrome())
+        elif url.path == "/api":
+            self._json({"routes": _ROUTES})
         else:
-            self._json({"error": "not found"}, 404)
+            self._json({"error": "not found", "routes": _ROUTES}, 404)
+
+
+# Route index served by /api and echoed in 404 bodies.
+_ROUTES = [
+    "/", "/histogram", "/model", "/system", "/flow", "/tsne",
+    "/activations", "/metrics", "/api", "/api/sessions", "/api/static",
+    "/api/updates", "/api/tsne", "/api/trace", "POST /remote",
+    "POST /api/tsne",
+]
 
 
 class UIServer:

@@ -412,3 +412,370 @@ def test_tsne_post_gated_by_enable_remote():
         assert ei.value.code == 403
     finally:
         server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Observability core (deeplearning4j_tpu/observability/): metrics registry,
+# span tracing, StepProfiler, and the wired-through endpoints.
+# ---------------------------------------------------------------------------
+
+
+def _http_get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.read()
+
+
+class TestMetricsRegistry:
+    def test_counter_gauge_histogram_values(self):
+        from deeplearning4j_tpu.observability.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        c = reg.counter("req_total", "requests", label_names=("code",))
+        c.labels(code="200").inc()
+        c.labels(code="200").inc(2)
+        c.labels(code="500").inc()
+        assert c.labels(code="200").get() == 3
+        assert c.labels(code="500").get() == 1
+        g = reg.gauge("depth", "queue depth")
+        g.set(7)
+        assert g.get() == 7
+        h = reg.histogram("lat", "latency", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 5.0):
+            h.observe(v)
+        buckets, cum, s, count = h._only().histogram_state()
+        assert buckets == (0.1, 1.0)
+        assert cum == [1, 2, 3] and count == 3
+        assert abs(s - 5.55) < 1e-9
+
+    def test_prometheus_text_format_conformance(self):
+        """Text format 0.0.4: HELP/TYPE lines, escaped label values,
+        cumulative _bucket series ending at +Inf == _count, _sum/_count."""
+        from deeplearning4j_tpu.observability.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        c = reg.counter("odd_total", "counts odd things",
+                        label_names=("name",))
+        c.labels(name='a"b\\c\nd').inc()
+        h = reg.histogram("lat_seconds", "latency", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5)
+        text = reg.to_prometheus()
+        lines = text.strip().split("\n")
+        assert "# HELP odd_total counts odd things" in lines
+        assert "# TYPE odd_total counter" in lines
+        # Escaping: backslash, double-quote, newline within the label value.
+        assert 'odd_total{name="a\\"b\\\\c\\nd"} 1' in lines
+        assert "# TYPE lat_seconds histogram" in lines
+        assert 'lat_seconds_bucket{le="0.1"} 1' in lines
+        assert 'lat_seconds_bucket{le="1"} 2' in lines
+        assert 'lat_seconds_bucket{le="+Inf"} 2' in lines
+        assert any(l.startswith("lat_seconds_sum ") for l in lines)
+        assert "lat_seconds_count 2" in lines
+        # Buckets are cumulative and non-decreasing.
+        vals = [int(l.rsplit(" ", 1)[1]) for l in lines
+                if l.startswith("lat_seconds_bucket")]
+        assert vals == sorted(vals)
+
+    def test_family_dedupe_and_kind_mismatch(self):
+        from deeplearning4j_tpu.observability.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        a = reg.counter("x_total", "x", label_names=("k",))
+        b = reg.counter("x_total", "ignored", label_names=("k",))
+        assert a is b
+        with pytest.raises(ValueError):
+            reg.gauge("x_total")
+        with pytest.raises(ValueError):
+            reg.counter("x_total", label_names=("other",))
+        with pytest.raises(ValueError):
+            reg.counter("bad name")
+        with pytest.raises(ValueError):
+            a.labels(wrong="v")
+
+    def test_json_snapshot_and_summary(self):
+        from deeplearning4j_tpu.observability.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        h = reg.histogram("s_seconds", "steps", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.2, 0.3, 2.0):
+            h.observe(v)
+        snap = reg.to_json()
+        series = snap["s_seconds"]["series"][0]
+        assert series["count"] == 4
+        summary = series["summary"]
+        assert summary["count"] == 4 and summary["mean"] == pytest.approx(
+            2.55 / 4)
+        assert 0 < summary["p50"] <= 1.0
+        reg.reset()  # values drop to zero; the family itself survives
+        assert reg.to_json()["s_seconds"]["series"][0]["count"] == 0
+
+    def test_scrape_time_gauge_and_collector(self):
+        from deeplearning4j_tpu.observability.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        g = reg.gauge("fn_gauge", "from function")
+        g.set_function(lambda: 42.0)
+        calls = []
+        reg.register_collector(lambda r: calls.append(1))
+        text = reg.to_prometheus()
+        assert "fn_gauge 42" in text
+        assert calls  # collector ran at scrape
+
+    def test_disabled_registry_records_nothing(self):
+        from deeplearning4j_tpu.observability.metrics import MetricsRegistry
+
+        reg = MetricsRegistry(enabled=False)
+        c = reg.counter("n_total")
+        c.inc(5)
+        h = reg.histogram("h_seconds")
+        h.observe(1.0)
+        assert c.get() == 0
+        assert h._only().histogram_state()[3] == 0
+
+
+class TestDisabledOverhead:
+    def test_noop_path_is_cheap(self):
+        """The ISSUE 2 bar: a disabled registry/tracer adds < a few µs per
+        call. Budget is generous (10µs) for noisy shared CI machines; the
+        real cost is one attribute load + bool check (~0.1µs)."""
+        import time as _t
+
+        from deeplearning4j_tpu.observability.metrics import MetricsRegistry
+        from deeplearning4j_tpu.observability.tracing import Tracer
+
+        reg = MetricsRegistry(enabled=False)
+        c = reg.counter("o_total")
+        h = reg.histogram("o_seconds")
+        tr = Tracer(enabled=False)
+        n = 20000
+        t0 = _t.perf_counter()
+        for _ in range(n):
+            c.inc()
+            h.observe(0.5)
+            with tr.span("x"):
+                pass
+        per_call = (_t.perf_counter() - t0) / (3 * n)
+        assert per_call < 10e-6, f"{per_call * 1e6:.2f}µs per disabled call"
+        assert c.get() == 0 and not tr.events()
+
+
+class TestTracing:
+    def test_nested_spans_and_chrome_export(self):
+        from deeplearning4j_tpu.observability.tracing import Tracer
+
+        tr = Tracer()
+        with tr.span("outer", cat="test"):
+            with tr.span("inner", cat="test", k="v"):
+                pass
+        doc = tr.export_chrome()
+        # Valid Chrome trace JSON: round-trips and has the required fields.
+        doc2 = json.loads(json.dumps(doc))
+        assert doc2["traceEvents"]
+        by_name = {e["name"]: e for e in doc2["traceEvents"]}
+        inner, outer = by_name["inner"], by_name["outer"]
+        for e in (inner, outer):
+            assert e["ph"] == "X"
+            assert isinstance(e["ts"], float) and isinstance(e["dur"], float)
+            assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        assert inner["args"]["parent"] == "outer"
+        assert inner["args"]["k"] == "v"
+        assert "parent" not in outer["args"]
+        # Inner is contained within outer on the timeline.
+        assert outer["ts"] <= inner["ts"]
+        assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+
+    def test_ring_buffer_bounded(self):
+        from deeplearning4j_tpu.observability.tracing import Tracer
+
+        tr = Tracer(max_events=16)
+        for i in range(100):
+            with tr.span(f"s{i}"):
+                pass
+        events = tr.events()
+        assert len(events) == 16
+        assert events[-1]["name"] == "s99"  # newest kept, oldest dropped
+
+    def test_decorator_error_attr_and_instant(self):
+        from deeplearning4j_tpu.observability.tracing import Tracer
+
+        tr = Tracer()
+
+        @tr.trace("worker")
+        def work():
+            return 5
+
+        assert work() == 5
+        with pytest.raises(ValueError):
+            with tr.span("boom"):
+                raise ValueError("x")
+        tr.instant("marker", note="here")
+        names = {e["name"]: e for e in tr.events()}
+        assert "worker" in names
+        assert names["boom"]["args"]["error"] == "ValueError"
+        assert names["marker"]["ph"] == "i"
+
+
+class TestComposableEpochHooks:
+    def test_composable_fans_out_epoch_hooks(self, rng):
+        """Regression lock for the ISSUE 2 satellite: composed listeners
+        must see on_epoch_start/on_epoch_end, not just iteration_done."""
+        from deeplearning4j_tpu.optimize.listeners import (
+            ComposableIterationListener,
+        )
+
+        class Recorder(IterationListener):
+            def __init__(self):
+                self.calls = []
+
+            def iteration_done(self, model, iteration):
+                self.calls.append("iter")
+
+            def on_epoch_start(self, model):
+                self.calls.append("start")
+
+            def on_epoch_end(self, model):
+                self.calls.append("end")
+
+        a, b = Recorder(), Recorder()
+        net = mlp_net()
+        net.set_listeners(ComposableIterationListener(a, b))
+        x, y = batch(rng)
+        net.fit(DataSet(x, y))
+        for r in (a, b):
+            assert r.calls == ["start", "iter", "end"]
+
+
+class TestPerformanceListenerHonesty:
+    def test_no_stale_samples_per_sec(self, rng):
+        """An interval without record_batch must report NaN, not the
+        previous interval's number."""
+        from deeplearning4j_tpu.optimize.listeners import PerformanceListener
+
+        listener = PerformanceListener(frequency=1, out=lambda s: None)
+        net = mlp_net()
+        net.set_listeners(listener)
+        x, y = batch(rng)
+        net.fit(DataSet(x, y))  # primes the clock
+        listener.record_batch(16)
+        net.fit(DataSet(x, y))
+        assert listener.last_samples_per_sec > 0
+        net.fit(DataSet(x, y))  # no record_batch this interval
+        assert np.isnan(listener.last_samples_per_sec)
+        assert listener.last_batches_per_sec > 0  # still per-iteration
+
+    def test_sync_knob_settles_before_sampling(self, rng):
+        from deeplearning4j_tpu.optimize.listeners import PerformanceListener
+
+        msgs = []
+        listener = PerformanceListener(frequency=1, sync=True,
+                                       out=msgs.append)
+        net = mlp_net()
+        net.set_listeners(listener)
+        x, y = batch(rng)
+        for _ in range(3):
+            net.fit(DataSet(x, y))
+        assert msgs and listener.last_batches_per_sec > 0
+
+
+class TestStepProfilerAcceptance:
+    def test_smoke_run_metrics_and_trace(self, rng, tmp_path):
+        """The ISSUE 2 acceptance smoke: fit a small MLP under StepProfiler
+        with an in-fit checkpoint save, serve a request through
+        InferenceServer, then assert the /metrics scrape carries the
+        step-latency histogram, the compile-vs-execute split, checkpoint
+        bytes, and request latency — and the exported trace nests
+        fit -> iteration -> checkpoint."""
+        from deeplearning4j_tpu import observability as obs
+        from deeplearning4j_tpu.checkpoint import CheckpointManager
+        from deeplearning4j_tpu.observability import StepProfiler
+        from deeplearning4j_tpu.serving import InferenceServer
+
+        obs.tracer.clear()
+        net = mlp_net()
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), async_save=False)
+
+        class SaveAt(IterationListener):
+            def iteration_done(self, model, iteration):
+                if iteration == 3:
+                    mgr.save(model, step=iteration)
+
+        net.set_listeners(SaveAt())
+        x, y = batch(rng)
+        with StepProfiler(net, sync=True) as prof:
+            for _ in range(5):
+                net.fit(DataSet(x, y))
+        summary = prof.summary()
+        assert summary["steps"] == 5
+        assert summary["first_call_steps"] >= 1
+        assert summary["compile_seconds"] > 0
+        assert summary["execute_seconds_median"] > 0
+        assert summary["host_to_device_bytes"] > 0
+
+        server = InferenceServer(net, port=0).start()
+        try:
+            req = urllib.request.Request(
+                server.url + "/predict",
+                data=json.dumps({"data": x[:4].tolist()}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                preds = json.loads(r.read())["predictions"]
+            assert len(preds) == 4
+            status, body = _http_get(server.url + "/metrics")
+        finally:
+            server.stop()
+        assert status == 200
+        scrape = body.decode()
+        for needle in (
+                "dl4j_step_latency_seconds_bucket",       # step histogram
+                "dl4j_profiler_compile_seconds",          # compile vs ...
+                "dl4j_profiler_execute_seconds_median",   # ... execute split
+                "dl4j_checkpoint_bytes_written_total",    # checkpoint bytes
+                "dl4j_request_latency_seconds_bucket",    # request histogram
+                "dl4j_serving_batch_size_bucket",
+                'dl4j_jit_cache_misses_total{engine="mln"}',
+                "dl4j_train_flops_per_step",
+        ):
+            assert needle in scrape, f"missing {needle} in /metrics"
+
+        doc = json.loads(json.dumps(obs.tracer.export_chrome()))
+        events = doc["traceEvents"]
+        assert events
+        for e in events:
+            assert e["ph"] in ("X", "i")
+            assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        edges = {(e["name"], e["args"].get("parent")) for e in events
+                 if e["ph"] == "X"}
+        assert ("mln.iteration", "mln.fit") in edges
+        assert ("checkpoint.snapshot", "mln.iteration") in edges
+        assert ("checkpoint.write", "mln.iteration") in edges
+        assert any(e["name"] == "serving.batch" for e in events)
+
+
+class TestUIServerObsRoutes:
+    def test_metrics_trace_and_route_index(self):
+        from deeplearning4j_tpu import observability as obs
+
+        obs.metrics.counter("ui_probe_total", "probe").inc()
+        with obs.tracer.span("ui.probe"):
+            pass
+        server = UIServer(port=0).attach(InMemoryStatsStorage()).start()
+        base = server.url.rstrip("/")
+        try:
+            status, body = _http_get(base + "/metrics")
+            assert status == 200
+            assert "# TYPE ui_probe_total counter" in body.decode()
+            status, body = _http_get(base + "/api/trace")
+            doc = json.loads(body)
+            assert any(e["name"] == "ui.probe" for e in doc["traceEvents"])
+            status, body = _http_get(base + "/api")
+            routes = json.loads(body)["routes"]
+            assert "/metrics" in routes and "/api/trace" in routes
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(base + "/definitely/not/a/route",
+                                       timeout=5)
+            assert ei.value.code == 404
+            nf = json.loads(ei.value.read())
+            assert nf["error"] == "not found"
+            assert "/metrics" in nf["routes"]  # 404s advertise the index
+        finally:
+            server.stop()
