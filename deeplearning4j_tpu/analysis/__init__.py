@@ -1,0 +1,54 @@
+"""tpulint — JAX/TPU-aware static analysis + runtime strict-mode guards.
+
+The reference DL4J stack validated configuration on the JVM side
+(`MultiLayerConfiguration` sanity checks) before any native kernel ran.
+This package is the JAX port's equivalent, split in two:
+
+- **Static** (`linter.py`, `rules.py`): an AST pass over every module in
+  the package with framework-aware rules (JX001-JX006) for the failure
+  modes that are *silent* on TPU — host syncs inside traced code, Python
+  side effects baked in at trace time, retrace storms, accidental
+  float64, unlocked cross-thread mutation, dtype-sniffing on user input.
+  Run it with ``python -m deeplearning4j_tpu.analysis`` (or the
+  ``tpulint`` console script); findings are suppressible inline
+  (``# tpulint: disable=JX001``) or grandfathered in a checked-in
+  baseline where every entry carries a reason.
+
+- **Runtime** (`runtime.py`): ``strict_mode()`` wraps a step body in
+  ``jax.transfer_guard("disallow")``; ``RetraceGuard`` fires when one
+  function compiles more than N times (wired to the engines' jit-cache
+  counters from the observability core); ``install_nan_guard`` hooks the
+  engines' ``_fit_dispatch`` to fail fast on a NaN loss.
+
+Tier-1 runs the full-package lint (`tests/test_static_analysis.py`), so a
+new violation fails CI before it costs a TPU hour.
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.analysis.findings import Finding, Severity
+from deeplearning4j_tpu.analysis.rules import ALL_RULES, Rule, get_rules
+from deeplearning4j_tpu.analysis.linter import (
+    DEFAULT_BASELINE_PATH,
+    Baseline,
+    fingerprint,
+    lint_file,
+    lint_package,
+    lint_paths,
+    lint_source,
+)
+from deeplearning4j_tpu.analysis.runtime import (
+    RetraceError,
+    RetraceGuard,
+    install_nan_guard,
+    strict_enabled,
+    strict_mode,
+)
+
+__all__ = [
+    "Finding", "Severity", "Rule", "ALL_RULES", "get_rules",
+    "lint_source", "lint_file", "lint_paths", "lint_package",
+    "Baseline", "fingerprint", "DEFAULT_BASELINE_PATH",
+    "strict_mode", "strict_enabled", "RetraceGuard", "RetraceError",
+    "install_nan_guard",
+]

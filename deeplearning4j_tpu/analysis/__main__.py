@@ -1,0 +1,98 @@
+"""`python -m deeplearning4j_tpu.analysis` / `tpulint` CLI.
+
+Prints findings and exits non-zero on any *new* (non-baseline) violation
+or on a baseline entry without a reason — the contract tier-1 enforces.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from deeplearning4j_tpu.analysis.findings import Severity
+from deeplearning4j_tpu.analysis.linter import (
+    DEFAULT_BASELINE_PATH, Baseline, lint_package, lint_paths,
+)
+from deeplearning4j_tpu.analysis.rules import ALL_RULES
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpulint",
+        description="JAX/TPU-aware static analysis for deeplearning4j_tpu")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the whole package)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE_PATH,
+                    help="baseline JSON of grandfathered findings")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignore the baseline")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from current findings "
+                         "(existing reasons are preserved by fingerprint)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable JSON output")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid in sorted(ALL_RULES):
+            print(f"{rid}  {ALL_RULES[rid].description}")
+        return 0
+
+    rules = args.rules.split(",") if args.rules else None
+    unknown = [r for r in (rules or []) if r not in ALL_RULES]
+    if unknown:
+        print(f"tpulint: unknown rule(s): {', '.join(unknown)}",
+              file=sys.stderr)
+        return 2
+
+    findings = (lint_paths(args.paths, rules) if args.paths
+                else lint_package(rules))
+
+    baseline = (Baseline([]) if args.no_baseline
+                else Baseline.load(args.baseline))
+    if args.write_baseline:
+        Baseline.from_findings(findings, previous=baseline).save(
+            args.baseline)
+        print(f"tpulint: wrote {len(findings)} finding(s) to "
+              f"{args.baseline}")
+        return 0
+
+    new, grandfathered, stale = baseline.split(findings)
+    unreasoned = baseline.missing_reasons()
+
+    if args.as_json:
+        print(json.dumps({
+            "new": [f.to_json() for f in new],
+            "grandfathered": [f.to_json() for f in grandfathered],
+            "stale_baseline": stale,
+            "baseline_missing_reasons": unreasoned,
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.format())
+        if grandfathered:
+            print(f"tpulint: {len(grandfathered)} grandfathered finding(s) "
+                  f"suppressed by baseline ({args.baseline})")
+        for e in stale:
+            print("tpulint: stale baseline entry (no longer fires): "
+                  f"{e['rule']} {e['path']} ({e.get('context')})")
+        for e in unreasoned:
+            print("tpulint: baseline entry missing a reason: "
+                  f"{e['rule']} {e['path']} ({e.get('context')})")
+
+    errors = sum(1 for f in new if f.severity == Severity.ERROR)
+    warnings = len(new) - errors
+    if not args.as_json:
+        print(f"tpulint: {errors} error(s), {warnings} warning(s), "
+              f"{len(grandfathered)} baselined, {len(stale)} stale")
+    if new or unreasoned:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

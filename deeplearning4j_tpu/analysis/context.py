@@ -1,0 +1,412 @@
+"""Per-module AST analysis context shared by all tpulint rules.
+
+One parse + one indexing pass per module; every rule then works off the
+same precomputed facts:
+
+- import aliases (which local names mean numpy / jax.numpy / jax / time /
+  stdlib random),
+- every function/lambda with a dotted qualname, its params, decorators
+  and enclosing class,
+- a conservative intra-module call graph (plain-name calls and
+  ``self.method()`` calls),
+- the set of **trace roots** (functions decorated with or passed to
+  ``jax.jit`` / ``pmap`` / ``shard_map`` / ``grad`` / ``vmap`` /
+  ``lax.scan``-family wrappers) and its transitive closure
+  ``jit_reachable`` — the "code that runs under trace" region most rules
+  scope themselves to,
+- inline suppression comments (``# tpulint: disable=JX001[,JX002|all]``
+  on the offending line, or ``# tpulint: disable-file=...`` in the first
+  ten lines of the module).
+
+The call graph is intentionally intra-module and name-based: cross-module
+dispatch (e.g. the layer-impl registry) is invisible to it. A function
+that is traced but not discoverable can be annotated with a
+``# tpulint: traced`` comment on its ``def`` line.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+# Wrappers whose function argument executes under trace. `scan`-family
+# names are only honored when rooted in a jax-ish alias (see _is_tracer_fn)
+# so arbitrary `.cond()` methods on project objects don't count.
+TRACE_WRAPPERS = {
+    "jit", "pjit", "pmap", "shard_map", "grad", "value_and_grad", "vmap",
+    "remat", "checkpoint", "custom_vjp", "custom_jvp",
+}
+TRACE_WRAPPERS_JAX_ONLY = {
+    "scan", "while_loop", "fori_loop", "cond", "switch", "associated_scan",
+}
+
+_SUPPRESS_RE = re.compile(r"#\s*tpulint:\s*disable=([A-Za-z0-9_,\s]+|all)")
+_SUPPRESS_FILE_RE = re.compile(
+    r"#\s*tpulint:\s*disable-file=([A-Za-z0-9_,\s]+|all)")
+_TRACED_RE = re.compile(r"#\s*tpulint:\s*traced\b")
+
+
+class FunctionInfo:
+    __slots__ = ("node", "qualname", "name", "params", "class_name",
+                 "parent", "decorators", "lineno", "children")
+
+    def __init__(self, node, qualname: str, name: str, params: List[str],
+                 class_name: Optional[str], parent: Optional[str],
+                 decorators, lineno: int):
+        self.node = node
+        self.qualname = qualname
+        self.name = name
+        self.params = params
+        self.class_name = class_name
+        self.parent = parent          # qualname of enclosing function, if any
+        self.decorators = decorators
+        self.lineno = lineno
+        self.children: List[str] = []  # nested function qualnames
+
+
+def _dotted(node) -> Optional[str]:
+    """'jax.numpy.float64' for nested Attribute/Name chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def attr_base(node) -> Optional[str]:
+    """Root Name of an Attribute chain ('np' for np.random.seed)."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def terminal_attr(node) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def walk_body(fn_node) -> Iterator[ast.AST]:
+    """Walk a function body without descending into nested defs/lambdas
+    (those are separate FunctionInfo entries with their own reachability)."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    # skip the arguments node of the function itself, keep defaults
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class ModuleContext:
+    def __init__(self, source: str, path: str, rel: str):
+        self.source = source
+        self.path = path
+        self.rel = rel
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+
+        self.numpy_aliases: Set[str] = set()
+        self.jnp_aliases: Set[str] = set()
+        self.jax_aliases: Set[str] = set()
+        self.lax_aliases: Set[str] = set()
+        self.time_aliases: Set[str] = set()
+        self.random_aliases: Set[str] = set()
+        self.from_jax_names: Set[str] = set()   # `from jax import jit` etc.
+
+        self.functions: Dict[str, FunctionInfo] = {}
+        self._by_name: Dict[str, List[str]] = {}
+        self.calls: Dict[str, Set[Tuple[str, str]]] = {}
+        self.jit_roots: Set[str] = set()
+        self.jit_reachable: Set[str] = set()
+        self._parents: Dict[int, ast.AST] = {}
+
+        self._file_suppressed: Set[str] = set()
+        self._scan_imports()
+        self._index_functions()
+        self._index_calls_and_roots()
+        self._compute_reachability()
+        self._scan_file_suppressions()
+
+    # ------------------------------------------------------------ imports
+    def _scan_imports(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    name, asname = a.name, a.asname or a.name.split(".")[0]
+                    if name == "numpy":
+                        self.numpy_aliases.add(asname)
+                    elif name == "jax.numpy":
+                        self.jnp_aliases.add(a.asname or "jnp")
+                    elif name == "jax":
+                        self.jax_aliases.add(asname)
+                    elif name == "jax.lax":
+                        self.lax_aliases.add(a.asname or "lax")
+                    elif name == "time":
+                        self.time_aliases.add(asname)
+                    elif name == "random":
+                        self.random_aliases.add(asname)
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                for a in node.names:
+                    asname = a.asname or a.name
+                    if mod == "jax" and a.name == "numpy":
+                        self.jnp_aliases.add(asname)
+                    elif mod == "jax" and a.name == "lax":
+                        self.lax_aliases.add(asname)
+                    elif mod.startswith("jax"):
+                        self.from_jax_names.add(asname)
+
+    # ---------------------------------------------------------- functions
+    def _index_functions(self):
+        ctx = self
+
+        class V(ast.NodeVisitor):
+            def __init__(self):
+                self.stack: List[str] = []       # qualname parts
+                self.fn_stack: List[str] = []    # enclosing fn qualnames
+                self.class_stack: List[str] = []
+
+            def _add(self, node, name, params):
+                qual = ".".join(self.stack + [name]) if self.stack else name
+                info = FunctionInfo(
+                    node, qual, name, params,
+                    self.class_stack[-1] if self.class_stack else None,
+                    self.fn_stack[-1] if self.fn_stack else None,
+                    getattr(node, "decorator_list", []), node.lineno)
+                ctx.functions[qual] = info
+                ctx._by_name.setdefault(name, []).append(qual)
+                if info.parent:
+                    ctx.functions[info.parent].children.append(qual)
+                return qual
+
+            def visit_ClassDef(self, node):
+                self.stack.append(node.name)
+                self.class_stack.append(node.name)
+                self.generic_visit(node)
+                self.class_stack.pop()
+                self.stack.pop()
+
+            def _visit_fn(self, node):
+                args = node.args
+                params = ([a.arg for a in getattr(args, "posonlyargs", [])]
+                          + [a.arg for a in args.args]
+                          + [a.arg for a in args.kwonlyargs])
+                qual = self._add(node, node.name, params)
+                self.stack.extend([node.name, "<locals>"])
+                self.fn_stack.append(qual)
+                self.generic_visit(node)
+                self.fn_stack.pop()
+                self.stack = self.stack[:-2]
+
+            visit_FunctionDef = _visit_fn
+            visit_AsyncFunctionDef = _visit_fn
+
+            def visit_Lambda(self, node):
+                args = node.args
+                params = [a.arg for a in args.args]
+                name = f"<lambda:{node.lineno}>"
+                qual = self._add(node, name, params)
+                self.stack.extend([name, "<locals>"])
+                self.fn_stack.append(qual)
+                self.generic_visit(node)
+                self.fn_stack.pop()
+                self.stack = self.stack[:-2]
+
+        V().visit(self.tree)
+
+    # -------------------------------------------------------------- calls
+    def _is_tracer_fn(self, func) -> bool:
+        """Is `func` (the .func of a Call) a trace-introducing wrapper?"""
+        term = terminal_attr(func)
+        if term is None:
+            return False
+        base = attr_base(func)
+        if term in TRACE_WRAPPERS:
+            if isinstance(func, ast.Name):
+                # bare `jit` only counts if imported from jax
+                return term in self.from_jax_names or term in ("jit", "pjit",
+                                                               "pmap")
+            return base in self.jax_aliases | self.lax_aliases | {"jax"}
+        if term in TRACE_WRAPPERS_JAX_ONLY:
+            return base in self.jax_aliases | self.lax_aliases
+        return False
+
+    def _decorated_traced(self, info: FunctionInfo) -> bool:
+        for dec in info.decorators:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            if self._is_tracer_fn(target):
+                return True
+            # @partial(jax.jit, ...) / @functools.partial(jit, ...)
+            if (isinstance(dec, ast.Call)
+                    and terminal_attr(dec.func) == "partial" and dec.args
+                    and self._is_tracer_fn(dec.args[0])):
+                return True
+        # explicit annotation for functions traced via dynamic dispatch
+        line = self.lines[info.lineno - 1] if info.lineno <= len(
+            self.lines) else ""
+        return bool(_TRACED_RE.search(line))
+
+    def _owner_of(self, node) -> str:
+        """Qualname of the function whose *body* contains `node`."""
+        best, best_span = "<module>", None
+        for qual, info in self.functions.items():
+            n = info.node
+            end = getattr(n, "end_lineno", n.lineno)
+            if n.lineno <= node.lineno <= end:
+                span = end - n.lineno
+                if best_span is None or span < best_span:
+                    best, best_span = qual, span
+        return best
+
+    def _index_calls_and_roots(self):
+        # per-function outgoing edges
+        for qual, info in self.functions.items():
+            edges: Set[Tuple[str, str]] = set()
+            for node in walk_body(info.node):
+                if isinstance(node, ast.Call):
+                    f = node.func
+                    if isinstance(f, ast.Name):
+                        edges.add(("name", f.id))
+                    elif (isinstance(f, ast.Attribute)
+                          and isinstance(f.value, ast.Name)
+                          and f.value.id == "self"):
+                        edges.add(("self", f.attr))
+            self.calls[qual] = edges
+
+        # decorated roots + pragma roots
+        for qual, info in self.functions.items():
+            if self._decorated_traced(info):
+                self.jit_roots.add(qual)
+
+        # call-site roots: jax.jit(f), lax.scan(f, ...), executor-free
+        for node in ast.walk(self.tree):
+            if not (isinstance(node, ast.Call)
+                    and self._is_tracer_fn(node.func)):
+                continue
+            owner = self._owner_of(node)
+            for arg in node.args:
+                self._mark_root_expr(arg, owner)
+            for kw in node.keywords:
+                if kw.arg in ("fun", "f", "body_fun", "cond_fun"):
+                    self._mark_root_expr(kw.value, owner)
+
+    def _resolve(self, owner: str, kind: str, name: str) -> Optional[str]:
+        """Resolve a called name from `owner`'s scope to a qualname."""
+        cands = self._by_name.get(name)
+        if not cands:
+            return None
+        if kind == "self":
+            cls = (self.functions[owner].class_name
+                   if owner in self.functions else None)
+            for c in cands:
+                if self.functions[c].class_name and (
+                        cls is None
+                        or self.functions[c].class_name == cls):
+                    return c
+            return None
+        # nearest lexical scope: prefer a candidate nested in owner, then
+        # siblings/ancestors, then module level; fall back to first.
+        if owner in self.functions:
+            prefix = owner + ".<locals>."
+            for c in cands:
+                if c.startswith(prefix):
+                    return c
+        for c in cands:
+            if "<locals>" not in c or owner.startswith(
+                    c.rsplit(".<locals>.", 1)[0]):
+                return c
+        return cands[0]
+
+    def _mark_root_expr(self, expr, owner: str):
+        qual = None
+        if isinstance(expr, ast.Name):
+            qual = self._resolve(owner, "name", expr.id)
+        elif (isinstance(expr, ast.Attribute)
+              and isinstance(expr.value, ast.Name)
+              and expr.value.id == "self"):
+            qual = self._resolve(owner, "self", expr.attr)
+        elif isinstance(expr, ast.Lambda):
+            qual = ".".join(filter(None, [
+                owner if owner != "<module>" else "",
+                "<locals>" if owner != "<module>" else "",
+                f"<lambda:{expr.lineno}>"]))
+            if qual not in self.functions:
+                for q, i in self.functions.items():
+                    if i.node is expr:
+                        qual = q
+                        break
+        if qual in self.functions:
+            self.jit_roots.add(qual)
+
+    def _host_static(self, qual: str) -> bool:
+        """lru_cache/cache-decorated functions take hashable (static) args
+        and run once per distinct key — host-side by construction, so trace
+        reachability must not propagate into them."""
+        info = self.functions.get(qual)
+        if info is None:
+            return False
+        for dec in info.decorators:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            if terminal_attr(target) in ("lru_cache", "cache"):
+                return True
+        return False
+
+    def _compute_reachability(self):
+        seen = set(self.jit_roots)
+        frontier = list(seen)
+        while frontier:
+            qual = frontier.pop()
+            for kind, name in self.calls.get(qual, ()):
+                target = self._resolve(qual, kind, name)
+                if (target and target not in seen
+                        and not self._host_static(target)):
+                    seen.add(target)
+                    frontier.append(target)
+        self.jit_reachable = seen
+
+    # ------------------------------------------------------- suppressions
+    def _scan_file_suppressions(self):
+        for line in self.lines[:10]:
+            m = _SUPPRESS_FILE_RE.search(line)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",")}
+                self._file_suppressed |= rules
+
+    def is_suppressed(self, line: int, rule: str) -> bool:
+        if ("all" in self._file_suppressed
+                or rule in self._file_suppressed):
+            return True
+        if 1 <= line <= len(self.lines):
+            m = _SUPPRESS_RE.search(self.lines[line - 1])
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",")}
+                return "all" in rules or rule in rules
+        return False
+
+    # ---------------------------------------------------------- utilities
+    def reachable_functions(self) -> Iterator[FunctionInfo]:
+        for qual in sorted(self.jit_reachable):
+            yield self.functions[qual]
+
+    def ancestors(self, node) -> Iterator[ast.AST]:
+        """Lazily build a child->parent map and walk up from `node`."""
+        if not self._parents:
+            for parent in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(parent):
+                    self._parents[id(child)] = parent
+        cur = node
+        while id(cur) in self._parents:
+            cur = self._parents[id(cur)]
+            yield cur
+
+    def context_of(self, node) -> str:
+        return self._owner_of(node)

@@ -1,0 +1,45 @@
+"""Finding/severity types shared by the linter, rules, CLI and baseline."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+class Severity:
+    ERROR = "error"
+    WARNING = "warning"
+
+    ORDER = {ERROR: 0, WARNING: 1}
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One typed lint finding: ``rule id, path:line, message, severity``.
+
+    ``context`` is the dotted qualname of the enclosing function/class
+    (``<module>`` at top level); the baseline fingerprints on
+    (rule, path, context, message) rather than the line number so
+    unrelated edits above a grandfathered finding don't churn the
+    baseline file.
+    """
+
+    rule: str
+    path: str          # repo-relative posix path
+    line: int
+    message: str
+    severity: str = Severity.ERROR
+    context: str = field(default="<module>")
+
+    def sort_key(self):
+        return (self.path, self.line, self.rule)
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}: {self.rule} "
+                f"[{self.severity}] {self.message} (in {self.context})")
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule, "path": self.path, "line": self.line,
+            "message": self.message, "severity": self.severity,
+            "context": self.context,
+        }

@@ -1,0 +1,158 @@
+"""tpulint driver: walk modules, run rules, apply suppressions + baseline.
+
+The baseline file (`tpulint_baseline.json`, checked in next to this
+module) grandfathers pre-existing findings so the tier-1 gate only fails
+on *new* violations. Every baseline entry must carry a human-written
+``reason``; entries fingerprint on (rule, path, context, message) — not
+the line number — so unrelated edits don't churn the file. Stale entries
+(baselined findings that no longer fire) are reported so the file shrinks
+as debt is paid down.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from deeplearning4j_tpu.analysis.context import ModuleContext
+from deeplearning4j_tpu.analysis.findings import Finding
+from deeplearning4j_tpu.analysis.rules import get_rules
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REPO_ROOT = os.path.dirname(_PKG_DIR)
+DEFAULT_BASELINE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "tpulint_baseline.json")
+
+
+def _relpath(path: str) -> str:
+    path = os.path.abspath(path)
+    try:
+        rel = os.path.relpath(path, _REPO_ROOT)
+    except ValueError:
+        rel = path
+    return rel.replace(os.sep, "/")
+
+
+def lint_source(source: str, path: str = "<snippet>",
+                rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Lint a source string (unit tests use this for good/bad snippets)."""
+    rel = path if path.startswith("<") else _relpath(path)
+    try:
+        ctx = ModuleContext(source, path, rel)
+    except SyntaxError as e:
+        return [Finding(rule="PARSE", path=rel, line=e.lineno or 0,
+                        message=f"syntax error: {e.msg}")]
+    out: List[Finding] = []
+    for rule in get_rules(rules):
+        for f in rule.check(ctx):
+            if not ctx.is_suppressed(f.line, f.rule):
+                out.append(f)
+    return sorted(out, key=Finding.sort_key)
+
+
+def lint_file(path: str,
+              rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as fh:
+        return lint_source(fh.read(), path, rules)
+
+
+def iter_py_files(root: str) -> Iterable[str]:
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if not d.startswith((".", "__pycache__")))
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+
+def lint_paths(paths: Sequence[str],
+               rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    out: List[Finding] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for f in iter_py_files(p):
+                out.extend(lint_file(f, rules))
+        else:
+            out.extend(lint_file(p, rules))
+    return sorted(out, key=Finding.sort_key)
+
+
+def lint_package(rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Lint every module of deeplearning4j_tpu (what tier-1 enforces)."""
+    return lint_paths([_PKG_DIR], rules)
+
+
+# ----------------------------------------------------------------- baseline
+
+def fingerprint(f: Finding) -> Tuple[str, str, str, str]:
+    return (f.rule, f.path, f.context, f.message)
+
+
+class Baseline:
+    """Grandfathered findings; every entry must carry a non-empty reason."""
+
+    def __init__(self, entries: Optional[List[dict]] = None):
+        self.entries = entries or []
+        self._index: Dict[Tuple[str, str, str, str], dict] = {
+            (e["rule"], e["path"], e.get("context", "<module>"),
+             e["message"]): e
+            for e in self.entries
+        }
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls([])
+        with open(path, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+        return cls(data.get("findings", []))
+
+    def save(self, path: str) -> None:
+        data = {"version": 1,
+                "comment": ("tpulint grandfathered findings; every entry "
+                            "needs a `reason`. Regenerate with "
+                            "`python -m deeplearning4j_tpu.analysis "
+                            "--write-baseline` (reasons are preserved by "
+                            "fingerprint)."),
+                "findings": self.entries}
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(data, fh, indent=2, sort_keys=False)
+            fh.write("\n")
+        os.replace(tmp, path)
+
+    def missing_reasons(self) -> List[dict]:
+        return [e for e in self.entries
+                if not str(e.get("reason", "")).strip()
+                or str(e.get("reason", "")).strip().upper().startswith("TODO")]
+
+    def split(self, findings: Sequence[Finding]):
+        """Partition into (new, grandfathered) and compute stale entries."""
+        new: List[Finding] = []
+        matched_keys = set()
+        grandfathered: List[Finding] = []
+        for f in findings:
+            key = fingerprint(f)
+            if key in self._index:
+                matched_keys.add(key)
+                grandfathered.append(f)
+            else:
+                new.append(f)
+        stale = [e for k, e in self._index.items() if k not in matched_keys]
+        return new, grandfathered, stale
+
+    @classmethod
+    def from_findings(cls, findings: Sequence[Finding],
+                      previous: Optional["Baseline"] = None) -> "Baseline":
+        entries = []
+        for f in sorted(findings, key=Finding.sort_key):
+            key = fingerprint(f)
+            prev = previous._index.get(key) if previous else None
+            entries.append({
+                "rule": f.rule, "path": f.path, "context": f.context,
+                "message": f.message, "line": f.line,
+                "reason": (prev or {}).get(
+                    "reason", "TODO: justify or fix this finding"),
+            })
+        return cls(entries)

@@ -1,0 +1,493 @@
+"""tpulint rules JX001-JX006.
+
+Each rule is a class with a stable ``id``; registration is
+registry-driven (`@register_rule`) so satellite PRs add rules without
+touching the linter core. Rules receive a fully-indexed
+:class:`~deeplearning4j_tpu.analysis.context.ModuleContext` and yield
+:class:`~deeplearning4j_tpu.analysis.findings.Finding`s; suppression and
+baseline matching happen in the linter, not here.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Type
+
+from deeplearning4j_tpu.analysis.context import (
+    ModuleContext, attr_base, terminal_attr, walk_body,
+)
+from deeplearning4j_tpu.analysis.findings import Finding, Severity
+
+ALL_RULES: Dict[str, Type["Rule"]] = {}
+
+
+def register_rule(cls: Type["Rule"]) -> Type["Rule"]:
+    ALL_RULES[cls.id] = cls
+    return cls
+
+
+def get_rules(only=None) -> List["Rule"]:
+    ids = sorted(ALL_RULES) if only is None else list(only)
+    return [ALL_RULES[i]() for i in ids]
+
+
+class Rule:
+    id: str = ""
+    description: str = ""
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: ModuleContext, node, message: str,
+                severity: str = Severity.ERROR) -> Finding:
+        return Finding(rule=self.id, path=ctx.rel, line=node.lineno,
+                       message=message, severity=severity,
+                       context=ctx.context_of(node))
+
+
+def _rooted_at_param(node, info) -> bool:
+    """Does the expression reference one of the function's own params
+    (excluding self)? Params of a traced function hold traced values;
+    `float(layer.l1)`-style config access does not sync anything."""
+    params = set(info.params) - {"self", "cls"}
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in params:
+            return True
+    return False
+
+
+def _is_shape_derived(node) -> bool:
+    """int(x.shape[0])-style: static under trace, not a host sync."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in ("shape", "ndim",
+                                                           "size", "dtype"):
+            return True
+    return False
+
+
+@register_rule
+class HostSyncRule(Rule):
+    """JX001: device->host synchronization inside trace-reachable code.
+
+    `.block_until_ready()`, `.item()`, `float()/int()` on a traced value,
+    and `np.asarray/np.array` on device values all force the async
+    dispatch queue to drain (or fail outright under `jit`). Over a
+    high-latency TPU transport one stray sync costs more than the step.
+    """
+
+    id = "JX001"
+    description = "host sync (.item/.block_until_ready/np.asarray/float) in jit-reachable code"
+
+    _SYNC_ATTRS = {"block_until_ready": "drains the dispatch queue",
+                   "item": "device->host scalar transfer"}
+
+    def check(self, ctx):
+        for info in ctx.reachable_functions():
+            for node in walk_body(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                term = terminal_attr(f)
+                if isinstance(f, ast.Attribute) and term in self._SYNC_ATTRS:
+                    yield self.finding(
+                        ctx, node,
+                        f"`.{term}()` in traced/hot code "
+                        f"({self._SYNC_ATTRS[term]})")
+                elif (isinstance(f, ast.Attribute)
+                      and term in ("asarray", "array")
+                      and attr_base(f) in ctx.numpy_aliases):
+                    yield self.finding(
+                        ctx, node,
+                        f"`{attr_base(f)}.{term}()` in traced code forces a "
+                        "device->host transfer; use jnp or hoist to the host "
+                        "side")
+                elif (isinstance(f, ast.Name) and f.id in ("float", "int")
+                      and len(node.args) == 1
+                      and not isinstance(node.args[0], ast.Constant)
+                      and not _is_shape_derived(node.args[0])
+                      and _rooted_at_param(node.args[0], info)):
+                    yield self.finding(
+                        ctx, node,
+                        f"`{f.id}()` on a traced value concretizes it "
+                        "(host sync, or ConcretizationTypeError under jit)",
+                        Severity.WARNING)
+
+
+@register_rule
+class SideEffectRule(Rule):
+    """JX002: Python side effects under `jit` run once at trace time.
+
+    `print` silently stops printing after the first call; `time.*` and
+    `random.*`/`np.random.*` freeze to their trace-time value — the
+    classic "my dropout mask never changes" bug.
+    """
+
+    id = "JX002"
+    description = "Python side effects (print/time/random/np.random) under jit"
+
+    _TIME_FNS = {"time", "perf_counter", "monotonic", "process_time",
+                 "clock", "time_ns", "perf_counter_ns"}
+
+    def check(self, ctx):
+        for info in ctx.reachable_functions():
+            for node in walk_body(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if isinstance(f, ast.Name) and f.id == "print":
+                    yield self.finding(
+                        ctx, node,
+                        "`print` under jit executes at trace time only; use "
+                        "jax.debug.print",
+                        Severity.WARNING)
+                    continue
+                if not isinstance(f, ast.Attribute):
+                    continue
+                base = attr_base(f)
+                if base in ctx.time_aliases and f.attr in self._TIME_FNS:
+                    yield self.finding(
+                        ctx, node,
+                        f"`{base}.{f.attr}()` under jit freezes to its "
+                        "trace-time value")
+                elif base in ctx.random_aliases:
+                    yield self.finding(
+                        ctx, node,
+                        f"stdlib `{base}.{f.attr}()` under jit is baked in at "
+                        "trace time; thread a jax.random key instead")
+                elif (base in ctx.numpy_aliases
+                      and isinstance(f.value, ast.Attribute)
+                      and f.value.attr == "random"):
+                    yield self.finding(
+                        ctx, node,
+                        f"`{base}.random.{f.attr}()` under jit is baked in at "
+                        "trace time; thread a jax.random key instead")
+
+
+_ARRAYISH_PARAMS = {
+    "x", "xs", "y", "ys", "inputs", "input", "batch", "features", "labels",
+    "params", "state", "arr", "array", "data", "weights", "grads", "logits",
+    "probs", "mask", "targets",
+}
+
+
+@register_rule
+class RetraceHazardRule(Rule):
+    """JX003: patterns that defeat the jit cache and retrace every step.
+
+    (a) `jax.jit(...)` called inside a for/while loop builds a fresh
+    compiled callable per iteration; (b) `jax.jit(lambda ...)` inside a
+    function body gets a new identity per call, so the cache never hits;
+    (c) `static_argnums`/`static_argnames` pointing at array-valued
+    params recompiles on every distinct batch.
+    """
+
+    id = "JX003"
+    description = "retrace hazards: jit-in-loop, jit(lambda) per call, static_argnums on arrays"
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and ctx._is_tracer_fn(node.func)):
+                continue
+            term = terminal_attr(node.func)
+            if term not in ("jit", "pjit", "pmap"):
+                continue
+            in_loop = any(isinstance(a, (ast.For, ast.While, ast.AsyncFor))
+                          for a in ctx.ancestors(node))
+            if in_loop:
+                yield self.finding(
+                    ctx, node,
+                    f"`{term}` called inside a loop compiles a fresh program "
+                    "every iteration; hoist it or cache the jitted callable")
+            if (node.args and isinstance(node.args[0], ast.Lambda)
+                    and ctx.context_of(node) != "<module>"):
+                yield self.finding(
+                    ctx, node,
+                    f"`{term}(lambda ...)` inside a function creates a new "
+                    "callable identity per call, so the jit cache never hits",
+                    Severity.WARNING)
+            for kw in node.keywords:
+                if kw.arg not in ("static_argnums", "static_argnames"):
+                    continue
+                target = node.args[0] if node.args else None
+                params = self._target_params(ctx, node, target)
+                for name in self._static_params(kw, params):
+                    if name in _ARRAYISH_PARAMS:
+                        yield self.finding(
+                            ctx, kw.value,
+                            f"`{kw.arg}` marks array-like param `{name}` "
+                            "static: every distinct batch recompiles (and "
+                            "arrays are unhashable under jit)")
+
+    def _target_params(self, ctx, call, target):
+        qual = None
+        if isinstance(target, ast.Name):
+            qual = ctx._resolve(ctx.context_of(call), "name", target.id)
+        elif (isinstance(target, ast.Attribute)
+              and isinstance(target.value, ast.Name)
+              and target.value.id == "self"):
+            qual = ctx._resolve(ctx.context_of(call), "self", target.attr)
+        info = ctx.functions.get(qual) if qual else None
+        return info.params if info else None
+
+    def _static_params(self, kw, params):
+        vals = (kw.value.elts
+                if isinstance(kw.value, (ast.Tuple, ast.List))
+                else [kw.value])
+        for v in vals:
+            if not isinstance(v, ast.Constant):
+                continue
+            if isinstance(v.value, int) and params is not None:
+                idx = v.value
+                names = [p for p in params if p != "self"]
+                if 0 <= idx < len(names):
+                    yield names[idx]
+            elif isinstance(v.value, str):
+                yield v.value
+
+
+@register_rule
+class Float64Rule(Rule):
+    """JX004: float64 in traced kernel code.
+
+    TPUs have no f64 ALU: XLA software-emulates it at ~1/10th throughput,
+    and with `jax_enable_x64` off the dtype silently downgrades — either
+    way the literal is wrong. Host-side numpy f64 (metrics, serializers)
+    is fine and not flagged; explicitly x64-gated code
+    (`... if jax.config.jax_enable_x64 else ...`) is skipped.
+    """
+
+    id = "JX004"
+    description = "float64 literal / implicit x64 promotion in jit-reachable code"
+
+    def _x64_guarded(self, ctx, node) -> bool:
+        for anc in ctx.ancestors(node):
+            test = getattr(anc, "test", None)
+            if test is not None and isinstance(anc, (ast.If, ast.IfExp)):
+                try:
+                    if "x64" in ast.unparse(test):
+                        return True
+                except Exception:
+                    pass
+        return False
+
+    def check(self, ctx):
+        for info in ctx.reachable_functions():
+            for node in walk_body(info.node):
+                if (isinstance(node, ast.Attribute)
+                        and node.attr == "float64"
+                        and attr_base(node) in
+                        ctx.jnp_aliases | ctx.numpy_aliases):
+                    if not self._x64_guarded(ctx, node):
+                        yield self.finding(
+                            ctx, node,
+                            f"`{attr_base(node)}.float64` in traced code: "
+                            "TPUs emulate f64 (~10x slower) and x64 mode is "
+                            "usually off — use float32/bfloat16 or gate on "
+                            "jax_enable_x64")
+                elif isinstance(node, ast.Call):
+                    for kw in node.keywords:
+                        if (kw.arg == "dtype"
+                                and isinstance(kw.value, ast.Constant)
+                                and kw.value.value == "float64"
+                                and not self._x64_guarded(ctx, node)):
+                            yield self.finding(
+                                ctx, kw.value,
+                                "dtype='float64' in traced code promotes the "
+                                "kernel to emulated f64")
+
+
+_THREADY_ATTR_SKIP = ("thread", "lock", "executor", "future", "queue",
+                      "event", "cond", "semaphore")
+
+
+@register_rule
+class ThreadSafetyRule(Rule):
+    """JX005: unlocked cross-thread attribute mutation.
+
+    Heuristic: in a class that spawns threads (`threading.Thread(...)`,
+    executor `.submit(...)`, or subclassing `Thread`), an attribute
+    assigned both from a thread-entry method (or anything it calls) and
+    from other methods, where at least one of those assignments is not
+    under a `with self.<lock-ish>:` block. `__init__` assignments are
+    exempt (construction happens-before thread start), as are attributes
+    that are themselves threading primitives.
+    """
+
+    id = "JX005"
+    description = "attribute mutated across threads without holding the class lock"
+
+    def check(self, ctx):
+        classes: Dict[str, List] = {}
+        for qual, info in ctx.functions.items():
+            if info.class_name and "<locals>" not in qual:
+                classes.setdefault(info.class_name, []).append(info)
+        for cls_name, methods in sorted(classes.items()):
+            yield from self._check_class(ctx, cls_name, methods)
+
+    def _check_class(self, ctx, cls_name, methods):
+        # analysis units: methods + functions nested inside them (thread
+        # bodies are typically `def work(): ...` locals of the spawner)
+        units: Dict[str, object] = {m.qualname: m for m in methods}
+        by_name = {m.name: m for m in methods}
+        for qual, info in ctx.functions.items():
+            if any(qual.startswith(m.qualname + ".<locals>.")
+                   for m in methods):
+                units[qual] = info
+
+        entries = set()  # unit qualnames that run on a spawned thread
+        for qual, u in units.items():
+            for node in walk_body(u.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                term = terminal_attr(node.func)
+                if not (term == "Thread"
+                        or (term in ("submit", "map")
+                            and isinstance(node.func, ast.Attribute))):
+                    continue
+                cands = list(node.args)
+                cands += [kw.value for kw in node.keywords
+                          if kw.arg == "target"]
+                for c in cands:
+                    if (isinstance(c, ast.Attribute)
+                            and isinstance(c.value, ast.Name)
+                            and c.value.id == "self"
+                            and c.attr in by_name):
+                        entries.add(by_name[c.attr].qualname)
+                    elif isinstance(c, ast.Name):
+                        t = ctx._resolve(qual, "name", c.id)
+                        if t in units:
+                            entries.add(t)
+        cls_node = self._class_node(ctx, cls_name)
+        if cls_node is not None and any(
+                terminal_attr(b) == "Thread" for b in cls_node.bases):
+            if "run" in by_name:
+                entries.add(by_name["run"].qualname)
+        if not entries:
+            return
+
+        # thread side = closure of entry units over self-/local-name calls
+        thread_side = set(entries)
+        frontier = list(entries)
+        while frontier:
+            qual = frontier.pop()
+            for kind, callee in ctx.calls.get(qual, ()):
+                if kind == "self" and callee in by_name:
+                    t = by_name[callee].qualname
+                else:
+                    t = ctx._resolve(qual, "name", callee) \
+                        if kind == "name" else None
+                if t in units and t not in thread_side:
+                    thread_side.add(t)
+                    frontier.append(t)
+
+        stores: Dict[str, List] = {}  # attr -> [(unit_qual, node, guarded)]
+        for qual, u in units.items():
+            if u.name == "__init__":
+                continue
+            for node in walk_body(u.node):
+                if isinstance(node, ast.Assign):
+                    tgts = node.targets
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    tgts = [node.target]
+                else:
+                    continue
+                tgts = [e for t in tgts for e in
+                        (t.elts if isinstance(t, (ast.Tuple, ast.List))
+                         else (t,))]
+                for tgt in tgts:
+                    if (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"):
+                        attr = tgt.attr
+                        if any(k in attr.lower()
+                               for k in _THREADY_ATTR_SKIP):
+                            continue
+                        guarded = self._locked(ctx, node)
+                        stores.setdefault(attr, []).append(
+                            (qual, node, guarded))
+
+        def short(qual):
+            return qual.replace(cls_name + ".", "", 1).replace(
+                ".<locals>.", "/")
+
+        for attr, sites in sorted(stores.items()):
+            t_sites = [s for s in sites if s[0] in thread_side]
+            o_sites = [s for s in sites if s[0] not in thread_side]
+            if not t_sites or not o_sites:
+                continue
+            unguarded = [s for s in t_sites + o_sites if not s[2]]
+            if not unguarded:
+                continue
+            site = min(unguarded, key=lambda s: s[1].lineno)
+            t_names = sorted({short(s[0]) for s in t_sites})
+            o_names = sorted({short(s[0]) for s in o_sites})
+            yield Finding(
+                rule=self.id, path=ctx.rel, line=site[1].lineno,
+                severity=Severity.WARNING,
+                context=f"{cls_name}.{short(site[0])}",
+                message=(f"`self.{attr}` is written from thread-side "
+                         f"{t_names} and caller-side {o_names} without "
+                         "holding the class lock"))
+
+    def _class_node(self, ctx, name):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef) and node.name == name:
+                return node
+        return None
+
+    def _locked(self, ctx, node) -> bool:
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, ast.With):
+                for item in anc.items:
+                    expr = item.context_expr
+                    if isinstance(expr, ast.Call):
+                        expr = expr.func
+                    try:
+                        src = ast.unparse(expr).lower()
+                    except Exception:
+                        src = ""
+                    if any(k in src for k in ("lock", "mutex", "cond",
+                                              "cv")):
+                        return True
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break
+        return False
+
+
+@register_rule
+class DtypeSniffRule(Rule):
+    """JX006: dtype-sniffing on user input outside an explicit preprocessor.
+
+    `x.dtype == uint8` as a semantic switch ("bytes must be an image")
+    corrupts any other uint8 payload — the motivating bug fed uint8
+    embedding ids through a /255 scaler, flooring every id to 0. The
+    policy decision belongs in `nn/conf/preprocessors.py` (the allowed
+    location), keyed on declared model structure, not on the dtype alone.
+    """
+
+    id = "JX006"
+    description = "dtype-sniffing (x.dtype == uint8) outside nn/conf/preprocessors.py"
+
+    ALLOWED_SUFFIXES = ("nn/conf/preprocessors.py",)
+
+    def check(self, ctx):
+        rel = ctx.rel.replace("\\", "/")
+        if rel.endswith(self.ALLOWED_SUFFIXES) or "/analysis/" in rel:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Compare):
+                continue
+            sides = [node.left] + list(node.comparators)
+            has_dtype = any(isinstance(s, ast.Attribute)
+                            and s.attr == "dtype" for s in sides)
+            sniffs = any(
+                (isinstance(s, ast.Attribute) and s.attr == "uint8")
+                or (isinstance(s, ast.Constant) and s.value == "uint8")
+                for s in sides)
+            if has_dtype and sniffs:
+                yield self.finding(
+                    ctx, node,
+                    "dtype-sniffing `.dtype == uint8` decides semantics from "
+                    "the wire format; route through an explicit preprocessor "
+                    "(nn/conf/preprocessors.py) keyed on model structure")

@@ -1,0 +1,222 @@
+"""Runtime strict-mode guards — the dynamic half of tpulint.
+
+Static rules catch what is visible in the source; these guards catch the
+same failure classes at run time, cheaply enough to leave on in CI:
+
+- :func:`strict_mode` — context manager that wraps the step body in
+  ``jax.transfer_guard("disallow")`` so any *implicit* host<->device
+  transfer (a stray numpy array flowing into a jitted step, a device
+  value silently fetched for a Python branch) raises instead of eating
+  milliseconds per step. Off by default; ``DL4J_TPU_STRICT=1`` (or
+  ``enabled=True``) turns it on, and when given an engine it also
+  installs the retrace watch and NaN guard below.
+
+- :class:`RetraceGuard` — fires when one function compiles more than N
+  times (``DL4J_TPU_RETRACE_LIMIT``, default 10). ``wrap()`` counts
+  traces of a to-be-jitted callable directly; ``watch(net)`` hooks the
+  engine's ``_fit_dispatch`` and reads the PR-2 observability counters
+  (``dl4j_xla_compiles_total`` via the jax.monitoring hook, plus the
+  engine's own jit-program cache) to spot retrace storms in training.
+
+- :func:`install_nan_guard` — patches ``_fit_dispatch`` to settle the
+  loss scalar after each staged batch and raise ``FloatingPointError``
+  on NaN/inf, so a diverging run dies at the first bad step instead of
+  after the TPU hour.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import math
+import os
+import threading
+import warnings
+from typing import Callable, Dict, Optional
+
+
+def strict_enabled(default: bool = False) -> bool:
+    """Is strict mode requested via the environment (`DL4J_TPU_STRICT`)?"""
+    v = os.environ.get("DL4J_TPU_STRICT")
+    if v is None:
+        return default
+    return v.strip().lower() not in ("0", "false", "off", "")
+
+
+def _default_retrace_limit() -> int:
+    try:
+        return max(1, int(os.environ.get("DL4J_TPU_RETRACE_LIMIT", "10")))
+    except ValueError:
+        return 10
+
+
+class RetraceError(RuntimeError):
+    """A function recompiled more often than the strict-mode limit."""
+
+
+class RetraceGuard:
+    """Warn or raise when one function compiles more than `limit` times.
+
+    ``wrap(fn)`` returns a counting proxy to put *inside* ``jax.jit`` —
+    each retrace re-executes the Python body, so the count is exact::
+
+        guard = RetraceGuard(limit=3)
+        step = jax.jit(guard.wrap(step_fn))
+
+    ``watch(net)`` instruments a live engine instead: after every staged
+    batch it compares the growth of the engine's jit-program cache and
+    the observability compile counter against the limit.
+    """
+
+    def __init__(self, limit: Optional[int] = None,
+                 on_violation: Optional[str] = None):
+        self.limit = _default_retrace_limit() if limit is None else int(limit)
+        if on_violation is None:
+            on_violation = "raise" if strict_enabled() else "warn"
+        if on_violation not in ("warn", "raise"):
+            raise ValueError("on_violation must be 'warn' or 'raise'")
+        self.on_violation = on_violation
+        self.counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._watched = []
+        self._warned = set()
+
+    # ------------------------------------------------------------- wrap
+    def wrap(self, fn: Callable, name: Optional[str] = None) -> Callable:
+        name = name or getattr(fn, "__name__", "<fn>")
+
+        @functools.wraps(fn)
+        def traced(*args, **kwargs):
+            with self._lock:
+                n = self.counts[name] = self.counts.get(name, 0) + 1
+            if n > self.limit:
+                self._violate(name, n)
+            return fn(*args, **kwargs)
+
+        return traced
+
+    # ------------------------------------------------------------ watch
+    def _compiles_total(self) -> float:
+        try:
+            from deeplearning4j_tpu import observability as obs
+            fam = obs.metrics.get_family("dl4j_xla_compiles_total")
+            if fam is None:
+                return 0.0
+            return sum(c.get() for c in fam.children())
+        except Exception:
+            return 0.0
+
+    def watch(self, net, name: Optional[str] = None) -> "RetraceGuard":
+        """Instrument a live engine's `_fit_dispatch`; undo with `unwatch()`."""
+        try:
+            from deeplearning4j_tpu import observability as obs
+            obs.install_jax_compile_hook()
+        except Exception:
+            pass
+        name = name or type(net).__name__
+        base_programs = len(net._jit_cache)
+        base_compiles = self._compiles_total()
+        orig = net._fit_dispatch
+
+        def dispatch(batch, *a, **kw):
+            out = orig(batch, *a, **kw)
+            programs = len(net._jit_cache) - base_programs
+            compiles = self._compiles_total() - base_compiles
+            n = int(max(programs, compiles))
+            with self._lock:
+                self.counts[name] = n
+            if n > self.limit:
+                self._violate(name, n)
+            return out
+
+        net._fit_dispatch = dispatch
+        self._watched.append((net, orig))
+        return self
+
+    def unwatch(self) -> None:
+        while self._watched:
+            net, orig = self._watched.pop()
+            net._fit_dispatch = orig
+
+    def __enter__(self) -> "RetraceGuard":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.unwatch()
+        return False
+
+    # -------------------------------------------------------- violation
+    def _violate(self, name: str, n: int) -> None:
+        msg = (f"tpulint strict mode: `{name}` has compiled {n} times "
+               f"(limit {self.limit}) — likely a retrace storm from "
+               "per-step Python scalars/shapes; pad shapes or mark true "
+               "statics with static_argnums (see PERF.md §12)")
+        if self.on_violation == "raise":
+            raise RetraceError(msg)
+        if name not in self._warned:  # one warning per function, not per step
+            self._warned.add(name)
+            warnings.warn(msg, RuntimeWarning, stacklevel=3)
+
+
+def install_nan_guard(net, check_every: int = 1) -> Callable[[], None]:
+    """Patch `net._fit_dispatch` to raise FloatingPointError on a NaN/inf
+    loss. Settling the loss scalar syncs the step, so `check_every=k`
+    amortizes the sync over k batches. Returns an uninstall callable."""
+    orig = net._fit_dispatch
+    state = {"n": 0}
+
+    def dispatch(batch, *a, **kw):
+        out = orig(batch, *a, **kw)
+        state["n"] += 1
+        if state["n"] % check_every == 0:
+            v = net.score_value
+            if v is not None and (math.isnan(v) or math.isinf(v)):
+                raise FloatingPointError(
+                    f"tpulint strict mode: non-finite loss ({v}) at "
+                    f"iteration {getattr(net, 'iteration', '?')}")
+        return out
+
+    net._fit_dispatch = dispatch
+
+    def uninstall():
+        net._fit_dispatch = orig
+
+    return uninstall
+
+
+@contextlib.contextmanager
+def strict_mode(net=None, *, enabled: Optional[bool] = None,
+                transfer: str = "disallow",
+                retrace_limit: Optional[int] = None,
+                nan_guard: bool = True,
+                on_violation: str = "raise"):
+    """Strict-mode window for a step body (or a whole fit).
+
+    When off (the default unless `DL4J_TPU_STRICT` is set or
+    `enabled=True`), this is a no-op that yields None — zero overhead,
+    safe to leave in production code paths. When on:
+
+    - implicit host<->device transfers raise (``jax.transfer_guard``),
+      so inputs must be staged with an explicit ``jax.device_put``;
+    - with an engine passed, a :class:`RetraceGuard` watches its
+      dispatches and a NaN guard settles each step's loss.
+    """
+    on = strict_enabled() if enabled is None else bool(enabled)
+    if not on:
+        yield None
+        return
+    import jax
+
+    guard = RetraceGuard(limit=retrace_limit, on_violation=on_violation)
+    uninstall = None
+    if net is not None:
+        guard.watch(net)
+        if nan_guard:
+            uninstall = install_nan_guard(net)
+    try:
+        with jax.transfer_guard(transfer):
+            yield guard
+    finally:
+        if uninstall is not None:
+            uninstall()
+        guard.unwatch()

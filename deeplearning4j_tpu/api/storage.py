@@ -155,7 +155,8 @@ class RemoteStatsStorageRouter(StatsStorageRouter):
         self.retry_delay_seconds = float(retry_delay_seconds)
         self._queue: "queue.Queue" = queue.Queue(maxsize=queue_size)
         self._closed = False
-        self.dropped = 0
+        self._drop_lock = threading.Lock()  # `dropped` is bumped from both
+        self.dropped = 0                    # the worker and caller threads
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()
 
@@ -182,7 +183,8 @@ class RemoteStatsStorageRouter(StatsStorageRouter):
                     except Exception:
                         time.sleep(self.retry_delay_seconds * (attempt + 1))
                 else:
-                    self.dropped += 1
+                    with self._drop_lock:
+                        self.dropped += 1
             finally:
                 self._queue.task_done()  # incl. the close sentinel
 
@@ -192,7 +194,8 @@ class RemoteStatsStorageRouter(StatsStorageRouter):
         try:
             self._queue.put_nowait(payload)
         except Exception:
-            self.dropped += 1  # bounded queue full: drop, never block training
+            with self._drop_lock:
+                self.dropped += 1  # bounded queue full: drop, never block
 
     def put_static_info(self, record):
         self._enqueue({"type": "static", "record": _stamp(dict(record))})
@@ -228,6 +231,7 @@ class RemoteStatsStorageRouter(StatsStorageRouter):
                 try:  # make room by dropping the oldest queued record
                     self._queue.get_nowait()
                     self._queue.task_done()
-                    self.dropped += 1
+                    with self._drop_lock:
+                        self.dropped += 1
                 except Exception:
                     time.sleep(0.01)

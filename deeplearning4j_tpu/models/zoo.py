@@ -242,6 +242,55 @@ def transformer_lm(vocab_size: int, *, t: int = 64, d_model: int = 64,
     return gb.build()
 
 
+def _sample_token(probs, rng, temperature: float, top_k: int, top_p: float):
+    """Sample one next-token id from a [V] probability vector (greedy at
+    temperature<=0; top-k / nucleus top-p restrictions compose, applied
+    before temperature). Tokens excluded by top-k/top-p are masked to
+    -inf in logit space so re-tempering can NEVER re-admit them."""
+    import numpy as np
+
+    probs = np.asarray(probs, np.float64)
+    if temperature <= 0:
+        return int(probs.argmax())
+    if top_k:
+        kth = np.sort(probs)[-min(top_k, len(probs))]
+        probs = np.where(probs >= kth, probs, 0.0)
+    if top_p:
+        order = np.argsort(-probs)
+        csum = np.cumsum(probs[order]) - probs[order]
+        cut = order[csum >= top_p * probs.sum()]
+        probs = probs.copy()
+        probs[cut] = 0.0
+    logits = np.log(np.maximum(probs, 1e-12)) / temperature
+    logits[probs <= 0] = -np.inf
+    p = np.exp(logits - logits.max())
+    p /= p.sum()
+    return int(rng.choice(len(p), p=p))
+
+
+def _sample_tokens(probs, rng, temperature: float, top_k: int):
+    """Batched `_sample_token`: [B, V] probabilities -> [B] ids, one rng
+    draw per row (same draw order as a Python loop over rows, so seeded
+    generations are reproducible)."""
+    import numpy as np
+
+    probs = np.asarray(probs, np.float64)
+    if temperature <= 0:
+        return probs.argmax(-1)
+    if top_k:
+        kth = np.sort(probs, axis=-1)[:, -min(top_k, probs.shape[-1])]
+        probs = np.where(probs >= kth[:, None], probs, 0.0)
+    logits = np.log(np.maximum(probs, 1e-12)) / temperature
+    # Same exclusion mask as the single-sequence path: without it,
+    # temperature > 1 re-inflates the log(1e-12) floor of excluded tokens
+    # and batched top-k can sample outside the top k.
+    logits[probs <= 0] = -np.inf
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.asarray([rng.choice(p.shape[-1], p=p[i])
+                       for i in range(p.shape[0])])
+
+
 def generate_lm(cg, prompt_ids, n_steps: int, *, window: int,
                 temperature: float = 1.0, seed: int = 0,
                 use_cache: bool = False, top_k: int = 0,
@@ -273,22 +322,7 @@ def generate_lm(cg, prompt_ids, n_steps: int, *, window: int,
         raise ValueError("need at least one prompt token")
 
     def pick(probs):
-        probs = np.asarray(probs, np.float64)
-        if temperature <= 0:
-            return int(probs.argmax())
-        if top_k:
-            kth = np.sort(probs)[-min(top_k, len(probs))]
-            probs = np.where(probs >= kth, probs, 0.0)
-        if top_p:
-            order = np.argsort(-probs)
-            csum = np.cumsum(probs[order]) - probs[order]
-            cut = order[csum >= top_p * probs.sum()]
-            probs[cut] = 0.0
-        logits = np.log(np.maximum(probs, 1e-12)) / temperature
-        logits[probs <= 0] = -np.inf
-        p = np.exp(logits - logits.max())
-        p /= p.sum()
-        return int(rng.choice(len(p), p=p))
+        return _sample_token(probs, rng, temperature, top_k, top_p)
 
     if use_cache:
         cache_lens = [
@@ -397,17 +431,7 @@ def generate_lm_batch(cg, prompts, n_steps: int, *, temperature: float = 1.0,
             f"capacity {min(cache_lens)}")
 
     def pick(probs):  # probs: [B, V] -> [B]
-        probs = np.asarray(probs, np.float64)
-        if temperature <= 0:
-            return probs.argmax(-1)
-        if top_k:
-            kth = np.sort(probs, axis=-1)[:, -min(top_k, probs.shape[-1])]
-            probs = np.where(probs >= kth[:, None], probs, 0.0)
-        logits = np.log(np.maximum(probs, 1e-12)) / temperature
-        p = np.exp(logits - logits.max(-1, keepdims=True))
-        p /= p.sum(-1, keepdims=True)
-        return np.asarray([rng.choice(p.shape[-1], p=p[i])
-                           for i in range(p.shape[0])])
+        return _sample_tokens(probs, rng, temperature, top_k)
 
     out = [prompts]
     cg.rnn_clear_previous_state()

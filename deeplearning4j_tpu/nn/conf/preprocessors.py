@@ -201,3 +201,64 @@ class ComposableInputPreProcessor(InputPreProcessor):
 
 
 _PREPROCESSOR_REGISTRY["ComposableInputPreProcessor"] = ComposableInputPreProcessor
+
+
+# --------------------------------------------------------------------------
+# uint8 network-input policy.
+#
+# uint8 on the wire is deliberately ambiguous: streamed image batches ship
+# as bytes and want the device-side /255 ImagePreProcessingScaler (PERF.md
+# §3), while embedding ids for small vocabularies also arrive as uint8 and
+# must NOT be scaled (dividing ids by 255 floors every id to row 0 of the
+# embedding table — silent corruption). The engines used to sniff
+# `x.dtype == uint8` and always scale; the decision now lives here, keyed
+# on declared model structure (tpulint rule JX006 enforces that this
+# module stays the only place that inspects the uint8 wire format).
+
+UINT8_SCALE = "scale"          # image bytes: astype(compute)/255
+UINT8_IDS = "ids"              # embedding ids: astype(int32), never scaled
+UINT8_AMBIGUOUS = "ambiguous"  # mixed consumers: raise when uint8 arrives
+
+
+def _consumes_ids(layer) -> bool:
+    """Does this first layer read integer ids (gather) rather than values?"""
+    return (type(layer).__name__ == "EmbeddingLayer"
+            and getattr(layer, "input_format", "auto") != "onehot")
+
+
+def resolve_uint8_policy(consumers) -> str:
+    """Decide what a uint8 network input means from its direct consumers
+    (the first layer of a MultiLayerNetwork, or every vertex fed by one
+    network input of a ComputationGraph). `None` entries (non-layer
+    vertices: merge/elementwise/...) count as value consumers."""
+    kinds = set()
+    for layer in consumers:
+        kinds.add(UINT8_IDS if layer is not None and _consumes_ids(layer)
+                  else UINT8_SCALE)
+    if not kinds:
+        return UINT8_SCALE
+    if len(kinds) > 1:
+        return UINT8_AMBIGUOUS
+    return kinds.pop()
+
+
+def apply_uint8_policy(x, policy: str, compute_dtype):
+    """Stage one network input for the traced forward pass: uint8 image
+    bytes scale 0-255 -> 0-1 on device, uint8 ids cast to int32 unscaled,
+    floats cast to the compute dtype, everything else passes through.
+    Runs under trace — dtype and policy are static, so this adds no ops
+    for non-uint8 inputs."""
+    if x.dtype == jnp.uint8:
+        if policy == UINT8_IDS:
+            return x.astype(jnp.int32)
+        if policy == UINT8_AMBIGUOUS:
+            raise ValueError(
+                "uint8 network input is ambiguous: it feeds both an "
+                "ids-format EmbeddingLayer (wants raw ids) and a value "
+                "consumer (wants /255 image scaling). Feed ids as "
+                "int32/int64 or split the input so each consumer gets its "
+                "own; refusing to guess rather than silently zeroing ids.")
+        return x.astype(compute_dtype) / 255.0
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        return x.astype(compute_dtype)
+    return x

@@ -32,6 +32,7 @@ from deeplearning4j_tpu.nn.conf.graph import (
 )
 from deeplearning4j_tpu.nn.conf.layers import is_bias_param
 from deeplearning4j_tpu.nn.conf.neural_net import ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.conf import preprocessors as preprocessors_mod
 from deeplearning4j_tpu.nn.layers import OUTPUT_LAYER_TYPES, get_impl
 from deeplearning4j_tpu.ops import grad_norm as grad_norm_mod
 from deeplearning4j_tpu.ops import schedules as schedules_mod
@@ -179,6 +180,22 @@ class ComputationGraph:
             )
         return self._clock
 
+    @property
+    def _uint8_policies(self) -> Dict[str, str]:
+        """Per-network-input uint8 staging policy (see
+        `nn/conf/preprocessors.py`): every vertex fed directly by the input
+        votes, and a mixed ids/value vote is 'ambiguous' (raises if uint8
+        actually arrives)."""
+        out: Dict[str, str] = {}
+        for name in self.conf.network_inputs:
+            consumers = []
+            for vname, ins in self.conf.vertex_inputs.items():
+                if name in ins:
+                    vertex = self.conf.vertices.get(vname)
+                    consumers.append(getattr(vertex, "layer", None))
+            out[name] = preprocessors_mod.resolve_uint8_policy(consumers)
+        return out
+
     # --------------------------------------------------------------- forward
 
     def _forward_fn(self, params, state, inputs: Sequence, rng, train: bool,
@@ -188,16 +205,15 @@ class ComputationGraph:
         cdt = self._compute_dtype
         values: Dict[str, jnp.ndarray] = {}
         masks: Dict[str, Optional[jnp.ndarray]] = {}
+        policies = self._uint8_policies
         for i, name in enumerate(self.conf.network_inputs):
-            x = jnp.asarray(inputs[i])
-            if x.dtype == jnp.uint8:
-                # Device-side ImagePreProcessingScaler (see
-                # MultiLayerNetwork._forward_fn): bytes over the link,
-                # scale 0-255 -> 0-1 on device.
-                x = x.astype(cdt) / 255.0
-            elif jnp.issubdtype(x.dtype, jnp.floating):
-                x = x.astype(cdt)
-            values[name] = x
+            # Device-side ImagePreProcessingScaler (see
+            # MultiLayerNetwork._forward_fn): bytes over the link, scale
+            # 0-255 -> 0-1 on device — but only for value consumers; an
+            # input feeding an ids-format EmbeddingLayer is cast, and a
+            # uint8 input feeding both kinds raises instead of guessing.
+            values[name] = preprocessors_mod.apply_uint8_policy(
+                jnp.asarray(inputs[i]), policies[name], cdt)
             masks[name] = None if fmasks is None else fmasks[i]
         new_state: Dict[str, Any] = {}
         aux: Dict[str, Any] = {}

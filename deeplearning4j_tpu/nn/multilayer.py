@@ -38,6 +38,7 @@ from deeplearning4j_tpu.nn.conf.enums import (
 )
 from deeplearning4j_tpu.nn.conf.layers import CenterLossOutputLayer, is_bias_param
 from deeplearning4j_tpu.nn.conf.neural_net import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.conf import preprocessors as preprocessors_mod
 from deeplearning4j_tpu.nn.layers import OUTPUT_LAYER_TYPES, get_impl
 from deeplearning4j_tpu.ops import grad_norm as grad_norm_mod
 from deeplearning4j_tpu.ops import schedules as schedules_mod
@@ -165,6 +166,14 @@ class MultiLayerNetwork:
         self._initialized = True
         return self
 
+    @property
+    def _uint8_policy(self) -> str:
+        """How a uint8 network input is staged, from the first layer's
+        declared structure (see `nn/conf/preprocessors.py`): embedding ids
+        are cast, image bytes are /255-scaled."""
+        return preprocessors_mod.resolve_uint8_policy(
+            [self.layers[0]] if self.layers else [])
+
     # ------------------------------------------------------------- clock
     # The (step, rng) pair lives ON DEVICE and is advanced inside the jitted
     # train step. Converting a host scalar per iteration costs milliseconds
@@ -187,15 +196,14 @@ class MultiLayerNetwork:
                     keep_rnn_state: bool = False):
         """Pure forward pass (traced). Returns (final, new_state, activations, aux)."""
         cdt = self._compute_dtype
-        x = jnp.asarray(x)
-        if x.dtype == jnp.uint8:
-            # Device-side ImagePreProcessingScaler (reference:
-            # `ImagePreProcessingScaler.java` scales 0-255 -> 0-1 on HOST):
-            # shipping bytes and scaling on device quarters the
-            # host->device traffic of streamed image batches (PERF.md §3).
-            x = x.astype(cdt) / 255.0
-        elif jnp.issubdtype(x.dtype, jnp.floating):
-            x = x.astype(cdt)
+        # Device-side ImagePreProcessingScaler (reference:
+        # `ImagePreProcessingScaler.java` scales 0-255 -> 0-1 on HOST):
+        # shipping bytes and scaling on device quarters the host->device
+        # traffic of streamed image batches (PERF.md §3). The uint8
+        # interpretation (image bytes vs embedding ids) is decided by the
+        # first layer's declared structure, not sniffed from the dtype.
+        x = preprocessors_mod.apply_uint8_policy(
+            jnp.asarray(x), self._uint8_policy, cdt)
         mask = fmask
         new_state: Dict[str, Any] = {}
         acts: List[jnp.ndarray] = []

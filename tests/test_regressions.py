@@ -1,0 +1,170 @@
+"""Regression tests for three fixed bugs.
+
+1. `models/zoo._sample_tokens` (batched pick) dropped the `-inf` mask on
+   excluded tokens, so temperature > 1 could re-admit tokens outside
+   top-k via the re-inflated log(1e-12) floor.
+2. uint8 network inputs were dtype-sniffed and divided by 255 even when
+   the first layer is an ids-format EmbeddingLayer, silently zeroing the
+   token ids. The policy now comes from the declared model structure.
+3. `native/_fastvocab.so` was a committed binary; it must rebuild from
+   `fastvocab.cpp` on first use.
+"""
+
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (
+    ComputationGraph,
+    MultiLayerNetwork,
+    NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.models.zoo import _sample_token, _sample_tokens
+from deeplearning4j_tpu.nn.conf.layers import (
+    DenseLayer,
+    EmbeddingLayer,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.conf.preprocessors import (
+    UINT8_AMBIGUOUS,
+    UINT8_IDS,
+    UINT8_SCALE,
+    resolve_uint8_policy,
+)
+
+
+# ------------------------------------------------- 1. batched sampling
+
+class TestBatchedSamplingMask:
+    def test_top_k_never_samples_excluded_tokens_at_high_temperature(self):
+        # token 0 has ~all the mass but sits outside top_k once excluded;
+        # temperature=20 flattens logits so a missing -inf mask would give
+        # excluded tokens ~uniform odds — 200 draws would surely hit one.
+        probs = np.tile(np.asarray([[0.90, 0.06, 0.04, 0.0, 0.0]]), (200, 1))
+        rng = np.random.RandomState(7)
+        ids = _sample_tokens(probs, rng, temperature=20.0, top_k=2)
+        assert set(np.unique(ids)) <= {0, 1}
+
+    def test_batched_matches_single_row_loop(self):
+        rng = np.random.RandomState(3)
+        probs = rng.dirichlet(np.ones(11), size=6)
+        batched = _sample_tokens(probs, np.random.RandomState(42),
+                                 temperature=1.7, top_k=4)
+        loop_rng = np.random.RandomState(42)
+        looped = [_sample_token(probs[i], loop_rng, temperature=1.7,
+                                top_k=4, top_p=0.0)
+                  for i in range(len(probs))]
+        assert list(batched) == looped
+
+    def test_greedy_path_unchanged(self):
+        probs = np.asarray([[0.1, 0.7, 0.2], [0.5, 0.2, 0.3]])
+        ids = _sample_tokens(probs, np.random.RandomState(0),
+                             temperature=0.0, top_k=0)
+        assert list(ids) == [1, 0]
+
+    def test_single_top_p_does_not_mutate_input(self):
+        probs = np.asarray([0.5, 0.3, 0.15, 0.05])
+        before = probs.copy()
+        _sample_token(probs, np.random.RandomState(0), temperature=1.0,
+                      top_k=0, top_p=0.6)
+        assert (probs == before).all()
+
+
+# ------------------------------------------------- 2. uint8 input policy
+
+class TestUint8Policy:
+    def test_resolver(self):
+        emb_ids = EmbeddingLayer(n_in=10, n_out=4, activation="identity")
+        emb_onehot = EmbeddingLayer(n_in=10, n_out=4, activation="identity",
+                                    input_format="onehot")
+        dense = DenseLayer(n_in=10, n_out=4)
+        assert resolve_uint8_policy([emb_ids]) == UINT8_IDS
+        assert resolve_uint8_policy([dense]) == UINT8_SCALE
+        assert resolve_uint8_policy([emb_onehot]) == UINT8_SCALE
+        assert resolve_uint8_policy([emb_ids, dense]) == UINT8_AMBIGUOUS
+        assert resolve_uint8_policy([]) == UINT8_SCALE
+
+    def test_mln_embedding_uint8_ids_survive(self):
+        conf = (NeuralNetConfiguration.builder().seed(5)
+                .list()
+                .layer(EmbeddingLayer(n_in=10, n_out=6,
+                                      activation="identity"))
+                .layer(OutputLayer(n_out=3, activation="softmax"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        ids = np.asarray([0, 3, 7, 9])
+        out_u8 = np.asarray(net.output(ids.astype(np.uint8)))
+        out_i32 = np.asarray(net.output(ids.astype(np.int32)))
+        np.testing.assert_allclose(out_u8, out_i32, rtol=1e-6)
+        # and distinct ids still give distinct rows (not all zeroed to id 0)
+        assert not np.allclose(out_u8[0], out_u8[2])
+
+    def test_mln_dense_uint8_still_scales(self):
+        conf = (NeuralNetConfiguration.builder().seed(5)
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=6, activation="tanh"))
+                .layer(OutputLayer(n_out=3, activation="softmax"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x8 = np.asarray([[0, 51, 102, 255], [255, 0, 13, 26]], np.uint8)
+        out_u8 = np.asarray(net.output(x8))
+        out_f = np.asarray(net.output(x8.astype(np.float32) / 255.0))
+        np.testing.assert_allclose(out_u8, out_f, rtol=1e-5)
+
+    def test_graph_ambiguous_uint8_raises(self):
+        gb = (NeuralNetConfiguration.builder().seed(5).graph_builder()
+              .add_inputs("in")
+              .add_layer("emb", EmbeddingLayer(n_in=10, n_out=4,
+                                               activation="identity"), "in")
+              .add_layer("dense", DenseLayer(n_in=1, n_out=4,
+                                             activation="tanh"), "in")
+              .add_layer("out", OutputLayer(n_in=4, n_out=2,
+                                            activation="softmax"), "emb")
+              .set_outputs("out"))
+        net = ComputationGraph(gb.build()).init()
+        ids = np.asarray([[1], [2]], np.uint8)
+        with pytest.raises(ValueError, match="ambiguous"):
+            net.output(ids)
+        # non-uint8 input is unaffected by the ambiguity
+        net.output(ids.astype(np.int32))
+
+    def test_graph_embedding_only_uint8_is_ids(self):
+        gb = (NeuralNetConfiguration.builder().seed(5).graph_builder()
+              .add_inputs("in")
+              .add_layer("emb", EmbeddingLayer(n_in=10, n_out=4,
+                                               activation="identity"), "in")
+              .add_layer("out", OutputLayer(n_in=4, n_out=2,
+                                            activation="softmax"), "emb")
+              .set_outputs("out"))
+        net = ComputationGraph(gb.build()).init()
+        ids = np.asarray([1, 4, 9])
+        out_u8 = np.asarray(net.output(ids.astype(np.uint8))[0])
+        out_i32 = np.asarray(net.output(ids.astype(np.int32))[0])
+        np.testing.assert_allclose(out_u8, out_i32, rtol=1e-6)
+
+
+# ------------------------------------------------- 3. fastvocab rebuild
+
+class TestFastvocabRebuild:
+    def test_so_is_not_tracked_and_rebuilds_from_source(self, tmp_path):
+        from deeplearning4j_tpu import native as native_mod
+
+        if shutil.which("g++") is None and shutil.which("c++") is None:
+            pytest.skip("no C++ compiler available")
+        so = os.path.join(os.path.dirname(native_mod.__file__),
+                          "_fastvocab.so")
+        moved = tmp_path / "_fastvocab.so"
+        had_so = os.path.exists(so)
+        if had_so:
+            shutil.move(so, moved)
+        native_mod._LIBS.pop("fastvocab", None)
+        try:
+            lib = native_mod._lib("fastvocab")
+            assert lib is not None, "fastvocab failed to rebuild from source"
+            assert os.path.exists(so)
+        finally:
+            native_mod._LIBS.pop("fastvocab", None)
+            if had_so and not os.path.exists(so):
+                shutil.move(moved, so)

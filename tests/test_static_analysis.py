@@ -1,0 +1,434 @@
+"""tpulint static-analysis tests.
+
+Two layers:
+- per-rule unit tests: each of JX001-JX006 on a purpose-built bad snippet
+  (must fire) and a clean snippet (must not fire);
+- the tier-1 gate: the CLI over the whole package must exit 0 against the
+  checked-in baseline, and every baselined finding must carry a reason.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+from deeplearning4j_tpu.analysis import (
+    ALL_RULES,
+    Baseline,
+    DEFAULT_BASELINE_PATH,
+    lint_package,
+    lint_source,
+)
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+def lint(src, rules=None):
+    return lint_source(src, "<snippet>", rules=rules)
+
+
+# --------------------------------------------------------------- JX001
+
+class TestJX001HostSync:
+    def test_block_until_ready_under_jit_fires(self):
+        src = """
+import jax
+
+@jax.jit
+def step(x):
+    y = x + 1
+    y.block_until_ready()
+    return y
+"""
+        fs = lint(src, ["JX001"])
+        assert rules_of(fs) == {"JX001"}
+        assert "block_until_ready" in fs[0].message
+
+    def test_item_and_np_asarray_in_jit_called_helper_fire(self):
+        # the violation is in a helper only *reachable* from a jitted fn —
+        # exercises the call-graph closure, not just the decorated root
+        src = """
+import jax
+import numpy as np
+
+def helper(x):
+    return np.asarray(x).item()
+
+def step(x):
+    return helper(x) + 1
+
+fast = jax.jit(step)
+"""
+        fs = lint(src, ["JX001"])
+        assert len(fs) == 2  # np.asarray + .item
+        assert all(f.rule == "JX001" for f in fs)
+
+    def test_float_on_param_fires_but_config_float_does_not(self):
+        src = """
+import jax
+
+CONF = object()
+
+@jax.jit
+def step(x):
+    lr = float(CONF.learning_rate)   # module config, not param-rooted: clean
+    return x * float(x)              # traced param: fires
+"""
+        fs = lint(src, ["JX001"])
+        assert len(fs) == 1
+        assert fs[0].line == 9
+
+    def test_host_side_np_asarray_is_clean(self):
+        src = """
+import numpy as np
+
+def load(path):
+    return np.asarray([1, 2, 3]).item()
+"""
+        assert lint(src, ["JX001"]) == []
+
+    def test_suppression_comment_silences(self):
+        src = """
+import jax
+
+@jax.jit
+def step(x):
+    return x.item()  # tpulint: disable=JX001
+"""
+        assert lint(src, ["JX001"]) == []
+
+
+# --------------------------------------------------------------- JX002
+
+class TestJX002SideEffects:
+    def test_print_time_random_under_jit_fire(self):
+        src = """
+import jax
+import time
+import random
+import numpy as np
+
+@jax.jit
+def step(x):
+    print("step!")
+    t = time.time()
+    r = random.random()
+    n = np.random.randn()
+    return x + t + r + n
+"""
+        fs = lint(src, ["JX002"])
+        assert len(fs) == 4
+        assert rules_of(fs) == {"JX002"}
+
+    def test_side_effects_outside_trace_are_clean(self):
+        src = """
+import time
+import random
+
+def host_loop():
+    print("epoch", time.time(), random.random())
+"""
+        assert lint(src, ["JX002"]) == []
+
+    def test_jax_random_is_clean(self):
+        src = """
+import jax
+
+@jax.jit
+def step(x, key):
+    return x + jax.random.normal(key, x.shape)
+"""
+        assert lint(src, ["JX002"]) == []
+
+
+# --------------------------------------------------------------- JX003
+
+class TestJX003RetraceHazards:
+    def test_jit_inside_loop_fires(self):
+        src = """
+import jax
+
+def train(batches):
+    out = []
+    for b in batches:
+        out.append(jax.jit(lambda x: x * 2)(b))
+    return out
+"""
+        fs = lint(src, ["JX003"])
+        assert any("inside a loop" in f.message for f in fs)
+
+    def test_static_argnums_on_array_param_fires(self):
+        src = """
+import jax
+
+def step(params, x):
+    return x
+
+fast = jax.jit(step, static_argnums=(1,))
+"""
+        fs = lint(src, ["JX003"])
+        assert len(fs) == 1
+        assert "`x` static" in fs[0].message
+
+    def test_static_argnames_on_scalar_config_is_clean(self):
+        src = """
+import jax
+
+def step(x, n_layers):
+    return x * n_layers
+
+fast = jax.jit(step, static_argnames=("n_layers",))
+"""
+        assert lint(src, ["JX003"]) == []
+
+    def test_module_level_jit_is_clean(self):
+        src = """
+import jax
+
+def step(x):
+    return x * 2
+
+fast = jax.jit(step)
+"""
+        assert lint(src, ["JX003"]) == []
+
+
+# --------------------------------------------------------------- JX004
+
+class TestJX004Float64:
+    def test_f64_dtype_in_traced_code_fires(self):
+        src = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x):
+    return x.astype(jnp.float64)
+"""
+        fs = lint(src, ["JX004"])
+        assert len(fs) == 1
+        assert "float64" in fs[0].message
+
+    def test_host_side_f64_is_clean(self):
+        src = """
+import numpy as np
+
+def serialize(params):
+    return np.asarray(params, np.float64).tobytes()
+"""
+        assert lint(src, ["JX004"]) == []
+
+    def test_x64_gated_f64_is_clean(self):
+        src = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x):
+    dt = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    return x.astype(dt)
+"""
+        assert lint(src, ["JX004"]) == []
+
+
+# --------------------------------------------------------------- JX005
+
+class TestJX005ThreadSafety:
+    BAD = """
+import threading
+
+class Manager:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.progress = 0
+
+    def start(self):
+        t = threading.Thread(target=self._worker, daemon=True)
+        t.start()
+
+    def _worker(self):
+        self.progress += 1
+
+    def reset(self):
+        self.progress = 0
+"""
+
+    def test_unlocked_cross_thread_write_fires(self):
+        fs = lint(self.BAD, ["JX005"])
+        assert len(fs) == 1
+        assert "`self.progress`" in fs[0].message
+
+    def test_locked_writes_are_clean(self):
+        src = self.BAD.replace(
+            "        self.progress += 1",
+            "        with self._lock:\n            self.progress += 1",
+        ).replace(
+            "        self.progress = 0\n",
+            "        with self._lock:\n            self.progress = 0\n", 1)
+        # first replace targets _worker; also lock reset()
+        src = src.replace(
+            "    def reset(self):\n        self.progress = 0",
+            "    def reset(self):\n        with self._lock:\n"
+            "            self.progress = 0")
+        assert lint(src, ["JX005"]) == []
+
+    def test_nested_thread_target_is_seen(self):
+        src = """
+import threading
+
+class Saver:
+    def save(self):
+        def work():
+            self.last_error = "boom"
+        threading.Thread(target=work, daemon=True).start()
+
+    def check(self):
+        self.last_error = None
+"""
+        fs = lint(src, ["JX005"])
+        assert len(fs) == 1
+        assert "last_error" in fs[0].message
+
+    def test_threadless_class_is_clean(self):
+        src = """
+class Plain:
+    def a(self):
+        self.x = 1
+
+    def b(self):
+        self.x = 2
+"""
+        assert lint(src, ["JX005"]) == []
+
+
+# --------------------------------------------------------------- JX006
+
+class TestJX006DtypeSniff:
+    def test_uint8_sniff_fires(self):
+        src = """
+import jax.numpy as jnp
+
+def stage(x):
+    if x.dtype == jnp.uint8:
+        x = x / 255.0
+    return x
+"""
+        fs = lint(src, ["JX006"])
+        assert len(fs) == 1
+        assert "uint8" in fs[0].message
+
+    def test_uint8_as_storage_dtype_is_clean(self):
+        src = """
+import numpy as np
+
+def load(buf):
+    return np.frombuffer(buf, np.uint8)
+"""
+        assert lint(src, ["JX006"]) == []
+
+    def test_preprocessors_module_is_allowed(self, tmp_path):
+        src = """
+import jax.numpy as jnp
+
+def stage(x):
+    if x.dtype == jnp.uint8:
+        return x / 255.0
+    return x
+"""
+        d = tmp_path / "nn" / "conf"
+        d.mkdir(parents=True)
+        p = d / "preprocessors.py"
+        p.write_text(src)
+        from deeplearning4j_tpu.analysis import lint_file
+        assert [f for f in lint_file(str(p)) if f.rule == "JX006"] == []
+
+
+# ------------------------------------------------------------ framework
+
+class TestLinterFramework:
+    def test_registry_has_all_six_rules(self):
+        assert set(ALL_RULES) >= {"JX001", "JX002", "JX003", "JX004",
+                                  "JX005", "JX006"}
+
+    def test_findings_are_typed_and_sorted(self):
+        src = """
+import jax
+
+@jax.jit
+def step(x):
+    print(x)
+    return x.item()
+"""
+        fs = lint(src)
+        assert [f.rule for f in fs] == sorted(
+            [f.rule for f in fs], key=lambda r: [x.rule for x in fs].index(r))
+        for f in fs:
+            assert f.path and f.line > 0 and f.message and f.severity in (
+                "error", "warning") and f.context
+
+    def test_disable_all_comment(self):
+        src = """
+import jax
+
+@jax.jit
+def step(x):
+    return x.item()  # tpulint: disable=all
+"""
+        assert lint(src) == []
+
+    def test_baseline_roundtrip(self, tmp_path):
+        src = """
+import jax
+
+@jax.jit
+def step(x):
+    return x.item()
+"""
+        fs = lint(src, ["JX001"])
+        bl = Baseline.from_findings(fs)
+        p = tmp_path / "baseline.json"
+        bl.save(str(p))
+        loaded = Baseline.load(str(p))
+        new, grandfathered, stale = loaded.split(fs)
+        assert new == [] and len(grandfathered) == 1 and stale == []
+        # freshly written entries carry TODO reasons -> must be rejected
+        assert loaded.missing_reasons()
+
+
+# ------------------------------------------------------------ tier-1 gate
+
+class TestPackageGate:
+    def test_package_lint_is_clean_against_baseline(self):
+        """The in-process equivalent of the CLI gate (fast path)."""
+        findings = lint_package()
+        baseline = Baseline.load(DEFAULT_BASELINE_PATH)
+        new, _grandfathered, _stale = baseline.split(findings)
+        assert new == [], "new tpulint findings:\n" + "\n".join(
+            f.format() for f in new)
+        assert baseline.missing_reasons() == [], (
+            "baselined findings without a reason: "
+            f"{baseline.missing_reasons()}")
+
+    def test_cli_over_package_exits_zero(self):
+        """tier-1 registration: shell the CLI exactly as a developer would."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-m", "deeplearning4j_tpu.analysis"],
+            capture_output=True, text=True, env=env, timeout=300)
+        assert proc.returncode == 0, (
+            f"tpulint CLI failed:\n{proc.stdout}\n{proc.stderr}")
+
+    def test_cli_json_output_and_nonzero_on_violation(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "import jax\n\n@jax.jit\ndef step(x):\n    return x.item()\n")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-m", "deeplearning4j_tpu.analysis",
+             str(bad), "--no-baseline", "--json"],
+            capture_output=True, text=True, env=env, timeout=300)
+        assert proc.returncode == 1
+        data = json.loads(proc.stdout)
+        assert data["new"] and data["new"][0]["rule"] == "JX001"

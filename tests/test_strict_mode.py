@@ -1,0 +1,193 @@
+"""Runtime strict-mode guard tests (analysis/runtime.py).
+
+Covers: transfer-guard raises on an implicit host->device transfer,
+RetraceGuard fires on a shape-varying jitted function, the NaN guard
+kills a diverging fit, and everything is a no-op when disabled.
+"""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.analysis import (
+    RetraceError,
+    RetraceGuard,
+    install_nan_guard,
+    strict_enabled,
+    strict_mode,
+)
+from deeplearning4j_tpu.analysis import runtime as runtime_mod
+
+
+class FakeNet:
+    """Just enough engine surface for watch()/install_nan_guard()."""
+
+    def __init__(self):
+        self._jit_cache = {}
+        self.score_value = 0.5
+        self.iteration = 0
+        self.dispatched = 0
+
+    def _fit_dispatch(self, batch):
+        self.dispatched += 1
+        return batch
+
+
+class TestStrictEnabled:
+    def test_env_unset_defaults_off(self, monkeypatch):
+        monkeypatch.delenv("DL4J_TPU_STRICT", raising=False)
+        assert strict_enabled() is False
+        assert strict_enabled(default=True) is True
+
+    @pytest.mark.parametrize("val,expect", [
+        ("1", True), ("true", True), ("ON", True),
+        ("0", False), ("false", False), ("off", False), ("", False),
+    ])
+    def test_env_values(self, monkeypatch, val, expect):
+        monkeypatch.setenv("DL4J_TPU_STRICT", val)
+        assert strict_enabled() is expect
+
+
+class TestTransferGuard:
+    def test_implicit_transfer_raises_in_strict_mode(self):
+        f = jax.jit(lambda x: x * 2)
+        f(jnp.ones(3))  # warm up outside the guard
+        with strict_mode(enabled=True):
+            with pytest.raises(Exception, match="[Tt]ransfer"):
+                f(np.ones(3, dtype=np.float32))
+
+    def test_explicit_device_put_is_allowed(self):
+        f = jax.jit(lambda x: x * 2)
+        with strict_mode(enabled=True):
+            y = f(jax.device_put(np.ones(3, dtype=np.float32)))
+        assert float(np.asarray(y)[0]) == 2.0
+
+    def test_disabled_is_a_noop(self, monkeypatch):
+        monkeypatch.delenv("DL4J_TPU_STRICT", raising=False)
+        f = jax.jit(lambda x: x * 3)
+        with strict_mode() as guard:
+            assert guard is None  # no-op path yields None
+            y = f(np.ones(3, dtype=np.float32))  # implicit transfer: fine
+        assert float(np.asarray(y)[0]) == 3.0
+
+
+class TestRetraceGuard:
+    def test_fires_on_shape_varying_jit_fn(self):
+        guard = RetraceGuard(limit=2, on_violation="raise")
+        step = jax.jit(guard.wrap(lambda x: x * 2, name="step"))
+        step(jnp.ones(3))   # trace 1
+        step(jnp.ones(4))   # trace 2 (new shape)
+        with pytest.raises(RetraceError, match="compiled 3 times"):
+            step(jnp.ones(5))  # trace 3 > limit
+
+    def test_stable_shapes_do_not_fire(self):
+        guard = RetraceGuard(limit=1, on_violation="raise")
+        step = jax.jit(guard.wrap(lambda x: x + 1, name="stable"))
+        for _ in range(20):
+            step(jnp.ones(3))  # cached after the single trace
+        assert guard.counts["stable"] == 1
+
+    def test_warn_mode_warns_once(self):
+        guard = RetraceGuard(limit=1, on_violation="warn")
+        f = guard.wrap(lambda x: x, name="noisy")
+        f(1)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            f(2)
+            f(3)
+        assert len([x for x in w if "retrace storm" in str(x.message)]) == 1
+
+    def test_watch_fires_on_jit_cache_growth(self):
+        net = FakeNet()
+        guard = RetraceGuard(limit=2, on_violation="raise")
+        guard.watch(net)
+        try:
+            for i in range(2):
+                net._jit_cache[("shape", i)] = object()
+                net._fit_dispatch(i)  # programs <= limit: fine
+            net._jit_cache[("shape", 2)] = object()
+            with pytest.raises(RetraceError):
+                net._fit_dispatch(2)
+        finally:
+            guard.unwatch()
+        # unwatch restores the original bound method
+        net._fit_dispatch(3)
+        assert net.dispatched == 4
+
+    def test_limit_from_env(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_RETRACE_LIMIT", "3")
+        assert RetraceGuard().limit == 3
+        monkeypatch.setenv("DL4J_TPU_RETRACE_LIMIT", "garbage")
+        assert RetraceGuard().limit == 10
+
+
+class TestNanGuard:
+    def test_raises_on_nan_loss(self):
+        net = FakeNet()
+        uninstall = install_nan_guard(net)
+        net._fit_dispatch("b0")  # finite loss: fine
+        net.score_value = float("nan")
+        with pytest.raises(FloatingPointError, match="non-finite loss"):
+            net._fit_dispatch("b1")
+        uninstall()
+
+    def test_raises_on_inf_loss(self):
+        net = FakeNet()
+        install_nan_guard(net)
+        net.score_value = float("inf")
+        with pytest.raises(FloatingPointError):
+            net._fit_dispatch("b0")
+
+    def test_check_every_amortizes_the_sync(self):
+        net = FakeNet()
+        install_nan_guard(net, check_every=3)
+        net.score_value = float("nan")
+        net._fit_dispatch("b0")  # 1 % 3 != 0: not checked yet
+        net._fit_dispatch("b1")
+        with pytest.raises(FloatingPointError):
+            net._fit_dispatch("b2")  # 3 % 3 == 0: checked
+
+    def test_uninstall_restores_dispatch(self):
+        net = FakeNet()
+        uninstall = install_nan_guard(net)
+        uninstall()
+        net.score_value = float("nan")
+        net._fit_dispatch("b0")  # guard removed: no raise
+        assert net.dispatched == 1
+
+
+class TestStrictModeComposition:
+    def test_net_gets_watch_and_nan_guard_and_teardown(self):
+        net = FakeNet()
+        orig = net._fit_dispatch
+        with strict_mode(net, enabled=True, retrace_limit=100) as guard:
+            assert isinstance(guard, RetraceGuard)
+            assert net._fit_dispatch is not orig  # patched (watch + nan)
+            net.score_value = float("nan")
+            with pytest.raises(FloatingPointError):
+                net._fit_dispatch("batch")
+        assert net._fit_dispatch == orig  # fully restored (bound method eq)
+
+    def test_on_violation_propagates(self):
+        net = FakeNet()
+        with strict_mode(net, enabled=True, retrace_limit=1,
+                         nan_guard=False):
+            net._jit_cache["a"] = object()
+            net._jit_cache["b"] = object()
+            with pytest.raises(RetraceError):
+                net._fit_dispatch("batch")
+
+    def test_runtime_module_has_no_import_time_jax_dependency(self):
+        # strict_mode imports jax lazily so the linter CLI stays jax-free
+        import ast
+        import inspect
+        tree = ast.parse(inspect.getsource(runtime_mod))
+        toplevel = [n for n in tree.body
+                    if isinstance(n, (ast.Import, ast.ImportFrom))]
+        for n in toplevel:
+            names = [a.name for a in n.names] if isinstance(n, ast.Import) \
+                else [n.module or ""]
+            assert not any(name.split(".")[0] == "jax" for name in names)
