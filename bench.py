@@ -20,7 +20,7 @@ recorded BENCH_r*.json that carries it (the first measurement establishes
 the number to beat — the reference publishes none, BASELINE.md).
 
 Env knobs: BENCH_CONFIGS (comma list), BENCH_STEPS, BENCH_WARMUP,
-BENCH_BATCH_<CONFIG>, BENCH_PEAK_FLOPS.
+BENCH_BATCH_<CONFIG>, BENCH_PEAK_FLOPS, BENCH_SUPERSTEP_K.
 """
 
 import glob
@@ -245,6 +245,43 @@ def bench_lenet_step(steps, warmup):
     _ = net.score_value  # forces completion of the last step
     sps = batch * steps / (time.perf_counter() - t0)
     return _entry("lenet_mnist_fit_samples_per_sec", sps, "samples/sec")
+
+
+def bench_lenet_superstep(steps, warmup):
+    """Superstep dispatch fusion (PERF.md §13): K train iterations per
+    device dispatch over device-cached LeNet, against the per-batch loop on
+    the SAME cached data in the SAME run — the ratio is the dispatch
+    amortization, uncontaminated by run-to-run transport variance."""
+    from deeplearning4j_tpu.models import zoo
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    batch = int(os.environ.get("BENCH_BATCH_LENET", "512"))
+    k = int(os.environ.get("BENCH_SUPERSTEP_K", "8"))
+    # Each cached epoch must form >= 2 full K-blocks so the timed loop is
+    # superstep dispatches, not tail programs.
+    distinct = 2 * k
+
+    def mk(rng, b):
+        return (rng.rand(b, 28, 28, 1).astype("float32"),
+                np.eye(10, dtype="float32")[rng.randint(0, 10, b)])
+
+    per_net = MultiLayerNetwork(zoo.lenet_mnist()).init()
+    per_sps, _ = _timed_fit(per_net, mk, batch, steps, warmup,
+                            distinct=distinct, cached=True)
+
+    conf = zoo.lenet_mnist()
+    conf.global_conf.superstep_k = k
+    sup_net = MultiLayerNetwork(conf).init()
+    sup_sps, _ = _timed_fit(sup_net, mk, batch, steps, warmup,
+                            distinct=distinct, cached=True)
+
+    head = _entry(f"lenet_superstep_k{k}_samples_per_sec", sup_sps,
+                  "samples/sec",
+                  note=f"{k} iterations fused per dispatch, device-cached")
+    head["per_batch_same_run"] = round(per_sps, 1)
+    ratio = _entry("lenet_superstep_vs_per_batch_ratio",
+                   sup_sps / max(per_sps, 1e-9), "x (same-run)")
+    return head, ratio
 
 
 def bench_char_rnn(steps, warmup):
@@ -615,8 +652,8 @@ def main():
     warmup = int(os.environ.get("BENCH_WARMUP", "5"))
     configs = os.environ.get(
         "BENCH_CONFIGS",
-        "resnet50,lenet,char_rnn,lenet_step,word2vec,vgg16,flash_attn,"
-        "flash_tri,transformer"
+        "resnet50,lenet,char_rnn,lenet_step,lenet_superstep,word2vec,vgg16,"
+        "flash_attn,flash_tri,transformer"
     ).split(",")
 
     head, extra = None, {}
@@ -637,6 +674,11 @@ def main():
     if "lenet_step" in configs:
         e = bench_lenet_step(max(200, steps), warmup)
         extra[e["metric"]] = e
+    if "lenet_superstep" in configs:
+        # Same >=200-step floor as the other lenet configs: the compared
+        # loops must both dwarf the tail sync RTT (PERF.md §4).
+        for e in bench_lenet_superstep(max(200, steps), warmup):
+            extra[e["metric"]] = e
     if "word2vec" in configs:
         e = bench_word2vec(steps, warmup)
         extra[e["metric"]] = e

@@ -13,13 +13,45 @@ which is the role the reference's prefetch thread plays for disk I/O.
 
 from __future__ import annotations
 
+import logging
+import os
 import queue
 import threading
-from typing import Iterable, Iterator, List, Optional, Sequence
+from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence
 
 import numpy as np
 
-from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu import observability as _obs
+
+_log = logging.getLogger(__name__)
+
+_M_CACHE_BYTES = _obs.metrics.gauge(
+    "dl4j_device_cache_bytes",
+    "Bytes of training batches resident in HBM across "
+    "DeviceCacheDataSetIterator caches")
+
+
+def maybe_reset(iterator) -> bool:
+    """Reset `iterator` if it supports it; returns whether reset() ran.
+
+    Swallows only the "not resettable" case (no reset attribute /
+    NotImplementedError — e.g. a one-shot generator wrapped in an adapter);
+    an unexpected failure is LOGGED, not silently hidden, because a reset
+    that half-ran can make the following epoch train on a partial stream.
+    """
+    reset = getattr(iterator, "reset", None)
+    if reset is None:
+        return False
+    try:
+        reset()
+        return True
+    except NotImplementedError:
+        return False
+    except Exception:
+        _log.warning("%s.reset() failed unexpectedly; continuing without "
+                     "reset", type(iterator).__name__, exc_info=True)
+        return False
 
 
 class DataSetIterator:
@@ -85,11 +117,18 @@ class ListDataSetIterator(DataSetIterator):
 _TUPLE_PUT_MAX_BYTES = 4 << 20
 
 
-def stage_to_device(ds: DataSet) -> DataSet:
-    """Transfer one DataSet's arrays host->device, choosing the transfer
-    shape empirically fastest for the batch size (see _TUPLE_PUT_MAX_BYTES)."""
+def _stage_arrays(parts: Sequence[np.ndarray]) -> List:
+    """device_put a set of host arrays, choosing the transfer shape
+    empirically fastest for the total size (see _TUPLE_PUT_MAX_BYTES)."""
     import jax
 
+    if sum(p.nbytes for p in parts) <= _TUPLE_PUT_MAX_BYTES:
+        return list(jax.device_put(tuple(parts)))
+    return [jax.device_put(p) for p in parts]
+
+
+def stage_to_device(ds: DataSet) -> DataSet:
+    """Transfer one DataSet's arrays host->device (see _stage_arrays)."""
     parts = [np.asarray(ds.features)]
     idx = {"features": 0}
     for name in ("labels", "features_mask", "labels_mask"):
@@ -97,10 +136,7 @@ def stage_to_device(ds: DataSet) -> DataSet:
         if a is not None:
             idx[name] = len(parts)
             parts.append(np.asarray(a))
-    if sum(p.nbytes for p in parts) <= _TUPLE_PUT_MAX_BYTES:
-        staged = jax.device_put(tuple(parts))
-    else:
-        staged = [jax.device_put(p) for p in parts]
+    staged = _stage_arrays(parts)
     return DataSet(
         staged[0],
         staged[idx["labels"]] if "labels" in idx else None,
@@ -177,6 +213,19 @@ class AsyncDataSetIterator(DataSetIterator):
             self.base.reset()
 
 
+def _drop_staged(staged: Sequence[DataSet]) -> None:
+    """Eagerly free the device buffers of partially staged batches."""
+    for ds in staged:
+        for a in (ds.features, ds.labels, ds.features_mask, ds.labels_mask):
+            delete = getattr(a, "delete", None)
+            if delete is None:
+                continue
+            try:
+                delete()
+            except Exception:
+                pass  # already deleted / not a device array
+
+
 class DeviceCacheDataSetIterator(DataSetIterator):
     """Stage every batch to DEVICE memory once, replay from HBM thereafter.
 
@@ -194,6 +243,7 @@ class DeviceCacheDataSetIterator(DataSetIterator):
         self.base = base
         self.max_bytes = max_bytes
         self._cache: Optional[List[DataSet]] = None
+        self._cache_bytes = 0
 
     def _ds_bytes(self, ds: DataSet) -> int:
         return sum(
@@ -205,16 +255,26 @@ class DeviceCacheDataSetIterator(DataSetIterator):
     def __iter__(self):
         if self._cache is None:
             staged, total = [], 0
-            for ds in self.base:
-                total += self._ds_bytes(ds)
-                if self.max_bytes is not None and total > self.max_bytes:
-                    raise MemoryError(
-                        f"DeviceCacheDataSetIterator: dataset exceeds "
-                        f"max_bytes={self.max_bytes}; use AsyncDataSetIterator "
-                        f"for streaming-scale data"
-                    )
-                staged.append(stage_to_device(ds))
+            try:
+                for ds in self.base:
+                    total += self._ds_bytes(ds)
+                    if self.max_bytes is not None and total > self.max_bytes:
+                        raise MemoryError(
+                            f"DeviceCacheDataSetIterator: dataset exceeds "
+                            f"max_bytes={self.max_bytes}; use "
+                            f"AsyncDataSetIterator for streaming-scale data"
+                        )
+                    staged.append(stage_to_device(ds))
+            except BaseException:
+                # Mid-staging failure (MemoryError budget, device OOM,
+                # consumer interrupt): `_cache` stays None, so without
+                # cleanup the partially staged batches would sit in HBM
+                # until GC while the next attempt restages from scratch.
+                _drop_staged(staged)
+                raise
             self._cache = staged
+            self._cache_bytes = total
+            _M_CACHE_BYTES.inc(total)
         return iter(self._cache)
 
     def reset(self):
@@ -223,6 +283,8 @@ class DeviceCacheDataSetIterator(DataSetIterator):
     def invalidate(self):
         """Drop the device cache (e.g. after the underlying data changed)."""
         self._cache = None
+        _M_CACHE_BYTES.inc(-self._cache_bytes)
+        self._cache_bytes = 0
 
     def total_examples(self):
         if self._cache is not None:
@@ -313,3 +375,245 @@ class IteratorDataSetIterator(DataSetIterator):
 
     def batch_size(self):
         return self._batch_size
+
+
+# --------------------------------------------------------------- superstep
+# Superstep training (PERF.md §13): K staged batches stacked into [K, B, ...]
+# device arrays so ONE jitted dispatch runs K train iterations as a
+# `lax.scan` over the leading axis. The containers below are what the
+# engines' `_fit_dispatch` recognizes as "already K batches".
+
+
+class Superbatch:
+    """K same-shape DataSets stacked along a new leading axis.
+
+    Field names match DataSet (features/labels/features_mask/labels_mask) so
+    introspection-based consumers (`observability.host_nbytes`,
+    `StepProfiler._host_nbytes`) keep working unchanged; each array is
+    `[K, B, ...]` (masks `[K, B]` / `[K, B, T]`).
+    """
+
+    def __init__(self, features, labels=None, features_mask=None,
+                 labels_mask=None, k: int = 1):
+        self.features = features
+        self.labels = labels
+        self.features_mask = features_mask
+        self.labels_mask = labels_mask
+        self.k = int(k)
+
+    def num_examples(self) -> int:
+        return int(np.shape(self.features)[0] * np.shape(self.features)[1])
+
+
+class MultiSuperbatch:
+    """K same-shape MultiDataSets stacked along a new leading axis (the
+    ComputationGraph twin of `Superbatch`; per-part lists of [K, B, ...])."""
+
+    def __init__(self, features, labels, features_masks=None,
+                 labels_masks=None, k: int = 1):
+        self.features = list(features)
+        self.labels = list(labels)
+        self.features_masks = None if features_masks is None else list(features_masks)
+        self.labels_masks = None if labels_masks is None else list(labels_masks)
+        self.k = int(k)
+
+    def num_examples(self) -> int:
+        return int(np.shape(self.features[0])[0] * np.shape(self.features[0])[1])
+
+
+def _part_sig(a) -> Optional[tuple]:
+    if a is None:
+        return None
+    dtype = getattr(a, "dtype", None)  # device arrays: no host pull
+    if dtype is None:
+        dtype = np.asarray(a).dtype
+    return (tuple(np.shape(a)), str(dtype))
+
+
+def batch_signature(item) -> tuple:
+    """Shape/dtype/mask-presence signature of one batch. Only CONSECUTIVE
+    batches with identical signatures stack into a superbatch; a signature
+    change flushes the current block (automatic per-batch fallback for
+    heterogeneous streams — every distinct signature is its own program)."""
+    if isinstance(item, MultiDataSet):
+        return (
+            "mds",
+            tuple(_part_sig(a) for a in item.features),
+            tuple(_part_sig(a) for a in item.labels),
+            None if item.features_masks is None
+            else tuple(_part_sig(a) for a in item.features_masks),
+            None if item.labels_masks is None
+            else tuple(_part_sig(a) for a in item.labels_masks),
+        )
+    return ("ds", _part_sig(item.features), _part_sig(item.labels),
+            _part_sig(item.features_mask), _part_sig(item.labels_mask))
+
+
+def batch_nbytes(item) -> int:
+    """Total bytes of one batch's arrays (host or device)."""
+    if isinstance(item, MultiDataSet):
+        parts = list(item.features) + list(item.labels)
+        for masks in (item.features_masks, item.labels_masks):
+            if masks is not None:
+                parts.extend(masks)
+    else:
+        parts = [item.features, item.labels, item.features_mask,
+                 item.labels_mask]
+    return sum(int(a.nbytes) if hasattr(a, "nbytes")
+               else np.asarray(a).nbytes for a in parts if a is not None)
+
+
+def _stack_parts(parts: Sequence) -> Optional[Any]:
+    """Stack K same-shape parts along a new leading axis. Host parts stack
+    host-side (staged afterwards in ONE transfer); device-resident parts
+    (a DeviceCacheDataSetIterator replay) stack on device."""
+    if parts[0] is None:
+        return None
+    if all(isinstance(p, np.ndarray) for p in parts):
+        return np.stack(parts)
+    import jax.numpy as jnp
+
+    return jnp.stack([jnp.asarray(p) for p in parts])
+
+
+def _maybe_stage(parts: List) -> List:
+    """Stage the np members of a flat part list to device (one tuple-put
+    when small, per-array puts when large — see `_stage_arrays`)."""
+    np_idx = [i for i, p in enumerate(parts) if isinstance(p, np.ndarray)]
+    if not np_idx:
+        return parts
+    staged = _stage_arrays([parts[i] for i in np_idx])
+    out = list(parts)
+    for i, s in zip(np_idx, staged):
+        out[i] = s
+    return out
+
+
+def stack_superbatch(batches: Sequence, stage: bool = True):
+    """Stack K same-signature batches into a Superbatch/MultiSuperbatch,
+    optionally staging the stacked arrays to device in one transfer."""
+    first = batches[0]
+    k = len(batches)
+    if isinstance(first, MultiDataSet):
+        feats = [_stack_parts([b.features[i] for b in batches])
+                 for i in range(len(first.features))]
+        labs = [_stack_parts([b.labels[i] for b in batches])
+                for i in range(len(first.labels))]
+        fmasks = None if first.features_masks is None else [
+            _stack_parts([b.features_masks[i] for b in batches])
+            for i in range(len(first.features_masks))]
+        lmasks = None if first.labels_masks is None else [
+            _stack_parts([b.labels_masks[i] for b in batches])
+            for i in range(len(first.labels_masks))]
+        if stage:
+            flat = feats + labs + (fmasks or []) + (lmasks or [])
+            flat = _maybe_stage(flat)
+            pos = 0
+            for dst in (feats, labs, fmasks, lmasks):
+                if dst is None:
+                    continue
+                dst[:] = flat[pos:pos + len(dst)]
+                pos += len(dst)
+        return MultiSuperbatch(feats, labs, fmasks, lmasks, k=k)
+    parts = [
+        _stack_parts([b.features for b in batches]),
+        _stack_parts([b.labels for b in batches]),
+        _stack_parts([b.features_mask for b in batches]),
+        _stack_parts([b.labels_mask for b in batches]),
+    ]
+    if stage:
+        parts = _maybe_stage(parts)
+    return Superbatch(parts[0], parts[1], parts[2], parts[3], k=k)
+
+
+class SuperbatchIterator(DataSetIterator):
+    """Chunk any base iterator into K-blocks for superstep training.
+
+    Consecutive same-signature batches are stacked into `[K, B, ...]`
+    superbatches (see `stack_superbatch`); a signature change or the end of
+    the stream flushes early, so the last `< K` batches form a TRUE-LENGTH
+    tail block (no padding — the engines compile one extra program per
+    distinct block length and the numerics match the per-batch loop
+    exactly). Singleton blocks yield the ORIGINAL item, reusing the
+    engine's per-batch program.
+
+    Byte-budget aware: `max_bytes` (default from `DL4J_TPU_SUPERSTEP_BYTES`)
+    caps a block's stacked size, lowering the effective K for large batches
+    so the stacked superbatch never multiplies peak HBM unexpectedly.
+
+    When the base is a `DeviceCacheDataSetIterator` the stacked device
+    blocks are cached here too (keyed on the identity of the base's cache,
+    so `invalidate()` propagates): cached epochs restack ONCE, not per
+    epoch.
+    """
+
+    def __init__(self, base: Iterable, k: int,
+                 max_bytes: Optional[int] = None, stage: bool = True,
+                 cache: Optional[bool] = None,
+                 transform: Optional[Callable] = None):
+        self.base = base
+        self.k = max(1, int(k))
+        if max_bytes is None:
+            env = os.environ.get("DL4J_TPU_SUPERSTEP_BYTES")
+            max_bytes = int(env) if env else None
+        self.max_bytes = max_bytes
+        self.stage = stage
+        self.cache = (isinstance(base, DeviceCacheDataSetIterator)
+                      if cache is None else bool(cache))
+        self.transform = transform
+        self._blocks: Optional[List] = None
+        self._built_from: Any = None
+
+    def _iter_blocks(self) -> Iterator:
+        buf: List = []
+        sig = None
+        limit = self.k
+
+        def flush():
+            if len(buf) == 1:
+                return buf[0]
+            return stack_superbatch(buf, stage=self.stage)
+
+        for item in self.base:
+            if self.transform is not None:
+                item = self.transform(item)
+            s = batch_signature(item)
+            if buf and s != sig:
+                yield flush()
+                buf = []
+            if not buf:
+                sig = s
+                limit = self.k
+                if self.max_bytes is not None:
+                    per = batch_nbytes(item)
+                    if per > 0:
+                        limit = max(1, min(self.k, self.max_bytes // per))
+            buf.append(item)
+            if len(buf) >= limit:
+                yield flush()
+                buf = []
+        if buf:
+            yield flush()
+
+    def __iter__(self):
+        if not self.cache:
+            return self._iter_blocks()
+        base_cache = getattr(self.base, "_cache", None)
+        if self._blocks is None or self._built_from is not base_cache:
+            self._blocks = list(self._iter_blocks())
+            # Captured AFTER iterating (a cold DeviceCache builds its cache
+            # during the iteration above); identity mismatch on the next
+            # epoch means the base was invalidated and restaged.
+            self._built_from = getattr(self.base, "_cache", None)
+        return iter(self._blocks)
+
+    def reset(self):
+        maybe_reset(self.base)
+
+    def batch_size(self):
+        bs = getattr(self.base, "batch_size", None)
+        return bs() if callable(bs) else None
+
+    def total_examples(self):
+        te = getattr(self.base, "total_examples", None)
+        return te() if callable(te) else None

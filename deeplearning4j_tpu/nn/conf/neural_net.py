@@ -88,6 +88,10 @@ class GlobalConf:
     convolution_mode: Any = ConvolutionMode.TRUNCATE
     max_num_line_search_iterations: int = 5
     dtype: str = "float32"  # compute/param dtype policy ("float32" | "bfloat16")
+    # Superstep training: fuse up to K train iterations into ONE device
+    # dispatch (lax.scan over stacked batches; PERF.md §13). 0/1 = per-batch
+    # dispatch. Overridable at runtime via DL4J_TPU_SUPERSTEP_K.
+    superstep_k: int = 0
 
     def to_dict(self):
         d = {}
@@ -156,6 +160,7 @@ class Builder:
     def l2(self, v): self._g.l2 = float(v); return self
     def drop_out(self, v): self._g.dropout = float(v); return self
     def use_drop_connect(self, v=True): self._g.use_drop_connect = bool(v); return self
+    def superstep_k(self, v): self._g.superstep_k = int(v); return self
     def minimize(self, v=True): self._g.minimize = bool(v); return self
     def gradient_normalization(self, v): self._g.gradient_normalization = GradientNormalization.of(v); return self
     def gradient_normalization_threshold(self, v): self._g.gradient_normalization_threshold = float(v); return self

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import copy
 import math
+import os
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -37,7 +38,14 @@ from deeplearning4j_tpu.nn.layers import OUTPUT_LAYER_TYPES, get_impl
 from deeplearning4j_tpu.ops import grad_norm as grad_norm_mod
 from deeplearning4j_tpu.ops import schedules as schedules_mod
 from deeplearning4j_tpu.ops import updaters as updaters_mod
+from deeplearning4j_tpu.nn import superstep as _superstep
 from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.datasets.iterators import (
+    MultiSuperbatch,
+    Superbatch,
+    SuperbatchIterator,
+    maybe_reset,
+)
 from deeplearning4j_tpu import observability as _obs
 
 # Hot-loop series resolved once at import (observability/metrics.py rule 2).
@@ -47,11 +55,21 @@ _M_ITERS = _obs.metrics.counter(
 _M_EPOCHS = _obs.metrics.counter(
     "dl4j_train_epochs_total", "Completed fit() epochs",
     label_names=("engine",)).labels(engine="graph")
-_M_DISPATCH = _obs.metrics.histogram(
+_M_DISPATCH_FAMILY = _obs.metrics.histogram(
     "dl4j_step_dispatch_seconds",
     "Host time to dispatch one staged batch (async — completion is NOT "
     "awaited; see dl4j_step_latency_seconds from StepProfiler for settled "
-    "latency)", label_names=("engine",)).labels(engine="graph")
+    "latency); `k` = train iterations fused into the dispatch (superstep)",
+    label_names=("engine", "k"))
+_M_DISPATCH_K = {1: _M_DISPATCH_FAMILY.labels(engine="graph", k="1")}
+
+
+def _dispatch_observe(k: int, seconds: float) -> None:
+    child = _M_DISPATCH_K.get(k)
+    if child is None:  # few distinct k values per process; cache children
+        child = _M_DISPATCH_FAMILY.labels(engine="graph", k=str(k))
+        _M_DISPATCH_K[k] = child
+    child.observe(seconds)
 _M_H2D = _obs.metrics.counter(
     "dl4j_host_to_device_bytes_total",
     "Host-resident bytes staged to device with training batches",
@@ -284,7 +302,12 @@ class ComputationGraph:
         return self._jit_cache[key]
 
     def _build_jit(self, kind: str, train=False, keep_rnn_state=False,
-                   advance=False, collect=False, algo=None):
+                   advance=False, collect=False, algo=None, k=None,
+                   scan=True):
+        # `k`/`scan` select the superstep program shape (`nn/superstep.py`,
+        # see MultiLayerNetwork._build_jit): distinct block lengths register
+        # as distinct cached programs so StepProfiler attributes a tail
+        # block's first call to compile.
         if kind == "solver_step":
             from jax.flatten_util import ravel_pytree
 
@@ -338,6 +361,31 @@ class ComputationGraph:
                                        fmasks, lmasks, step, sub, carry_rnn=False)
                 return out + ((step + 1.0, key),)
             return jax.jit(step_fn, donate_argnums=(0, 2))
+        if kind == "train_superstep":
+            # K full train iterations as ONE dispatch: a fused loop (`lax.scan`
+            # by default, opt-in unrolled — `nn/superstep.py`) over the
+            # leading [K] axis of stacked input/label/mask LISTS (lists are
+            # pytrees, so the loop slices every entry; None mask entries are
+            # empty pytrees and pass through). Clock advance matches the
+            # per-batch `train_step` exactly — bit-identical RNG chain.
+            # See MultiLayerNetwork's twin + PERF.md §13.
+            def step_super(params, state, opt_state, inputs, labels, fmasks,
+                           lmasks, clock):
+                def body(carry, inp):
+                    params, state, opt_state, (step, key) = carry
+                    ins, labs, fms, lms = inp
+                    key, sub = jax.random.split(key)
+                    params, state, opt_state, loss = self._train_step(
+                        params, state, opt_state, ins, labs, fms, lms, step,
+                        sub, carry_rnn=False)
+                    return (params, state, opt_state, (step + 1.0, key)), loss
+
+                (params, state, opt_state,
+                 clock), losses = _superstep.superstep_loop(
+                    body, (params, state, opt_state, clock),
+                    (inputs, labels, fmasks, lmasks), k, scan)
+                return params, state, opt_state, losses, clock
+            return jax.jit(step_super, donate_argnums=(0, 2))
         if kind == "train_step_stats":
             def step_fn_s(params, state, opt_state, inputs, labels, fmasks, lmasks, clock):
                 step, key = clock
@@ -579,39 +627,53 @@ class ComputationGraph:
             iterator = [_as_mds(data, labels)]
         else:
             iterator = data
-        if hasattr(iterator, "reset"):
-            try:
-                iterator.reset()
-            except Exception:
-                pass
+        maybe_reset(iterator)
         for listener in self.listeners:
             listener.on_epoch_start(self)
         with _obs.tracer.span("graph.fit", cat="train", epoch=self.epoch):
-            for item in iterator:
-                self._fit_dispatch(_as_mds(item))
+            k = self._superstep_k()
+            if k > 1:
+                for item in self._superstep_wrap(iterator, k):
+                    self._fit_dispatch(
+                        item if isinstance(item, MultiSuperbatch)
+                        else _as_mds(item))
+            else:
+                for item in iterator:
+                    self._fit_dispatch(_as_mds(item))
         self.epoch += 1
         _M_EPOCHS.inc()
         for listener in self.listeners:
             listener.on_epoch_end(self)
         return self
 
-    def _fit_dispatch(self, mds: MultiDataSet):
-        """tBPTT/plain dispatch + iterations loop for one staged batch —
-        shared by `fit()` and `ParallelWrapper`. Observability choke point
-        (see `MultiLayerNetwork._fit_dispatch`); `StepProfiler` patches this
+    def _fit_dispatch(self, mds):
+        """tBPTT/plain/superstep dispatch + iterations loop for one staged
+        batch (or stacked `MultiSuperbatch`) — shared by `fit()` and
+        `ParallelWrapper`. Observability choke point (see
+        `MultiLayerNetwork._fit_dispatch`); `StepProfiler` patches this
         method on the instance."""
         _M_H2D.inc(_obs.host_nbytes(mds.features, mds.labels,
-                                    mds.features_masks, mds.labels_masks))
+                                    mds.features_masks
+                                    if hasattr(mds, "features_masks")
+                                    else mds.features_mask,
+                                    mds.labels_masks
+                                    if hasattr(mds, "labels_masks")
+                                    else mds.labels_mask))
         it0 = self.iteration
         t0 = time.perf_counter()
         with _obs.iteration_span("graph", it0 + 1):
             try:
                 return self._fit_dispatch_inner(mds)
             finally:
-                _M_DISPATCH.observe(time.perf_counter() - t0)
+                _dispatch_observe(int(getattr(mds, "k", 1)),
+                                  time.perf_counter() - t0)
                 _M_ITERS.inc(max(0, self.iteration - it0))
 
-    def _fit_dispatch_inner(self, mds: MultiDataSet):
+    def _fit_dispatch_inner(self, mds):
+        if isinstance(mds, (MultiSuperbatch, Superbatch)):
+            # Stacked K-block: `_superstep_k` gated out solver / tBPTT /
+            # stats / multi-iteration paths before blocks formed.
+            return self._fit_superstep(mds)
         g = self.conf.global_conf
         algo = OptimizationAlgorithm.of(g.optimization_algo)
         if algo != OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT:
@@ -647,6 +709,83 @@ class ComputationGraph:
         self.last_training_stats = {}
         for listener in self.listeners:
             listener.iteration_done(self, self.iteration)
+
+    # -------------------------------------------------------------- superstep
+
+    def _superstep_k(self) -> int:
+        """Effective superstep K (see `MultiLayerNetwork._superstep_k`):
+        the config/env knob, gated to 0 for stats listeners, tBPTT, solver
+        optimizers, and multi-`iterations` batches."""
+        env = os.environ.get("DL4J_TPU_SUPERSTEP_K")
+        g = self.conf.global_conf
+        try:
+            k = int(env) if env else int(getattr(g, "superstep_k", 0) or 0)
+        except ValueError:
+            return 0
+        if (k < 2 or self._collect_stats
+                or max(1, g.iterations) != 1
+                or BackpropType.of(self.conf.backprop_type)
+                == BackpropType.TRUNCATED_BPTT
+                or OptimizationAlgorithm.of(g.optimization_algo)
+                != OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT):
+            return 0
+        return k
+
+    def _superstep_wrap(self, iterator, k: int):
+        """SuperbatchIterator over `iterator`, converting items to
+        MultiDataSet BEFORE stacking; the wrapper is cached on the base so
+        device-cached epochs restack once (see MultiLayerNetwork twin)."""
+        if isinstance(iterator, SuperbatchIterator):
+            return iterator
+        wrapper = getattr(iterator, "_superbatch_wrapper", None)
+        if (isinstance(wrapper, SuperbatchIterator)
+                and wrapper.base is iterator and wrapper.k == k):
+            return wrapper
+        wrapper = SuperbatchIterator(iterator, k, transform=_as_mds)
+        try:
+            iterator._superbatch_wrapper = wrapper
+        except (AttributeError, TypeError):
+            pass  # lists/tuples/slots: re-wrapped per fit(), still correct
+        return wrapper
+
+    def _fit_superstep(self, sb):
+        """One dispatch, K train iterations (`train_superstep` scan); the
+        `[K]` loss vector fans out to listeners per iteration — same
+        (iteration, score) sequence as the per-batch loop."""
+        if isinstance(sb, Superbatch):
+            # DataSet-shaped block (e.g. from ParallelWrapper): lift to the
+            # graph's list-of-parts shape.
+            sb = MultiSuperbatch(
+                [sb.features], [sb.labels],
+                None if sb.features_mask is None else [sb.features_mask],
+                None if sb.labels_mask is None else [sb.labels_mask],
+                k=sb.k)
+        k = int(sb.k)
+        if k == 1:  # defensive: SuperbatchIterator yields raw singletons
+            return self._fit_one(MultiDataSet(
+                features=[f[0] for f in sb.features],
+                labels=[l[0] for l in sb.labels],
+                features_masks=None if sb.features_masks is None
+                else [None if m is None else m[0] for m in sb.features_masks],
+                labels_masks=None if sb.labels_masks is None
+                else [None if m is None else m[0] for m in sb.labels_masks],
+            ))
+        step_fn = self._get_jit("train_superstep", k=k,
+                                scan=_superstep.use_scan())
+        (self.params_tree, self.state, self.opt_state, losses,
+         self._clock) = step_fn(
+            self.params_tree, self.state, self.opt_state,
+            [jnp.asarray(f) for f in sb.features],
+            [jnp.asarray(l) for l in sb.labels],
+            _as_mask_list(sb.features_masks),
+            _as_mask_list(sb.labels_masks),
+            self._device_clock(),
+        )
+        for i in range(k):
+            self._score = losses[i]  # device scalar; sync deferred
+            self.iteration += 1
+            for listener in self.listeners:
+                listener.iteration_done(self, self.iteration)
 
     def _fit_tbptt(self, mds: MultiDataSet):
         """Truncated BPTT over a DAG (reference: `ComputationGraph` tBPTT path):
@@ -854,11 +993,7 @@ class ComputationGraph:
         from deeplearning4j_tpu.eval.evaluation import Evaluation
 
         ev = Evaluation(top_n=top_n)
-        if hasattr(iterator, "reset"):
-            try:
-                iterator.reset()
-            except Exception:
-                pass
+        maybe_reset(iterator)
         if isinstance(iterator, (DataSet, MultiDataSet)):
             iterator = [iterator]
         for item in iterator:

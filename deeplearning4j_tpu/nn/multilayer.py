@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import copy
 import math
+import os
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -43,7 +44,13 @@ from deeplearning4j_tpu.nn.layers import OUTPUT_LAYER_TYPES, get_impl
 from deeplearning4j_tpu.ops import grad_norm as grad_norm_mod
 from deeplearning4j_tpu.ops import schedules as schedules_mod
 from deeplearning4j_tpu.ops import updaters as updaters_mod
+from deeplearning4j_tpu.nn import superstep as _superstep
 from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import (
+    Superbatch,
+    SuperbatchIterator,
+    maybe_reset,
+)
 from deeplearning4j_tpu import observability as _obs
 
 # Hot-loop series resolved once at import (observability/metrics.py rule 2).
@@ -53,11 +60,21 @@ _M_ITERS = _obs.metrics.counter(
 _M_EPOCHS = _obs.metrics.counter(
     "dl4j_train_epochs_total", "Completed fit() epochs",
     label_names=("engine",)).labels(engine="mln")
-_M_DISPATCH = _obs.metrics.histogram(
+_M_DISPATCH_FAMILY = _obs.metrics.histogram(
     "dl4j_step_dispatch_seconds",
     "Host time to dispatch one staged batch (async — completion is NOT "
     "awaited; see dl4j_step_latency_seconds from StepProfiler for settled "
-    "latency)", label_names=("engine",)).labels(engine="mln")
+    "latency); `k` = train iterations fused into the dispatch (superstep)",
+    label_names=("engine", "k"))
+_M_DISPATCH_K = {1: _M_DISPATCH_FAMILY.labels(engine="mln", k="1")}
+
+
+def _dispatch_observe(k: int, seconds: float) -> None:
+    child = _M_DISPATCH_K.get(k)
+    if child is None:  # few distinct k values per process; cache children
+        child = _M_DISPATCH_FAMILY.labels(engine="mln", k=str(k))
+        _M_DISPATCH_K[k] = child
+    child.observe(seconds)
 _M_H2D = _obs.metrics.counter(
     "dl4j_host_to_device_bytes_total",
     "Host-resident bytes staged to device with training batches",
@@ -264,7 +281,13 @@ class MultiLayerNetwork:
         return fn
 
     def _build_jit(self, kind: str, train=False, keep_rnn_state=False,
-                   advance=False, collect=False, algo=None):
+                   advance=False, collect=False, algo=None, k=None,
+                   scan=True):
+        # `k`/`scan` select the superstep program shape (`nn/superstep.py`)
+        # and are part of the `_get_jit` cache key: each distinct block
+        # length registers as its own cached program, so StepProfiler's
+        # jit-cache-growth heuristic classifies a tail block's first call as
+        # compile, not steady-state execute.
         if kind == "solver_step":
             from jax.flatten_util import ravel_pytree
 
@@ -310,6 +333,33 @@ class MultiLayerNetwork:
                                        lmask, step, sub, carry_rnn=False)
                 return out + ((step + 1.0, key),)
             return jax.jit(step_plain, donate_argnums=(0, 2))
+        if kind == "train_superstep":
+            # K full train iterations as ONE dispatch: a fused loop (`lax.scan` by
+            # default, opt-in unrolled — `nn/superstep.py`) over the
+            # leading [K] axis of a stacked superbatch, carrying
+            # (params, state, opt_state, clock) with donated buffers and
+            # returning the K per-step losses as a vector (PERF.md §13).
+            # The body advances the clock exactly like `step_plain`
+            # (`key, sub = split(key)` then `step + 1.0`), so the RNG split
+            # chain — and therefore dropout masks, BN batch-stat order, and
+            # updater step counts — is bit-for-bit identical to K
+            # sequential `_fit_one` calls.
+            def step_super(params, state, opt_state, xs, ys, fmasks, lmasks,
+                           clock):
+                def body(carry, inp):
+                    params, state, opt_state, (step, key) = carry
+                    x, y, fm, lm = inp
+                    key, sub = jax.random.split(key)
+                    params, state, opt_state, loss = self._train_step(
+                        params, state, opt_state, x, y, fm, lm, step, sub,
+                        carry_rnn=False)
+                    return (params, state, opt_state, (step + 1.0, key)), loss
+
+                (params, state, opt_state, clock), losses = _superstep.superstep_loop(
+                    body, (params, state, opt_state, clock),
+                    (xs, ys, fmasks, lmasks), k, scan)
+                return params, state, opt_state, losses, clock
+            return jax.jit(step_super, donate_argnums=(0, 2))
         if kind == "train_step_stats":
             def step_stats(params, state, opt_state, x, y, fmask, lmask, clock):
                 step, key = clock
@@ -585,11 +635,7 @@ class MultiLayerNetwork:
             iterator = [_as_dataset(data, labels)]
         else:
             iterator = data
-        if hasattr(iterator, "reset"):
-            try:
-                iterator.reset()
-            except Exception:
-                pass
+        maybe_reset(iterator)
 
         g = self.conf.global_conf
         if self.conf.pretrain:
@@ -598,16 +644,14 @@ class MultiLayerNetwork:
                 # the backprop pass see the data.
                 iterator = list(iterator)
             self.pretrain(iterator)
-            if hasattr(iterator, "reset"):
-                try:
-                    iterator.reset()
-                except Exception:
-                    pass
+            maybe_reset(iterator)
         for listener in self.listeners:
             listener.on_epoch_start(self)
         with _obs.tracer.span("mln.fit", cat="train", epoch=self.epoch):
             if self.conf.backprop:
-                for ds in iterator:
+                k = self._superstep_k()
+                src = self._superstep_wrap(iterator, k) if k > 1 else iterator
+                for ds in src:
                     self._fit_dispatch(ds)
         self.epoch += 1
         _M_EPOCHS.inc()
@@ -615,13 +659,14 @@ class MultiLayerNetwork:
             listener.on_epoch_end(self)
         return self
 
-    def _fit_dispatch(self, ds: DataSet):
-        """tBPTT/plain dispatch + iterations loop for one staged batch —
-        shared by `fit()` and `ParallelWrapper` so sharded training honors
-        the same backprop-type config. Also the engine's observability
-        choke point: every training path (plain / tBPTT / solver, local or
-        sharded) stages batches through here, and `StepProfiler` patches
-        this method on the instance."""
+    def _fit_dispatch(self, ds):
+        """tBPTT/plain/superstep dispatch + iterations loop for one staged
+        batch (or stacked `Superbatch`) — shared by `fit()` and
+        `ParallelWrapper` so sharded training honors the same backprop-type
+        config. Also the engine's observability choke point: every training
+        path (plain / tBPTT / solver / superstep, local or sharded) stages
+        batches through here, and `StepProfiler` patches this method on the
+        instance."""
         _M_H2D.inc(_obs.host_nbytes(ds.features, ds.labels,
                                     ds.features_mask, ds.labels_mask))
         it0 = self.iteration
@@ -630,10 +675,15 @@ class MultiLayerNetwork:
             try:
                 return self._fit_dispatch_inner(ds)
             finally:
-                _M_DISPATCH.observe(time.perf_counter() - t0)
+                _dispatch_observe(int(getattr(ds, "k", 1)),
+                                  time.perf_counter() - t0)
                 _M_ITERS.inc(max(0, self.iteration - it0))
 
-    def _fit_dispatch_inner(self, ds: DataSet):
+    def _fit_dispatch_inner(self, ds):
+        if isinstance(ds, Superbatch):
+            # Stacked K-block: `_superstep_k` already gated out the solver /
+            # tBPTT / stats / multi-iteration paths before blocks formed.
+            return self._fit_superstep(ds)
         g = self.conf.global_conf
         algo = OptimizationAlgorithm.of(g.optimization_algo)
         if algo != OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT:
@@ -644,6 +694,76 @@ class MultiLayerNetwork:
                 self._fit_tbptt(ds)
             else:
                 self._fit_one(ds)
+
+    # -------------------------------------------------------------- superstep
+
+    def _superstep_k(self) -> int:
+        """Effective superstep K for this engine: the `superstep_k` config
+        knob (env `DL4J_TPU_SUPERSTEP_K` overrides), gated to 0 — per-batch
+        dispatch — whenever a path needs per-iteration host visibility or
+        its own dispatch structure: stats-collecting listeners
+        (`_collect_stats`, same precedent as the tBPTT scan), truncated
+        BPTT (already scan-fused per sequence), solver optimizers, and
+        multi-`iterations` batches."""
+        env = os.environ.get("DL4J_TPU_SUPERSTEP_K")
+        g = self.conf.global_conf
+        try:
+            k = int(env) if env else int(getattr(g, "superstep_k", 0) or 0)
+        except ValueError:
+            return 0
+        if (k < 2 or self._collect_stats
+                or max(1, g.iterations) != 1
+                or BackpropType.of(self.conf.backprop_type)
+                == BackpropType.TRUNCATED_BPTT
+                or OptimizationAlgorithm.of(g.optimization_algo)
+                != OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT):
+            return 0
+        return k
+
+    def _superstep_wrap(self, iterator, k: int):
+        """Wrap `iterator` in a `SuperbatchIterator`, caching the wrapper on
+        the base iterator so a device-cached epoch restacks once, not per
+        `fit()` call."""
+        if isinstance(iterator, SuperbatchIterator):
+            return iterator
+        wrapper = getattr(iterator, "_superbatch_wrapper", None)
+        if (isinstance(wrapper, SuperbatchIterator)
+                and wrapper.base is iterator and wrapper.k == k):
+            return wrapper
+        wrapper = SuperbatchIterator(iterator, k)
+        try:
+            iterator._superbatch_wrapper = wrapper
+        except (AttributeError, TypeError):
+            pass  # lists/tuples/slots: re-wrapped per fit(), still correct
+        return wrapper
+
+    def _fit_superstep(self, sb: Superbatch):
+        """One dispatch, K train iterations (see `train_superstep` in
+        `_build_jit`). The returned `[K]` loss vector fans out to listeners
+        per iteration, so ScoreIterationListener etc. observe the same
+        (iteration, score) sequence as the per-batch loop — scores stay
+        device scalars until someone reads `score_value`."""
+        k = int(sb.k)
+        if k == 1:  # defensive: SuperbatchIterator yields raw singletons
+            return self._fit_one(DataSet(sb.features[0],
+                                         None if sb.labels is None else sb.labels[0],
+                                         None if sb.features_mask is None else sb.features_mask[0],
+                                         None if sb.labels_mask is None else sb.labels_mask[0]))
+        step_fn = self._get_jit("train_superstep", k=k,
+                                scan=_superstep.use_scan())
+        (self.params_tree, self.state, self.opt_state, losses,
+         self._clock) = step_fn(
+            self.params_tree, self.state, self.opt_state,
+            jnp.asarray(sb.features), jnp.asarray(sb.labels),
+            None if sb.features_mask is None else jnp.asarray(sb.features_mask),
+            None if sb.labels_mask is None else jnp.asarray(sb.labels_mask),
+            self._device_clock(),
+        )
+        for i in range(k):
+            self._score = losses[i]  # device scalar; sync deferred
+            self.iteration += 1
+            for listener in self.listeners:
+                listener.iteration_done(self, self.iteration)
 
     def _fit_solver(self, ds: DataSet, algo):
         """Full-batch LBFGS/CG/line-search optimize of one batch (reference:
@@ -693,11 +813,7 @@ class MultiLayerNetwork:
             if loss_impl is None:
                 continue
             for _ in range(max(1, epochs)):
-                if hasattr(iterator, "reset"):
-                    try:
-                        iterator.reset()
-                    except Exception:
-                        pass
+                maybe_reset(iterator)
                 for ds in iterator:
                     self._pretrain_step(i, layer, loss_impl,
                                         jnp.asarray(ds.features))
@@ -937,11 +1053,7 @@ class MultiLayerNetwork:
         from deeplearning4j_tpu.eval.evaluation import Evaluation
 
         ev = Evaluation(top_n=top_n)
-        if hasattr(iterator, "reset"):
-            try:
-                iterator.reset()
-            except Exception:
-                pass
+        maybe_reset(iterator)
         if isinstance(iterator, DataSet):
             iterator = [iterator]
         for ds in iterator:

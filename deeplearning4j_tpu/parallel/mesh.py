@@ -42,6 +42,15 @@ def data_sharding(mesh: Mesh, ndim: int, axis: str = "data") -> NamedSharding:
     return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
 
 
+def superbatch_sharding(mesh: Mesh, ndim: int,
+                        axis: str = "data") -> NamedSharding:
+    """Sharding for a `[K, B, ...]` stacked superstep block: the batch axis
+    (dim 1) shards over `axis`, the K step axis and feature dims replicate —
+    each scan iteration then sees the same per-device batch split that
+    `data_sharding` gives a single dispatched batch."""
+    return NamedSharding(mesh, P(None, axis, *([None] * (ndim - 2))))
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

@@ -21,6 +21,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.datasets.iterators import (
+    MultiSuperbatch,
+    Superbatch,
+    batch_signature,
+    maybe_reset,
+)
 from deeplearning4j_tpu.parallel import mesh as mesh_mod
 from deeplearning4j_tpu.parallel.context import parallel_context
 from deeplearning4j_tpu import observability as _obs
@@ -138,54 +144,124 @@ class ParallelWrapper:
             a, mesh_mod.data_sharding(self.mesh, np.ndim(a), self.data_axis)
         )
 
+    def _prepare(self, ds, is_graph: bool):
+        """Pad one host batch to a mesh-size-multiple batch dim."""
+        if is_graph:
+            mds = MultiDataSet.from_dataset(ds) if isinstance(ds, DataSet) else ds
+            return self._pad_mds(mds)
+        if isinstance(ds, MultiDataSet):
+            raise TypeError("MultiDataSet input requires a ComputationGraph net")
+        return self._pad_dataset(ds)
+
+    def _shard_batch(self, padded, is_graph: bool):
+        """device_put one padded batch with the batch dim over the mesh."""
+        if is_graph:
+            return MultiDataSet(
+                features=[self._shard(np.asarray(f)) for f in padded.features],
+                labels=[self._shard(np.asarray(l)) for l in padded.labels],
+                features_masks=None if padded.features_masks is None
+                else [self._shard(m) for m in padded.features_masks],
+                labels_masks=None if padded.labels_masks is None
+                else [self._shard(m) for m in padded.labels_masks],
+            )
+        return DataSet(
+            self._shard(np.asarray(padded.features)),
+            self._shard(None if padded.labels is None else np.asarray(padded.labels)),
+            self._shard(padded.features_mask),
+            self._shard(padded.labels_mask),
+        )
+
+    def _shard_super(self, parts):
+        """np.stack K same-shape parts to [K, B, ...] and device_put with
+        the BATCH axis (dim 1) sharded over the mesh — one transfer per
+        part for the whole K-block."""
+        if parts[0] is None:
+            return None
+        stacked = np.stack([np.asarray(p) for p in parts])
+        return jax.device_put(stacked, mesh_mod.superbatch_sharding(
+            self.mesh, stacked.ndim, self.data_axis))
+
+    def _stack_shard(self, pending, is_graph: bool):
+        """Stack K padded same-signature batches into a sharded superbatch."""
+        k = len(pending)
+        if is_graph:
+            first = pending[0]
+            feats = [self._shard_super([p.features[i] for p in pending])
+                     for i in range(len(first.features))]
+            labs = [self._shard_super([p.labels[i] for p in pending])
+                    for i in range(len(first.labels))]
+            fmasks = None if first.features_masks is None else [
+                self._shard_super([p.features_masks[i] for p in pending])
+                for i in range(len(first.features_masks))]
+            lmasks = None if first.labels_masks is None else [
+                self._shard_super([p.labels_masks[i] for p in pending])
+                for i in range(len(first.labels_masks))]
+            return MultiSuperbatch(feats, labs, fmasks, lmasks, k=k)
+        return Superbatch(
+            self._shard_super([p.features for p in pending]),
+            self._shard_super([p.labels for p in pending]),
+            self._shard_super([p.features_mask for p in pending]),
+            self._shard_super([p.labels_mask for p in pending]),
+            k=k,
+        )
+
     def fit(self, iterator):
         """One pass over the iterator, each batch sharded across the mesh.
 
         Accepts the same inputs as the wrapped engine's `fit`: DataSet /
         iterator of DataSets for `MultiLayerNetwork`, plus MultiDataSet for
         `ComputationGraph` (the reference ParallelWrapper supports both,
-        `ParallelWrapper.java:322` and the MDS variant `:151`)."""
+        `ParallelWrapper.java:322` and the MDS variant `:151`).
+
+        When the engine's `superstep_k` knob is active, consecutive
+        same-signature padded batches are stacked into `[K, B, ...]`
+        superbatches sharded on the BATCH axis (dim 1), so sharded training
+        amortizes dispatch the same way local training does (PERF.md §13);
+        the engine gate (`_superstep_k`) also covers the stats-listener /
+        tBPTT / solver fallbacks here."""
         net = self.net
         is_graph = type(net).__name__ == "ComputationGraph"
-        if hasattr(iterator, "reset"):
-            try:
-                iterator.reset()
-            except Exception:
-                pass
+        maybe_reset(iterator)
         if isinstance(iterator, (DataSet, MultiDataSet)):
             iterator = [iterator]
-        for ds in iterator:
+        k = net._superstep_k() if hasattr(net, "_superstep_k") else 0
+        pending: list = []
+        sig = None
+
+        def flush():
+            if not pending:
+                return
             t0 = time.perf_counter()
-            if is_graph:
-                mds = MultiDataSet.from_dataset(ds) if isinstance(ds, DataSet) else ds
-                padded = self._pad_mds(mds)
-                sharded = MultiDataSet(
-                    features=[self._shard(np.asarray(f)) for f in padded.features],
-                    labels=[self._shard(np.asarray(l)) for l in padded.labels],
-                    features_masks=None if padded.features_masks is None
-                    else [self._shard(m) for m in padded.features_masks],
-                    labels_masks=None if padded.labels_masks is None
-                    else [self._shard(m) for m in padded.labels_masks],
-                )
+            if len(pending) == 1:
+                sharded = self._shard_batch(pending[0], is_graph)
             else:
-                if isinstance(ds, MultiDataSet):
-                    raise TypeError(
-                        "MultiDataSet input requires a ComputationGraph net"
-                    )
-                padded = self._pad_dataset(ds)
-                sharded = DataSet(
-                    self._shard(np.asarray(padded.features)),
-                    self._shard(None if padded.labels is None else np.asarray(padded.labels)),
-                    self._shard(padded.features_mask),
-                    self._shard(padded.labels_mask),
-                )
+                sharded = self._stack_shard(pending, is_graph)
             _M_SHARD_SECONDS.inc(time.perf_counter() - t0)
-            _M_BATCHES.inc()
+            _M_BATCHES.inc(len(pending))
+            pending.clear()
             with _obs.tracer.span("parallel.batch", cat="parallel",
                                   devices=self.n_devices,
-                                  data_axis=self.data_axis):
+                                  data_axis=self.data_axis,
+                                  k=int(getattr(sharded, "k", 1))):
                 with parallel_context(getattr(self, "context", None)):
                     net._fit_dispatch(sharded)
+
+        for ds in iterator:
+            t0 = time.perf_counter()
+            padded = self._prepare(ds, is_graph)
+            _M_SHARD_SECONDS.inc(time.perf_counter() - t0)
+            if k < 2:
+                pending.append(padded)
+                flush()
+                continue
+            s = batch_signature(padded)
+            if pending and s != sig:
+                flush()  # heterogeneous shapes: per-signature blocks
+            sig = s
+            pending.append(padded)
+            if len(pending) >= k:
+                flush()
+        flush()
         return net
 
     def evaluate(self, iterator, top_n: int = 1):

@@ -1,0 +1,430 @@
+"""Superstep training tests: K train iterations fused into ONE dispatch
+(`lax.scan` over stacked `[K, B, ...]` batches) must be bit-identical to K
+sequential per-batch steps — RNG chain, BN running stats, masked losses, and
+the true-length non-multiple-of-K tail included. Plus the block-forming
+iterator, the device-cache cleanup satellite, fallback gates, and the
+ParallelWrapper superstep path. PERF.md §13."""
+
+import logging
+
+import numpy as np
+import pytest
+
+import jax
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.datasets.iterators import (
+    _M_CACHE_BYTES,
+    DeviceCacheDataSetIterator,
+    ListDataSetIterator,
+    Superbatch,
+    SuperbatchIterator,
+    batch_signature,
+    maybe_reset,
+    stack_superbatch,
+)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    BatchNormalization,
+    DenseLayer,
+    DropoutLayer,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.conf.neural_net import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.optimize.listeners import (
+    CollectScoresIterationListener,
+    IterationListener,
+)
+
+from conftest import make_classification_data
+
+N_IN, N_OUT = 4, 3
+
+
+def mlp_conf(superstep_k=0, updater="adam", dropout=True, bn=True, **g):
+    b = (NeuralNetConfiguration.builder()
+         .seed(7).learning_rate(0.05).updater(updater).weight_init("xavier")
+         .superstep_k(superstep_k))
+    for name, v in g.items():
+        b = getattr(b, name)(v)
+    lb = b.list().layer(DenseLayer(n_out=8, activation="relu"))
+    if bn:
+        lb = lb.layer(BatchNormalization())
+    if dropout:
+        lb = lb.layer(DropoutLayer(dropout=0.5))
+    lb = lb.layer(OutputLayer(n_out=N_OUT, activation="softmax",
+                              loss_function="mcxent"))
+    return lb.set_input_type(InputType.feed_forward(N_IN)).build()
+
+
+def graph_conf(superstep_k=0):
+    return (NeuralNetConfiguration.builder()
+            .seed(7).learning_rate(0.05).updater("adam").weight_init("xavier")
+            .superstep_k(superstep_k)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d", DenseLayer(n_out=8, activation="relu"), "in")
+            .add_layer("drop", DropoutLayer(dropout=0.4), "d")
+            .add_layer("out", OutputLayer(n_out=N_OUT, activation="softmax",
+                                          loss_function="mcxent"), "drop")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(N_IN))
+            .build())
+
+
+def make_batches(rng, n_batches=7, batch=6, labels_mask=False):
+    out = []
+    for _ in range(n_batches):
+        X, Y = make_classification_data(rng, n=batch, n_features=N_IN,
+                                        n_classes=N_OUT, dtype="float32")
+        lm = None
+        if labels_mask:
+            lm = (rng.rand(batch) < 0.7).astype("float32")
+            lm[0] = 1.0  # at least one unmasked row per batch
+        out.append(DataSet(X, Y, labels_mask=lm))
+    return out
+
+
+def assert_trees_identical(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def net_snapshot(net):
+    return (net.params_tree, net.opt_state, net.state)
+
+
+def superstep_programs(net):
+    """Block lengths of the compiled `train_superstep` programs."""
+    ks = []
+    for key in net._jit_cache:
+        if key[0] == "train_superstep":
+            ks.extend(v for name, v in key[1] if name == "k")
+    return sorted(ks)
+
+
+# --------------------------------------------------------------------------
+# SuperbatchIterator / block forming
+
+
+class TestSuperbatchIterator:
+    def test_blocks_and_true_length_tail(self, rng):
+        batches = make_batches(rng, n_batches=7)
+        blocks = list(SuperbatchIterator(batches, k=4, stage=False))
+        assert [getattr(b, "k", 1) for b in blocks] == [4, 3]
+        assert blocks[0].features.shape == (4, 6, N_IN)
+        assert blocks[1].features.shape == (3, 6, N_IN)  # no padding
+
+    def test_singleton_block_yields_original_item(self, rng):
+        batches = make_batches(rng, n_batches=5)
+        blocks = list(SuperbatchIterator(batches, k=4, stage=False))
+        assert isinstance(blocks[0], Superbatch)
+        assert blocks[1] is batches[4]  # tail of 1: the raw DataSet
+
+    def test_signature_change_flushes(self, rng):
+        a = make_batches(rng, n_batches=3, batch=6)
+        b = make_batches(rng, n_batches=2, batch=5)  # different batch dim
+        blocks = list(SuperbatchIterator(a + b, k=4, stage=False))
+        assert [getattr(blk, "k", 1) for blk in blocks] == [3, 2]
+        assert batch_signature(a[0]) != batch_signature(b[0])
+
+    def test_byte_budget_lowers_effective_k(self, rng):
+        batches = make_batches(rng, n_batches=8)
+        per = sum(a.nbytes for a in (batches[0].features, batches[0].labels))
+        it = SuperbatchIterator(batches, k=8, max_bytes=3 * per, stage=False)
+        assert [b.k for b in it] == [3, 3, 2]
+
+    def test_stacking_preserves_values_and_masks(self, rng):
+        batches = make_batches(rng, n_batches=3, labels_mask=True)
+        sb = stack_superbatch(batches, stage=False)
+        for i, ds in enumerate(batches):
+            np.testing.assert_array_equal(sb.features[i], ds.features)
+            np.testing.assert_array_equal(sb.labels_mask[i], ds.labels_mask)
+        assert sb.features_mask is None
+
+    def test_multidataset_blocks(self, rng):
+        X, Y = make_classification_data(rng, n=6, n_features=N_IN,
+                                        n_classes=N_OUT, dtype="float32")
+        mds = MultiDataSet(features=[X], labels=[Y])
+        blocks = list(SuperbatchIterator([mds, mds, mds], k=2, stage=False))
+        assert [getattr(b, "k", 1) for b in blocks] == [2, 1]
+        assert blocks[1] is mds  # singleton tail: the raw MultiDataSet
+        assert blocks[0].features[0].shape == (2, 6, N_IN)
+
+    def test_staged_block_is_device_resident(self, rng):
+        batches = make_batches(rng, n_batches=2)
+        (sb,) = SuperbatchIterator(batches, k=2, stage=True)
+        assert not isinstance(sb.features, np.ndarray)
+        assert sb.features.shape == (2, 6, N_IN)
+
+    def test_device_cached_epochs_restack_once(self, rng):
+        base = DeviceCacheDataSetIterator(make_batches(rng, n_batches=4))
+        it = SuperbatchIterator(base, k=2)
+        first = list(it)
+        blocks_obj = it._blocks
+        second = list(it)
+        assert it._blocks is blocks_obj  # no restack on a cached epoch
+        assert all(a is b for a, b in zip(first, second))
+        base.invalidate()
+        list(it)
+        assert it._blocks is not blocks_obj  # invalidate propagates
+
+
+class TestMaybeReset:
+    def test_list_has_no_reset(self):
+        assert maybe_reset([1, 2]) is False
+
+    def test_resettable_iterator(self, rng):
+        it = ListDataSetIterator(make_batches(rng, n_batches=2))
+        assert maybe_reset(it) is True
+
+    def test_failing_reset_logged_not_raised(self, caplog):
+        class Broken:
+            def reset(self):
+                raise RuntimeError("boom")
+
+        class NotImpl:
+            def reset(self):
+                raise NotImplementedError
+
+        with caplog.at_level(logging.WARNING,
+                             logger="deeplearning4j_tpu.datasets.iterators"):
+            assert maybe_reset(Broken()) is False
+        assert any("reset() failed" in r.message for r in caplog.records)
+        caplog.clear()
+        with caplog.at_level(logging.WARNING,
+                             logger="deeplearning4j_tpu.datasets.iterators"):
+            assert maybe_reset(NotImpl()) is False  # silent: not resettable
+        assert not caplog.records
+
+
+# --------------------------------------------------------------------------
+# Device-cache cleanup satellite
+
+
+class TestDeviceCacheCleanup:
+    def test_memory_error_drops_partial_stage_and_gauge(self, rng):
+        batches = make_batches(rng, n_batches=4)
+        per = sum(a.nbytes for a in (batches[0].features, batches[0].labels))
+        before = _M_CACHE_BYTES.get()
+        it = DeviceCacheDataSetIterator(batches, max_bytes=2 * per)
+        with pytest.raises(MemoryError):
+            list(it)
+        assert it._cache is None
+        assert _M_CACHE_BYTES.get() == before  # nothing leaked into the gauge
+
+    def test_gauge_tracks_cache_lifecycle(self, rng):
+        batches = make_batches(rng, n_batches=3)
+        before = _M_CACHE_BYTES.get()
+        it = DeviceCacheDataSetIterator(batches)
+        list(it)
+        assert _M_CACHE_BYTES.get() > before
+        list(it)  # replay: no double count
+        after_replay = _M_CACHE_BYTES.get()
+        it.invalidate()
+        assert _M_CACHE_BYTES.get() == before
+        assert after_replay > before
+
+
+# --------------------------------------------------------------------------
+# MultiLayerNetwork equivalence
+
+
+class TestMLNEquivalence:
+    def fit_pair(self, rng, k, n_batches=7, **conf_kw):
+        batches = make_batches(rng, n_batches=n_batches,
+                               labels_mask=conf_kw.pop("labels_mask", False))
+        ref = MultiLayerNetwork(mlp_conf(superstep_k=0, **conf_kw)).init()
+        for ds in batches:
+            ref.fit(ds)
+        net = MultiLayerNetwork(mlp_conf(superstep_k=k, **conf_kw)).init()
+        net.fit(batches)
+        return ref, net
+
+    def test_bit_identical_with_bn_dropout_and_tail(self, rng):
+        """7 batches, K=4: the dropout RNG chain, BN running stats, adam
+        opt_state, and the length-3 tail block all match bit-for-bit."""
+        ref, net = self.fit_pair(rng, k=4)
+        assert_trees_identical(net_snapshot(ref), net_snapshot(net))
+        assert ref.iteration == net.iteration == 7
+        assert superstep_programs(net) == [3, 4]  # true-length tail program
+
+    def test_unrolled_program_close(self, rng, monkeypatch):
+        """`DL4J_TPU_SUPERSTEP_SCAN=0` opts into the unrolled program shape
+        (CPU conv speed — `nn/superstep.py`). XLA then optimizes across
+        iterations, so results are float-close, not bit-identical."""
+        monkeypatch.setenv("DL4J_TPU_SUPERSTEP_SCAN", "0")
+        ref, net = self.fit_pair(rng, k=4)
+        for x, y in zip(jax.tree_util.tree_leaves(net_snapshot(ref)),
+                        jax.tree_util.tree_leaves(net_snapshot(net))):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       rtol=1e-5, atol=1e-6)
+        assert any(("scan", False) in key[1] for key in net._jit_cache
+                   if key[0] == "train_superstep")
+
+    def test_bit_identical_masked_loss(self, rng):
+        ref, net = self.fit_pair(rng, k=3, n_batches=6, labels_mask=True)
+        assert_trees_identical(net_snapshot(ref), net_snapshot(net))
+
+    def test_scores_match_per_batch(self, rng):
+        ref, net = self.fit_pair(rng, k=4)
+        assert float(ref.score_value) == float(net.score_value)
+
+    def test_env_knob_overrides_conf(self, rng, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_SUPERSTEP_K", "3")
+        net = MultiLayerNetwork(mlp_conf(superstep_k=0)).init()
+        assert net._superstep_k() == 3
+        monkeypatch.setenv("DL4J_TPU_SUPERSTEP_K", "0")
+        net2 = MultiLayerNetwork(mlp_conf(superstep_k=8)).init()
+        assert net2._superstep_k() == 0
+        monkeypatch.delenv("DL4J_TPU_SUPERSTEP_K")
+        assert net2._superstep_k() == 8
+
+    def test_gates_force_per_batch(self, rng):
+        net = MultiLayerNetwork(mlp_conf(superstep_k=8, iterations=3)).init()
+        assert net._superstep_k() == 0
+        lbfgs = MultiLayerNetwork(
+            mlp_conf(superstep_k=8, dropout=False, bn=False,
+                     optimization_algo="lbfgs")).init()
+        assert lbfgs._superstep_k() == 0
+
+    def test_listener_fanout_order_and_scores(self, rng):
+        """Listeners fire once per TRAIN ITERATION (K per dispatch), in
+        iteration order, with the same scores as the per-batch loop."""
+        batches = make_batches(rng, n_batches=5)
+
+        def run(k):
+            seen = []
+
+            class Probe(IterationListener):
+                def iteration_done(self, model, iteration):
+                    seen.append((iteration, float(model.score_value)))
+
+            collect = CollectScoresIterationListener(frequency=1)
+            net = MultiLayerNetwork(mlp_conf(superstep_k=k)).init()
+            net.set_listeners(Probe(), collect)
+            net.fit(batches)
+            return seen, collect.scores
+
+        seq_seen, seq_scores = run(0)
+        sup_seen, sup_scores = run(3)
+        assert [i for i, _ in sup_seen] == [1, 2, 3, 4, 5]
+        assert sup_seen == seq_seen
+        assert sup_scores == seq_scores
+
+    def test_stats_listener_falls_back_to_per_batch(self, rng):
+        """A stats-collecting listener needs per-iteration host stats, so the
+        engine must gate superstep off and still populate the snapshot."""
+        from deeplearning4j_tpu.api.storage import InMemoryStatsStorage
+        from deeplearning4j_tpu.ui.stats import StatsListener
+
+        batches = make_batches(rng, n_batches=4)
+        net = MultiLayerNetwork(mlp_conf(superstep_k=4)).init()
+        net.set_listeners(StatsListener(InMemoryStatsStorage(), frequency=1))
+        assert net._superstep_k() == 0
+        net.fit(batches)
+        assert superstep_programs(net) == []
+        assert net.last_training_stats  # per-batch path collected stats
+
+    def test_superstep_k_survives_json_roundtrip(self):
+        conf = mlp_conf(superstep_k=6)
+        restored = MultiLayerConfiguration.from_json(conf.to_json())
+        assert restored.global_conf.superstep_k == 6
+
+    def test_wrapper_cached_on_iterator(self, rng):
+        net = MultiLayerNetwork(mlp_conf(superstep_k=2)).init()
+        base = DeviceCacheDataSetIterator(make_batches(rng, n_batches=4))
+        w1 = net._superstep_wrap(base, 2)
+        assert net._superstep_wrap(base, 2) is w1
+        assert net._superstep_wrap(base, 3) is not w1  # k changed
+
+
+# --------------------------------------------------------------------------
+# ComputationGraph equivalence
+
+
+class TestGraphEquivalence:
+    def test_bit_identical_with_tail(self, rng):
+        batches = make_batches(rng, n_batches=5, batch=5)
+        ref = ComputationGraph(graph_conf(superstep_k=0)).init()
+        for ds in batches:
+            ref.fit(ds)
+        net = ComputationGraph(graph_conf(superstep_k=3)).init()
+        net.fit(batches)
+        assert_trees_identical(net_snapshot(ref), net_snapshot(net))
+        assert ref.iteration == net.iteration == 5
+        assert superstep_programs(net) == [2, 3]
+
+    def test_multidataset_iterator(self, rng):
+        batches = make_batches(rng, n_batches=4, batch=5)
+        mds = [MultiDataSet(features=[d.features], labels=[d.labels])
+               for d in batches]
+        ref = ComputationGraph(graph_conf(superstep_k=0)).init()
+        for m in mds:
+            ref.fit(m)
+        net = ComputationGraph(graph_conf(superstep_k=2)).init()
+        net.fit(mds)
+        assert_trees_identical(net_snapshot(ref), net_snapshot(net))
+
+
+# --------------------------------------------------------------------------
+# ParallelWrapper
+
+
+class TestParallelWrapperSuperstep:
+    def test_sharded_superstep_matches_per_batch(self, rng):
+        from deeplearning4j_tpu.parallel import mesh as mesh_mod
+        from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+        def conf(k):
+            return (NeuralNetConfiguration.builder()
+                    .seed(7).learning_rate(0.1).updater("sgd")
+                    .weight_init("xavier").superstep_k(k)
+                    .list()
+                    .layer(DenseLayer(n_out=8, activation="tanh"))
+                    .layer(OutputLayer(n_out=N_OUT, activation="softmax",
+                                       loss_function="mcxent"))
+                    .set_input_type(InputType.feed_forward(N_IN))
+                    .build())
+
+        batches = make_batches(rng, n_batches=5, batch=16)
+        mesh = mesh_mod.create_mesh((8,), ("data",))
+
+        ref = MultiLayerNetwork(conf(0)).init()
+        ParallelWrapper(ref, mesh=mesh).fit(batches)
+
+        net = MultiLayerNetwork(conf(2)).init()
+        ParallelWrapper(net, mesh=mesh).fit(batches)
+
+        assert_trees_identical(ref.params_tree, net.params_tree)
+        assert ref.iteration == net.iteration == 5
+        assert 2 in superstep_programs(net)
+
+    def test_bench_lenet_superstep_smoke(self, monkeypatch):
+        """Fast CPU pass of the BENCH config: both timed loops run, the
+        superstep net actually compiles a fused program, and the emitted
+        entries carry the same-run ratio."""
+        import bench
+
+        monkeypatch.setenv("BENCH_BATCH_LENET", "8")
+        monkeypatch.setenv("BENCH_SUPERSTEP_K", "2")
+        head, ratio = bench.bench_lenet_superstep(steps=4, warmup=1)
+        assert head["metric"] == "lenet_superstep_k2_samples_per_sec"
+        assert head["value"] > 0
+        assert head["per_batch_same_run"] > 0
+        assert ratio["metric"] == "lenet_superstep_vs_per_batch_ratio"
+        assert ratio["value"] > 0
+
+    def test_superbatch_sharding_spec(self):
+        from jax.sharding import PartitionSpec as P
+
+        from deeplearning4j_tpu.parallel import mesh as mesh_mod
+
+        mesh = mesh_mod.create_mesh((8,), ("data",))
+        s = mesh_mod.superbatch_sharding(mesh, ndim=3)
+        assert s.spec == P(None, "data", None)
