@@ -284,6 +284,92 @@ def bench_lenet_superstep(steps, warmup):
     return head, ratio
 
 
+# Runs in a FRESH interpreter so every run pays (or skips) the real
+# cold-start path: jax import, first trace, first backend compile.
+_COLD_WARM_CHILD = r"""
+import json, os, time
+import numpy as np
+from deeplearning4j_tpu import observability as obs
+obs.install_jax_compile_hook()
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.models import zoo
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+batch = int(os.environ.get("BENCH_BATCH_LENET_COLDWARM", "64"))
+net = MultiLayerNetwork(zoo.lenet_mnist()).init()
+rng = np.random.RandomState(0)
+x = rng.rand(batch, 28, 28, 1).astype("float32")
+y = np.eye(10, dtype="float32")[rng.randint(0, 10, batch)]
+
+def totals():
+    out = {}
+    for name in ("dl4j_xla_compiles_total", "dl4j_compile_cache_hits_total"):
+        fam = obs.metrics.get_family(name)
+        out[name] = 0.0 if fam is None else sum(
+            c.get() for c in fam.children())
+    fam = obs.metrics.get_family("dl4j_xla_compile_seconds_total")
+    out["compile_seconds"] = 0.0 if fam is None else sum(
+        c.get() for c in fam.children())
+    return out
+
+t0 = time.perf_counter()
+net.fit(DataSet(x, y))
+_ = float(net.score_value)
+first_fit = time.perf_counter() - t0
+t = totals()
+print(json.dumps({
+    "first_fit_seconds": first_fit,
+    "compile_seconds": t["compile_seconds"],
+    "xla_compiles": t["dl4j_xla_compiles_total"],
+    "cache_hits": t["dl4j_compile_cache_hits_total"],
+}))
+"""
+
+
+def bench_lenet_cold_vs_warm(steps, warmup):
+    """Cold-start kill (compilation/): the SAME first-fit, in a fresh
+    process, with an empty vs a pre-populated compile cache. The cold child
+    traces + backend-compiles LeNet from nothing; the warm child replays
+    the executable store + persistent XLA cache. `warm_start_speedup` is
+    the whole-first-fit wall ratio — the user-visible cold-start cut."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    cache = tempfile.mkdtemp(prefix="bench-compile-cache-")
+
+    def run_child():
+        env = dict(os.environ, DL4J_TPU_COMPILE_CACHE=cache)
+        proc = subprocess.run([sys.executable, "-c", _COLD_WARM_CHILD],
+                              capture_output=True, text=True, env=env,
+                              timeout=1800)
+        if proc.returncode != 0:
+            raise RuntimeError(f"cold/warm child failed: "
+                               f"{proc.stderr[-2000:]}")
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    try:
+        cold = run_child()   # empty cache: pays the full trace + compile
+        warm = run_child()   # populated: AOT store + persistent cache
+    finally:
+        shutil.rmtree(cache, ignore_errors=True)
+
+    speedup = cold["first_fit_seconds"] / max(warm["first_fit_seconds"],
+                                              1e-9)
+    head = _entry("lenet_warm_start_speedup", speedup, "x (fresh process)",
+                  note="first fit() wall seconds, empty vs populated "
+                       "compile cache; includes trace + backend compile "
+                       "cold, executable-store replay warm")
+    head["compile_seconds_cold"] = round(cold["compile_seconds"], 3)
+    head["compile_seconds_warm"] = round(warm["compile_seconds"], 3)
+    head["first_fit_seconds_cold"] = round(cold["first_fit_seconds"], 3)
+    head["first_fit_seconds_warm"] = round(warm["first_fit_seconds"], 3)
+    head["xla_compiles_cold"] = cold["xla_compiles"]
+    head["xla_compiles_warm"] = warm["xla_compiles"]
+    head["cache_hits_warm"] = warm["cache_hits"]
+    return head
+
+
 def bench_char_rnn(steps, warmup):
     from deeplearning4j_tpu.models import zoo
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
@@ -652,8 +738,8 @@ def main():
     warmup = int(os.environ.get("BENCH_WARMUP", "5"))
     configs = os.environ.get(
         "BENCH_CONFIGS",
-        "resnet50,lenet,char_rnn,lenet_step,lenet_superstep,word2vec,vgg16,"
-        "flash_attn,flash_tri,transformer"
+        "resnet50,lenet,char_rnn,lenet_step,lenet_superstep,lenet_cold_warm,"
+        "word2vec,vgg16,flash_attn,flash_tri,transformer"
     ).split(",")
 
     head, extra = None, {}
@@ -679,6 +765,9 @@ def main():
         # loops must both dwarf the tail sync RTT (PERF.md §4).
         for e in bench_lenet_superstep(max(200, steps), warmup):
             extra[e["metric"]] = e
+    if "lenet_cold_warm" in configs:
+        e = bench_lenet_cold_vs_warm(steps, warmup)
+        extra[e["metric"]] = e
     if "word2vec" in configs:
         e = bench_word2vec(steps, warmup)
         extra[e["metric"]] = e

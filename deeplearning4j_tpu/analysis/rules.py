@@ -1,4 +1,4 @@
-"""tpulint rules JX001-JX006.
+"""tpulint rules JX001-JX007.
 
 Each rule is a class with a stable ``id``; registration is
 registry-driven (`@register_rule`) so satellite PRs add rules without
@@ -491,3 +491,68 @@ class DtypeSniffRule(Rule):
                     "dtype-sniffing `.dtype == uint8` decides semantics from "
                     "the wire format; route through an explicit preprocessor "
                     "(nn/conf/preprocessors.py) keyed on model structure")
+
+
+@register_rule
+class AotOutsideCompilationRule(Rule):
+    """JX007: AOT compilation machinery outside `compilation/`.
+
+    `fn.lower(...)` / `lowered.compile()` / `jax.export` /
+    `serialize_executable` call sites scattered through the codebase each
+    reinvent fingerprinting, version pinning, and fallback-on-corrupt
+    behavior — and silently miss the executable store, so their compiles
+    never become warm starts. The one sanctioned home is the
+    `compilation/` package (plus the profiler's cost-analysis probe, which
+    lowers only to read FLOPs).
+    """
+
+    id = "JX007"
+    description = ("AOT compile machinery (.lower()/.compile()/jax.export) "
+                   "outside compilation/")
+
+    ALLOWED_SUFFIXES = ("observability/profiler.py",)
+
+    def check(self, ctx):
+        rel = ctx.rel.replace("\\", "/")
+        if (rel.endswith(self.ALLOWED_SUFFIXES) or "/compilation/" in rel
+                or rel.startswith("compilation/") or "/analysis/" in rel):
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                mod = getattr(node, "module", "") or ""
+                names = [a.name for a in node.names]
+                if ("serialize_executable" in mod
+                        or "serialize_executable" in names):
+                    yield self.finding(
+                        ctx, node,
+                        "serialize_executable import outside compilation/: "
+                        "executable (de)serialization belongs to the AOT "
+                        "store (compilation/store.py)")
+                continue
+            if (isinstance(node, ast.Attribute) and node.attr == "export"
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "jax"):
+                yield self.finding(
+                    ctx, node,
+                    "jax.export outside compilation/: exported artifacts "
+                    "bypass the fingerprinted executable store")
+                continue
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            # `.lower(...)` WITH arguments: jit lowering takes the example
+            # args (str.lower() takes none). `.compile()` with NO
+            # arguments: Lowered.compile (re.compile always has some).
+            if node.func.attr == "lower" and (node.args or node.keywords):
+                yield self.finding(
+                    ctx, node,
+                    ".lower(...) outside compilation/: AOT-compile through "
+                    "the executable store (compilation/program.py) so the "
+                    "artifact is fingerprinted and reused")
+            elif node.func.attr == "compile" and not (node.args
+                                                      or node.keywords):
+                yield self.finding(
+                    ctx, node,
+                    ".compile() outside compilation/: AOT-compile through "
+                    "the executable store (compilation/program.py) so the "
+                    "artifact is fingerprinted and reused")

@@ -41,8 +41,7 @@ def leaf_chunks(arr) -> Iterator[Tuple[Tuple[Tuple[int, int], ...], np.ndarray]]
     a plain host array yields one chunk covering the whole array."""
     shards = getattr(arr, "addressable_shards", None)
     if not shards:
-        a = np.asarray(arr)
-        yield tuple((0, s) for s in a.shape), a
+        yield tuple((0, s) for s in np.shape(arr)), _host_copy(arr)
         return
     seen = set()
     for sh in shards:
@@ -53,7 +52,18 @@ def leaf_chunks(arr) -> Iterator[Tuple[Tuple[Tuple[int, int], ...], np.ndarray]]
         if idx in seen:
             continue
         seen.add(idx)
-        yield idx, np.asarray(sh.data)
+        yield idx, _host_copy(sh.data)
+
+
+def _host_copy(arr) -> np.ndarray:
+    """An OWNED host copy of `arr`. On the CPU backend `np.asarray` of a
+    jax array is a zero-copy view of the XLA buffer — and the training
+    step donates its input buffers, so by the time the async checkpoint
+    writer reads the view, the memory may hold a LATER step's values.
+    Forcing the copy on the snapshot thread is what makes the snapshot
+    actually immutable."""
+    a = np.asarray(arr)
+    return a.copy() if a.base is not None else a
 
 
 def _fsync_write(path: str, data: bytes) -> int:

@@ -1,0 +1,141 @@
+"""Persistent XLA compilation-cache wiring (tentpole layer 1).
+
+Every process used to pay full cold XLA compilation on its first batch.
+This module points JAX's persistent compilation cache at a per-user
+directory so the *backend compile* of a previously-seen program is a disk
+read instead of an XLA invocation — across process restarts, across jobs
+sharing the machine. (Layer 2, the AOT executable store in `store.py`,
+additionally skips tracing/lowering; this layer alone already removes the
+dominant cost.)
+
+Knob: ``DL4J_TPU_COMPILE_CACHE`` — opt-OUT semantics:
+
+- unset           -> per-user default (``$XDG_CACHE_HOME`` or
+                     ``~/.cache``)/deeplearning4j_tpu/compile, falling back
+                     to ``./.dl4j_compile_cache`` when the home cache is
+                     not writable (that fallback name is gitignored);
+- ``<dir>``       -> cache there;
+- ``0``/``off``/``false``/``none``/empty -> disabled entirely.
+
+Configuration happens once at package import (``deeplearning4j_tpu/
+__init__.py``): the engines compile a flock of small helper programs
+during ``net.init()`` — before any `_get_jit` — and a warm process should
+replay those from disk too, not just the big training programs. The
+warmup CLI's ``--cache-dir`` re-points it post-import via
+`compilation.reset()`. Concurrent processes are safe: jax writes cache
+entries via tmp-file + atomic rename, and the AOT store does the same
+(`store.py`), so readers never observe a half-written artifact.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import warnings
+from typing import Optional
+
+ENV_KNOB = "DL4J_TPU_COMPILE_CACHE"
+_OFF_VALUES = {"", "0", "false", "off", "none", "disabled"}
+
+# Repo-local fallback when the per-user cache dir is unwritable (e.g. a
+# read-only $HOME in a container). Listed in .gitignore.
+LOCAL_FALLBACK_DIRNAME = ".dl4j_compile_cache"
+
+_lock = threading.Lock()
+_configured = False
+_configured_root: Optional[str] = None
+
+
+def default_cache_dir() -> str:
+    """Per-user default: XDG cache dir, or the repo-local fallback when no
+    home directory resolves."""
+    base = os.environ.get("XDG_CACHE_HOME")
+    if not base:
+        home = os.path.expanduser("~")
+        base = os.path.join(home, ".cache") if home and home != "~" else None
+    if base:
+        return os.path.join(base, "deeplearning4j_tpu", "compile")
+    return os.path.abspath(LOCAL_FALLBACK_DIRNAME)
+
+
+def cache_root() -> Optional[str]:
+    """The configured cache root (None = caching disabled). Reads the env
+    knob on every call so tests can re-point it; `configure_persistent_cache`
+    latches the first answer for the jax side."""
+    raw = os.environ.get(ENV_KNOB)
+    if raw is None:
+        return default_cache_dir()
+    if raw.strip().lower() in _OFF_VALUES:
+        return None
+    return os.path.abspath(os.path.expanduser(raw.strip()))
+
+
+def _ensure_dir(path: str) -> bool:
+    try:
+        os.makedirs(path, exist_ok=True)
+        return os.access(path, os.W_OK)
+    except OSError:
+        return False
+
+
+def configure_persistent_cache() -> Optional[str]:
+    """Point jax's persistent compilation cache at `cache_root()`/xla
+    (idempotent; first call wins). Returns the active root, or None when
+    caching is disabled or the directory is unusable.
+
+    The size/time floors are dropped to "cache everything": the default
+    min-compile-time floor (1s) would skip exactly the many small programs
+    an engine run compiles (per-shape train steps, superstep tails), and
+    entry dedup across processes is the whole point of the directory.
+    """
+    global _configured, _configured_root
+    with _lock:
+        if _configured:
+            return _configured_root
+        root = cache_root()
+        if root is None:
+            _configured, _configured_root = True, None
+            return None
+        if not _ensure_dir(root):
+            fallback = os.path.abspath(LOCAL_FALLBACK_DIRNAME)
+            if fallback != root and _ensure_dir(fallback):
+                root = fallback
+            else:
+                warnings.warn(
+                    f"compile cache dir {root!r} is not writable and neither "
+                    f"is the {LOCAL_FALLBACK_DIRNAME!r} fallback; persistent "
+                    f"compilation caching is disabled for this process "
+                    f"(set {ENV_KNOB} to a writable dir)")
+                _configured, _configured_root = True, None
+                return None
+        try:
+            import jax
+
+            xla_dir = os.path.join(root, "xla")
+            os.makedirs(xla_dir, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", xla_dir)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.0)
+        except Exception as e:  # unknown flag on an exotic jax: degrade
+            warnings.warn(
+                f"could not configure jax's persistent compilation cache "
+                f"({type(e).__name__}: {e}); continuing without it")
+            _configured, _configured_root = True, None
+            return None
+        _configured, _configured_root = True, root
+        return root
+
+
+def reset_for_tests() -> None:
+    """Drop the latched configuration (and jax's in-memory cache handle) so
+    a test can re-point ``DL4J_TPU_COMPILE_CACHE`` at a fresh tmpdir."""
+    global _configured, _configured_root
+    with _lock:
+        _configured, _configured_root = False, None
+    try:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:
+        pass

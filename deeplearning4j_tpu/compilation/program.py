@@ -1,0 +1,205 @@
+"""CachedProgram: the store-aware wrapper the engines' jit cache holds.
+
+`nn/jit_cache.py` wraps every program built by `_build_jit` in a
+`CachedProgram` (when the compile cache is enabled). The wrapper keys each
+call on the ABSTRACT signature of its arguments — shapes/dtypes/structure/
+shardings, the same identity jit itself dispatches on — and on the first
+call of each signature:
+
+1. fingerprints (model config, signature, kind/static, mesh context,
+   versions — `store.build_fingerprint_doc`) and consults the AOT store;
+2. on a hit, uses the deserialized executable: no trace, no lowering, no
+   XLA — the cold-start cost is one disk read;
+3. on a miss, compiles via ``fn.lower(*args).compile()`` (same cost as the
+   jit call would have paid), writes the artifact back, and uses the
+   compiled executable from then on.
+
+Any failure in the store path degrades to the plain jitted callable with a
+warning. `warm(*args)` does step 1-3 *without executing* the program —
+donation-safe pre-compilation for the warmup API. `lower(*args)` delegates
+to the underlying jit fn (the profiler's cost-analysis probe relies on
+it).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import warnings
+from typing import Any, Dict, Optional, Tuple
+
+from deeplearning4j_tpu.compilation import cache as _cache
+from deeplearning4j_tpu.compilation import store as _store
+
+_store_lock = threading.Lock()
+_store_singleton: Optional[_store.AOTStore] = None
+_store_root: Optional[str] = None
+
+
+def get_store() -> Optional[_store.AOTStore]:
+    """Process-wide `AOTStore` under the configured cache root (configures
+    the persistent XLA cache as a side effect of first use). None when
+    caching is disabled."""
+    global _store_singleton, _store_root
+    root = _cache.configure_persistent_cache()
+    if root is None:
+        return None
+    with _store_lock:
+        if _store_singleton is None or _store_root != root:
+            _store_singleton = _store.AOTStore(root)
+            _store_root = root
+        return _store_singleton
+
+
+def reset_for_tests() -> None:
+    global _store_singleton, _store_root
+    with _store_lock:
+        _store_singleton, _store_root = None, None
+    _cache.reset_for_tests()
+
+
+def wrap_program(fn, net, kind: str, static: Dict[str, Any]):
+    """Wrap a freshly built jit program for the executable store; returns
+    `fn` unchanged when the compile cache is disabled (zero overhead)."""
+    if _cache.configure_persistent_cache() is None:
+        return fn
+    return CachedProgram(fn, net, kind, static)
+
+
+class CachedProgram:
+    """See module docstring. One instance per engine jit-cache entry, so
+    the (kind, static, context) identity is fixed; per-call identity is the
+    argument signature."""
+
+    def __init__(self, fn, net, kind: str, static: Dict[str, Any]):
+        self._fn = fn
+        self._net = net
+        self.kind = kind
+        self.static = dict(static)
+        self._entries: Dict[Tuple, Any] = {}
+        self._lock = threading.Lock()
+        self._fallback_warned = False
+
+    # ------------------------------------------------------------ identity
+
+    def _signature(self, args) -> Tuple:
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        descs = []
+        for leaf in leaves:
+            shape = getattr(leaf, "shape", None)
+            if shape is None:
+                descs.append((type(leaf).__name__,))
+                continue
+            descs.append((
+                tuple(shape), str(getattr(leaf, "dtype", "?")),
+                bool(getattr(leaf, "weak_type", False)),
+                getattr(leaf, "sharding", None),
+            ))
+        return (treedef, tuple(descs))
+
+    # ------------------------------------------------------------ dispatch
+
+    def __call__(self, *args):
+        return self._entry_for(args)(*args)
+
+    def _entry_for(self, args):
+        sig = self._signature(args)
+        entry = self._entries.get(sig)
+        if entry is not None:
+            return entry
+        with self._lock:
+            entry = self._entries.get(sig)
+            if entry is None:
+                entry = self._acquire(args)
+                self._entries[sig] = entry
+            return entry
+
+    def _acquire(self, args):
+        store = get_store()
+        if store is None:
+            return self._fn
+        try:
+            doc = _store.build_fingerprint_doc(self._net, self.kind,
+                                               self.static, args)
+            fp = _store.fingerprint(doc)
+        except Exception as e:
+            self._warn_fallback("fingerprinting failed", e)
+            return self._fn
+        loaded = store.load(fp)
+        if loaded is not None:
+            _store._M_HITS_AOT.inc()
+            return loaded
+        _store._M_MISSES_AOT.inc()
+        try:
+            t0 = time.perf_counter()
+            compiled = self._fn.lower(*args).compile()
+            # dl4j_compile_seconds{source=trace|persistent} for the backend
+            # part is observed by the jax.monitoring hook; this histogram
+            # entry is intentionally NOT duplicated here.
+            dt = time.perf_counter() - t0
+        except Exception as e:
+            self._warn_fallback("AOT compilation failed", e)
+            return self._fn
+        store.save(fp, compiled, dict(doc, compile_seconds=dt))
+        return compiled
+
+    def _warn_fallback(self, what: str, e: Exception) -> None:
+        if not self._fallback_warned:
+            self._fallback_warned = True
+            warnings.warn(
+                f"{what} for program {self.kind!r} "
+                f"({type(e).__name__}: {e}); using the plain jit path for "
+                f"this program")
+
+    # ------------------------------------------------------------- warmup
+
+    def warm(self, *args) -> str:
+        """Ensure an executable exists for this argument signature WITHOUT
+        running it (safe with donated buffers). Returns where it came
+        from: 'ready' (already warm), 'aot' (store hit), 'compiled'
+        (live compile + write-back), or 'jit' (store unavailable — the
+        program will trace on first call)."""
+        sig = self._signature(args)
+        with self._lock:
+            if sig in self._entries:
+                return "ready"
+            store = get_store()
+            if store is None:
+                return "jit"
+            try:
+                doc = _store.build_fingerprint_doc(self._net, self.kind,
+                                                  self.static, args)
+                fp = _store.fingerprint(doc)
+            except Exception as e:
+                self._warn_fallback("fingerprinting failed", e)
+                self._entries[sig] = self._fn
+                return "jit"
+            loaded = store.load(fp)
+            if loaded is not None:
+                _store._M_HITS_AOT.inc()
+                self._entries[sig] = loaded
+                return "aot"
+            _store._M_MISSES_AOT.inc()
+            try:
+                t0 = time.perf_counter()
+                compiled = self._fn.lower(*args).compile()
+                dt = time.perf_counter() - t0
+            except Exception as e:
+                self._warn_fallback("AOT compilation failed", e)
+                self._entries[sig] = self._fn
+                return "jit"
+            store.save(fp, compiled, dict(doc, compile_seconds=dt))
+            self._entries[sig] = compiled
+            return "compiled"
+
+    # ----------------------------------------------------------- plumbing
+
+    def lower(self, *args, **kwargs):
+        """Delegate to the underlying jit fn (cost-analysis probes)."""
+        return self._fn.lower(*args, **kwargs)
+
+    def __repr__(self) -> str:
+        return (f"CachedProgram({self.kind!r}, static={self.static}, "
+                f"entries={len(self._entries)})")

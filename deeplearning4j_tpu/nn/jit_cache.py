@@ -1,0 +1,44 @@
+"""Shared jit-program cache for the two engines.
+
+`MultiLayerNetwork._get_jit` and `ComputationGraph._get_jit` used to carry
+near-identical copies of the cache-key construction + lookup; both now
+delegate here, and the compile-cache store (`compilation/`) hooks in once
+instead of twice.
+
+The cache key is ``(kind, sorted static args, context_cache_key())``: the
+active `ParallelContext` selects which program a layer traces (ring vs
+flash attention, expert-sharded vs local MoE), so it is part of the
+program identity — the same net can train sharded and unsharded in one
+process without stale programs. Superstep `k`/`scan` arrive through
+`static`, so each distinct block length is its own cached program (the
+StepProfiler's jit-cache-growth heuristic relies on that to classify a
+tail block's first call as compile).
+
+When the compile cache is enabled (`DL4J_TPU_COMPILE_CACHE`, on by
+default) each freshly built program is wrapped in a
+`compilation.CachedProgram`, which consults the fingerprinted AOT
+executable store before the first trace and writes back on miss; when
+disabled, the raw jitted callable is cached — byte-for-byte the old
+behavior.
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu import compilation as _compilation
+from deeplearning4j_tpu.parallel.context import context_cache_key
+
+
+def get_jit(net, hit_metric, miss_metric, kind: str, **static):
+    """Cached program lookup for one engine instance (see module
+    docstring). `hit_metric`/`miss_metric` are the engine's labeled
+    jit-cache counters."""
+    key = (kind, tuple(sorted(static.items())), context_cache_key())
+    fn = net._jit_cache.get(key)
+    if fn is not None:
+        hit_metric.inc()
+        return fn
+    miss_metric.inc()
+    fn = _compilation.wrap_program(net._build_jit(kind, **static),
+                                   net, kind, static)
+    net._jit_cache[key] = fn
+    return fn

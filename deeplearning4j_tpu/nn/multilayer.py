@@ -44,6 +44,7 @@ from deeplearning4j_tpu.nn.layers import OUTPUT_LAYER_TYPES, get_impl
 from deeplearning4j_tpu.ops import grad_norm as grad_norm_mod
 from deeplearning4j_tpu.ops import schedules as schedules_mod
 from deeplearning4j_tpu.ops import updaters as updaters_mod
+from deeplearning4j_tpu.nn import jit_cache as jit_cache_mod
 from deeplearning4j_tpu.nn import superstep as _superstep
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterators import (
@@ -266,19 +267,22 @@ class MultiLayerNetwork:
         return preout
 
     def _get_jit(self, kind: str, **static):
-        from deeplearning4j_tpu.parallel.context import context_cache_key
+        # Key construction/lookup + compile-cache store hook shared with
+        # ComputationGraph (see nn/jit_cache.py).
+        return jit_cache_mod.get_jit(self, _M_JIT_HIT, _M_JIT_MISS,
+                                     kind, **static)
 
-        # The active ParallelContext selects which program layer impls trace
-        # (ring vs flash attention, expert-sharded vs local MoE), so it is
-        # part of the program identity.
-        key = (kind, tuple(sorted(static.items())), context_cache_key())
-        if key in self._jit_cache:
-            _M_JIT_HIT.inc()
-            return self._jit_cache[key]
-        _M_JIT_MISS.inc()
-        fn = self._build_jit(kind, **static)
-        self._jit_cache[key] = fn
-        return fn
+    def warmup(self, data=None, kinds=None, background: bool = False,
+               batch_size: int = 32):
+        """Pre-compile (or AOT-load) the jit programs for an example
+        batch's signature without running them — params/optimizer/RNG are
+        untouched. See `compilation.warmup.warmup_net` for the `data` /
+        `kinds` / `background` contract."""
+        from deeplearning4j_tpu.compilation import warmup as warmup_mod
+
+        return warmup_mod.warmup_net(self, data, kinds=kinds,
+                                     background=background,
+                                     batch_size=batch_size)
 
     def _build_jit(self, kind: str, train=False, keep_rnn_state=False,
                    advance=False, collect=False, algo=None, k=None,

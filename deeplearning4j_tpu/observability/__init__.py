@@ -107,24 +107,56 @@ def host_nbytes(*parts) -> int:
 _hook_lock = threading.Lock()
 _hook_installed = False
 _hook_registries: list = []
+# Persistent-cache hit attribution: on a hit jax STILL emits a
+# `backend_compile` duration event (near-zero — the "compile" was a disk
+# read), which used to be miscounted as a real compile. The cache_hits
+# event precedes it on the same thread, so a thread-local pending flag
+# re-routes the next backend_compile event to the persistent bucket.
+_hook_tls = threading.local()
+
+
+def _register_hook_families(reg: MetricsRegistry) -> None:
+    reg.counter("dl4j_xla_compiles_total",
+                "XLA backend compiles observed via jax.monitoring "
+                "(persistent-cache hits excluded)")
+    reg.counter("dl4j_xla_compile_seconds_total",
+                "Seconds in jax compile pipeline phases",
+                label_names=("phase",))
+    reg.counter("dl4j_compile_cache_hits_total",
+                "Compile-cache hits by layer (aot = framework executable "
+                "store, persistent = jax/XLA persistent compilation cache)",
+                label_names=("source",))
+    reg.counter("dl4j_compile_cache_misses_total",
+                "Compile-cache misses by layer (see "
+                "dl4j_compile_cache_hits_total)",
+                label_names=("source",))
+    reg.histogram("dl4j_compile_seconds",
+                  "Seconds to make one program runnable, by source (trace = "
+                  "full lowering + backend compile, persistent = XLA cache "
+                  "retrieval, aot = executable deserialization)",
+                  label_names=("source",))
 
 
 def install_jax_compile_hook(registry: Optional[MetricsRegistry] = None) -> bool:
-    """Feed `jax.monitoring` compile-duration events into the registry as
-    `dl4j_xla_compiles_total` and `dl4j_xla_compile_seconds_total{phase}`
-    (phase = trace / mlir / backend_compile...). The jax listener is
-    installed once per process; additional registries passed on later calls
-    are fanned out to. Returns True when the hook is (now) active."""
+    """Feed `jax.monitoring` compile events into the registry:
+
+    - `dl4j_xla_compiles_total` — real backend compiles (a persistent-cache
+      hit fires jax's backend_compile event with ~zero duration; those are
+      attributed to the cache, not counted here)
+    - `dl4j_xla_compile_seconds_total{phase}` — trace / mlir / backend...
+    - `dl4j_compile_cache_hits_total` / `_misses_total` {source=persistent}
+    - `dl4j_compile_seconds{source=trace|persistent}` (the `aot` source is
+      observed by `compilation.store`, not here)
+
+    The jax listeners are installed once per process; additional registries
+    passed on later calls are fanned out to. Returns True when the hook is
+    (now) active."""
     global _hook_installed
     reg = registry or metrics
     with _hook_lock:
         if reg not in _hook_registries:
             _hook_registries.append(reg)
-            reg.counter("dl4j_xla_compiles_total",
-                        "XLA backend compiles observed via jax.monitoring")
-            reg.counter("dl4j_xla_compile_seconds_total",
-                        "Seconds in jax compile pipeline phases",
-                        label_names=("phase",))
+            _register_hook_families(reg)
         if _hook_installed:
             return True
         try:
@@ -132,21 +164,50 @@ def install_jax_compile_hook(registry: Optional[MetricsRegistry] = None) -> bool
         except Exception:
             return False
 
+        def on_cache_event(event: str, **kw) -> None:
+            if event == "/jax/compilation_cache/cache_hits":
+                _hook_tls.persistent_hit = True
+                for r in _hook_registries:
+                    r.counter("dl4j_compile_cache_hits_total",
+                              label_names=("source",)).labels(
+                                  source="persistent").inc()
+            elif event == "/jax/compilation_cache/cache_misses":
+                _hook_tls.persistent_hit = False
+                for r in _hook_registries:
+                    r.counter("dl4j_compile_cache_misses_total",
+                              label_names=("source",)).labels(
+                                  source="persistent").inc()
+
         def on_event(event: str, duration: float, **kw) -> None:
+            if event.endswith("/cache_retrieval_time_sec"):
+                for r in _hook_registries:
+                    r.histogram("dl4j_compile_seconds",
+                                label_names=("source",)).labels(
+                                    source="persistent").observe(duration)
+                return
             if not event.startswith("/jax/core/compile"):
                 return
             # '/jax/core/compile/backend_compile_duration' -> 'backend_compile'
             phase = event.rsplit("/", 1)[-1]
             if phase.endswith("_duration"):
                 phase = phase[:-len("_duration")]
+            is_backend = phase == "backend_compile"
+            pending_hit = is_backend and getattr(
+                _hook_tls, "persistent_hit", False)
+            if pending_hit:
+                _hook_tls.persistent_hit = False
             for r in _hook_registries:
                 r.counter("dl4j_xla_compile_seconds_total",
                           label_names=("phase",)).labels(
                               phase=phase).inc(duration)
-                if phase == "backend_compile":
+                if is_backend and not pending_hit:
                     r.counter("dl4j_xla_compiles_total").inc()
+                    r.histogram("dl4j_compile_seconds",
+                                label_names=("source",)).labels(
+                                    source="trace").observe(duration)
 
         try:
+            monitoring.register_event_listener(on_cache_event)
             monitoring.register_event_duration_secs_listener(on_event)
         except Exception:
             return False
@@ -175,7 +236,8 @@ def bench_snapshot(registry: Optional[MetricsRegistry] = None) -> Dict[str, Any]
         return vals or None
 
     for hist in ("dl4j_step_latency_seconds", "dl4j_step_dispatch_seconds",
-                 "dl4j_infer_latency_seconds", "dl4j_request_latency_seconds"):
+                 "dl4j_infer_latency_seconds", "dl4j_request_latency_seconds",
+                 "dl4j_compile_seconds"):
         fam = reg.get_family(hist)
         if fam is None:
             continue
@@ -186,6 +248,8 @@ def bench_snapshot(registry: Optional[MetricsRegistry] = None) -> Dict[str, Any]
             key = ",".join(f"{k}={v}" for k, v in child.labels.items())
             out.setdefault(hist, {})[key or "_"] = summary
     for name in ("dl4j_xla_compiles_total", "dl4j_xla_compile_seconds_total",
+                 "dl4j_compile_cache_hits_total",
+                 "dl4j_compile_cache_misses_total",
                  "dl4j_jit_cache_hits_total", "dl4j_jit_cache_misses_total",
                  "dl4j_host_to_device_bytes_total",
                  "dl4j_checkpoint_bytes_written_total",

@@ -152,6 +152,7 @@ class StepProfiler:
 
         obs.install_jax_compile_hook(self.registry)
         self._compile_s0 = self._compile_seconds()
+        self._cache_counts0 = self._cache_counts()
         self._jit_known = len(self.net._jit_cache)
         self._orig_dispatch = self.net._fit_dispatch
         self._orig_output = self.net.output
@@ -212,8 +213,34 @@ class StepProfiler:
         return sum(c.get() for c in fam.children())
 
     def compile_seconds(self) -> float:
-        """XLA compile seconds that elapsed inside the profiled window."""
+        """XLA compile seconds that elapsed inside the profiled window.
+        A persistent-cache hit's near-zero backend_compile event still
+        lands here (it is seconds spent, just tiny); the hit itself is
+        reported under `summary()['compile_cache']`, not as a compile."""
         return max(0.0, self._compile_seconds() - self._compile_s0)
+
+    def _cache_counts(self) -> Dict[str, float]:
+        counts: Dict[str, float] = {}
+        for kind, name in (("hits", "dl4j_compile_cache_hits_total"),
+                           ("misses", "dl4j_compile_cache_misses_total")):
+            fam = self.registry.get_family(name)
+            if fam is None:
+                continue
+            for child in fam.children():
+                source = child.labels.get("source", "_")
+                counts[f"{kind}_{source}"] = child.get()
+        return counts
+
+    def compile_cache_deltas(self) -> Dict[str, float]:
+        """Per-source compile-cache hit/miss counts inside the profiled
+        window, e.g. {'hits_aot': 2, 'misses_persistent': 1}."""
+        base = getattr(self, "_cache_counts0", {})
+        out: Dict[str, float] = {}
+        for key, val in self._cache_counts().items():
+            delta = val - base.get(key, 0.0)
+            if delta > 0:
+                out[key] = delta
+        return out
 
     def execute_seconds_median(self) -> Optional[float]:
         if not self.step_times:
@@ -250,6 +277,9 @@ class StepProfiler:
             "execute_seconds_median": med,
             "host_to_device_bytes": self.h2d_bytes,
         }
+        cache = self.compile_cache_deltas()
+        if cache:
+            out["compile_cache"] = cache
         if self.step_times:
             s = sorted(self.step_times)
             out["step_latency"] = {

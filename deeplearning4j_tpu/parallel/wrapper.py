@@ -272,6 +272,36 @@ class ParallelWrapper:
         return sharded_evaluate(self.net, iterator, mesh=self.mesh,
                                 top_n=top_n)
 
+    def warmup(self, data=None, kinds=None, background: bool = False,
+               batch_size: int = 32):
+        """Pre-compile the SHARDED programs `fit()` will dispatch: the
+        example batch (synthetic when `data` is None) is padded and
+        device_put over this wrapper's mesh exactly like a training batch,
+        so the warmed programs carry the right input shardings and mesh
+        context. When the superstep knob is active the `[K, B, ...]`
+        superbatch program is warmed too. See
+        `compilation.warmup.warmup_net` for the return contract."""
+        from deeplearning4j_tpu.compilation import warmup as warmup_mod
+
+        net = self.net
+        is_graph = type(net).__name__ == "ComputationGraph"
+        if data is None:
+            data = warmup_mod.synthetic_dataset(net, batch_size)
+        if isinstance(data, (DataSet, MultiDataSet)):
+            data = [data]
+        k = net._superstep_k() if hasattr(net, "_superstep_k") else 0
+        items = []
+        for ds in data:
+            padded = self._prepare(ds, is_graph)
+            items.append(self._shard_batch(padded, is_graph))
+            has_labels = (padded.labels is not None)
+            if k > 1 and kinds is None and has_labels:
+                items.append(self._stack_shard([padded] * k, is_graph))
+        return warmup_mod.warmup_net(net, items, kinds=kinds,
+                                     background=background,
+                                     batch_size=batch_size,
+                                     context=self.context)
+
     # ------------------------------------------------------- checkpointing
 
     def checkpoint_manager(self, directory: str, **kwargs):

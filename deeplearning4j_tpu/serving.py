@@ -12,6 +12,11 @@ semantics are:
   one fixed `max_batch_size` so the jitted forward compiles exactly once
   and the MXU sees full batches (the TPU reason to batch at all);
 - `GET /health` liveness probe;
+- `GET /healthz` readiness probe: `{"status": "warming"|"ready"}` — with
+  `warmup=True` the server pushes one synthetic padded batch through the
+  model on a background thread at `start()` so the first real request pays
+  no XLA compile; while warming, `POST /predict` answers 503 +
+  `Retry-After` instead of stalling the caller behind the compile;
 - `GET /metrics` Prometheus scrape of the process-global registry
   (request-latency + batch-size histograms, queue-depth gauge — PERF.md §11).
 """
@@ -60,12 +65,19 @@ class InferenceServer:
 
     `max_batch_size` bounds the padded compile shape; `max_delay_ms` is how
     long the batcher waits to coalesce concurrent requests before running a
-    partial (still padded) batch.
+    partial (still padded) batch. With `warmup=True`, `start()` returns
+    immediately but compiles the serving program on a background thread by
+    pushing one synthetic `max_batch_size` batch through the model
+    (`warmup_shape` overrides the per-example feature shape when the model
+    config doesn't declare one); poll `GET /healthz` or call
+    `wait_ready()` before sending traffic.
     """
 
     def __init__(self, net, port: int = 0, host: str = "127.0.0.1",
                  max_batch_size: int = 32, max_delay_ms: float = 5.0,
-                 predict_timeout_s: Optional[float] = 300.0):
+                 predict_timeout_s: Optional[float] = 300.0,
+                 warmup: bool = False,
+                 warmup_shape: Optional[Tuple[int, ...]] = None):
         self.net = net
         self.host = host
         self.port = port
@@ -75,20 +87,66 @@ class InferenceServer:
         self.predict_timeout_s = predict_timeout_s
         self.max_batch_size = int(max_batch_size)
         self.max_delay_s = float(max_delay_ms) / 1000.0
+        self.warmup = bool(warmup)
+        self.warmup_shape = None if warmup_shape is None else tuple(warmup_shape)
+        self._ready = threading.Event()
+        self._ready.set()
         self._queue: "queue.Queue[Optional[_Pending]]" = queue.Queue()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._batcher: Optional[threading.Thread] = None
         self._serve_thread: Optional[threading.Thread] = None
+        self._warmup_thread: Optional[threading.Thread] = None
 
     @classmethod
     def from_checkpoint(cls, path, **kwargs) -> "InferenceServer":
         """Serve straight from a checkpoint on disk: a sharded checkpoint
         directory (a committed step or a `CheckpointManager` root — latest
         committed step wins) or a legacy model ZIP. The deploy path is one
-        call: train anywhere, point the server at the checkpoint store."""
+        call: train anywhere, point the server at the checkpoint store —
+        with `warmup=True` the checkpointed model is pre-compiled before
+        the first request arrives (watch `GET /healthz` for "ready")."""
         from deeplearning4j_tpu.checkpoint import load_any
 
         return cls(load_any(path), **kwargs)
+
+    # -------------------------------------------------------------- warmup
+
+    @property
+    def _status(self) -> str:
+        # Derived from the Event (its own lock) so the warmup thread and
+        # the HTTP handlers never race on a plain attribute.
+        return "ready" if self._ready.is_set() else "warming"
+
+    def wait_ready(self, timeout: Optional[float] = None) -> bool:
+        """Block until warmup finished (immediately True without warmup)."""
+        return self._ready.wait(timeout)
+
+    def _warmup_run(self) -> None:
+        """Push one synthetic padded batch through the model so the serving
+        program (and, with the compile cache enabled, the AOT/persistent
+        store) is hot before real traffic. Failures flip to "ready" anyway —
+        the first real request then pays the compile, exactly the
+        no-warmup behavior."""
+        try:
+            from deeplearning4j_tpu.compilation.warmup import (
+                infer_feature_shape)
+
+            shape = self.warmup_shape or infer_feature_shape(self.net)
+            if shape is None:
+                raise ValueError(
+                    "cannot infer the model's input shape; pass "
+                    "warmup_shape=(...) to InferenceServer")
+            x = np.zeros((self.max_batch_size,) + tuple(shape), np.float32)
+            with _obs.tracer.span("serving.warmup", cat="serving",
+                                  padded_to=self.max_batch_size):
+                np.asarray(self.net.output(x))
+        except Exception as e:
+            import warnings
+
+            warnings.warn(f"serving warmup failed ({type(e).__name__}: {e}); "
+                          "the first request will pay the compile")
+        finally:
+            self._ready.set()
 
     # ------------------------------------------------------------- batching
 
@@ -191,11 +249,13 @@ class InferenceServer:
             def log_message(self, *args):
                 pass
 
-            def _json(self, obj, code=200):
+            def _json(self, obj, code=200, headers=None):
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -203,6 +263,8 @@ class InferenceServer:
                 if self.path == "/health":
                     self._json({"status": "ok",
                                 "model": type(server.net).__name__})
+                elif self.path == "/healthz":
+                    self._json({"status": server._status})
                 elif self.path == "/metrics":
                     body = _obs.metrics.to_prometheus().encode()
                     self.send_response(200)
@@ -213,12 +275,18 @@ class InferenceServer:
                     self.wfile.write(body)
                 else:
                     self._json({"error": "not found",
-                                "routes": ["/health", "/metrics",
-                                           "/predict"]}, 404)
+                                "routes": ["/health", "/healthz",
+                                           "/metrics", "/predict"]}, 404)
 
             def do_POST(self):
                 if self.path != "/predict":
                     return self._json({"error": "not found"}, 404)
+                if server._status != "ready":
+                    # Don't park callers behind the warmup compile: tell
+                    # them to retry once /healthz flips to "ready".
+                    return self._json({"error": "warming up",
+                                       "status": server._status},
+                                      503, headers={"Retry-After": "1"})
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(self.rfile.read(length))
@@ -241,6 +309,14 @@ class InferenceServer:
         self._serve_thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True)
         self._serve_thread.start()
+        if self.warmup:
+            # The port is already bound and /healthz answers "warming", so
+            # orchestrators can watch readiness while the model compiles.
+            self._ready.clear()
+            self._warmup_thread = threading.Thread(
+                target=self._warmup_run, name="dl4j-serving-warmup",
+                daemon=True)
+            self._warmup_thread.start()
         return self
 
     @property

@@ -7,10 +7,17 @@ gradient checks run in double precision.
 """
 
 import os
+import tempfile
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Hermetic compile cache: without this, tests would share (and pollute)
+# the developer's per-user cache dir, and cached executables from an
+# earlier run would turn expected compiles into AOT hits.
+os.environ.setdefault("DL4J_TPU_COMPILE_CACHE",
+                      tempfile.mkdtemp(prefix="dl4j-test-compile-cache-"))
 
 import jax
 

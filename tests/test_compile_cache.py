@@ -1,0 +1,406 @@
+"""Persistent compile cache + AOT warmup (`deeplearning4j_tpu/compilation/`).
+
+Covers the acceptance criteria of the compile-cache PR: fingerprint
+invalidation (config / static-args / mesh / version changes each force a
+miss), corrupt-artifact fallback (warning + bit-identical results),
+warmup-then-fit with ZERO first-batch traces in a fresh process (checked
+via `dl4j_xla_compiles_total` in a subprocess), the CLI, and the serving
+readiness protocol (`/healthz`, 503 while warming).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (MultiLayerNetwork, NeuralNetConfiguration,
+                                compilation)
+from deeplearning4j_tpu import observability as obs
+from deeplearning4j_tpu.compilation import store as store_mod
+from deeplearning4j_tpu.compilation import warmup as warmup_mod
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+
+
+def mlp_conf(n_in=4, n_out=3, seed=42, lr=0.1):
+    return (NeuralNetConfiguration.builder()
+            .seed(seed).learning_rate(lr).updater("sgd")
+            .weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=n_out, activation="softmax",
+                               loss_function="mcxent"))
+            .set_input_type(InputType.feed_forward(n_in))
+            .build())
+
+
+def small_dataset(n=16, n_in=4, n_out=3, seed=0):
+    r = np.random.RandomState(seed)
+    x = r.rand(n, n_in).astype("float32")
+    y = np.eye(n_out, dtype="float32")[r.randint(0, n_out, n)]
+    return DataSet(x, y)
+
+
+@pytest.fixture
+def cache_dir(tmp_path, monkeypatch):
+    """Fresh per-test cache root (the session default from conftest stays
+    untouched); resets the store singleton on both sides."""
+    d = str(tmp_path / "compile-cache")
+    monkeypatch.setenv(compilation.ENV_KNOB, d)
+    compilation.reset()
+    yield d
+    compilation.reset()
+
+
+def _counter_total(name, source=None):
+    fam = obs.metrics.get_family(name)
+    if fam is None:
+        return 0.0
+    total = 0.0
+    for child in fam.children():
+        if source is not None and child.labels.get("source") != source:
+            continue
+        total += child.get()
+    return total
+
+
+# ------------------------------------------------------------ fingerprint
+
+
+class TestFingerprint:
+    def _doc(self, net=None, static=None, ds=None):
+        net = net or MultiLayerNetwork(mlp_conf())
+        if not net._initialized:
+            net.init()
+        ds = ds or small_dataset()
+        args = warmup_mod._mln_args(net, ds, "train_step")
+        return store_mod.build_fingerprint_doc(net, "train_step",
+                                               static or {}, args)
+
+    def test_stable_for_identical_inputs(self):
+        net = MultiLayerNetwork(mlp_conf())
+        net.init()
+        ds = small_dataset()
+        fp1 = store_mod.fingerprint(self._doc(net, ds=ds))
+        fp2 = store_mod.fingerprint(self._doc(net, ds=ds))
+        assert fp1 == fp2
+
+    def test_model_config_edit_forces_miss(self):
+        base = store_mod.fingerprint(self._doc())
+        edited = MultiLayerNetwork(mlp_conf(lr=0.2))
+        edited.init()
+        assert store_mod.fingerprint(self._doc(edited)) != base
+
+    def test_superstep_k_change_forces_miss(self):
+        net = MultiLayerNetwork(mlp_conf())
+        net.init()
+        ds = small_dataset()
+        fp2 = store_mod.fingerprint(self._doc(net, {"k": 2}, ds))
+        fp4 = store_mod.fingerprint(self._doc(net, {"k": 4}, ds))
+        assert fp2 != fp4
+
+    def test_mesh_context_forces_miss(self):
+        import jax
+
+        from deeplearning4j_tpu.parallel import mesh as mesh_mod
+        from deeplearning4j_tpu.parallel.context import (ParallelContext,
+                                                         parallel_context)
+
+        net = MultiLayerNetwork(mlp_conf())
+        net.init()
+        ds = small_dataset()
+        base = store_mod.fingerprint(self._doc(net, ds=ds))
+        mesh = mesh_mod.create_mesh(devices=jax.devices()[:2])
+        ctx = ParallelContext(mesh=mesh, data_axis=mesh.axis_names[0])
+        with parallel_context(ctx):
+            sharded = store_mod.fingerprint(self._doc(net, ds=ds))
+        assert sharded != base
+
+    def test_version_bump_forces_miss(self):
+        doc = self._doc()
+        bumped = dict(doc, jax="999.0.0")
+        assert store_mod.fingerprint(bumped) != store_mod.fingerprint(doc)
+
+    def test_batch_signature_forces_miss(self):
+        net = MultiLayerNetwork(mlp_conf())
+        net.init()
+        fp16 = store_mod.fingerprint(self._doc(net, ds=small_dataset(16)))
+        fp32 = store_mod.fingerprint(self._doc(net, ds=small_dataset(32)))
+        assert fp16 != fp32
+
+
+# ------------------------------------------------------- store + fallback
+
+
+class TestAOTStoreFallback:
+    def test_warmup_writes_artifacts(self, cache_dir):
+        net = MultiLayerNetwork(mlp_conf())
+        net.init()
+        summary = net.warmup(small_dataset())
+        assert summary["programs"] >= 3
+        assert summary["compiled"] + summary["aot"] >= 3
+        aot = os.path.join(cache_dir, "aot")
+        assert any(f.endswith(".jaxec") for f in os.listdir(aot))
+
+    def test_corrupt_artifact_warns_and_falls_back(self, cache_dir):
+        ds = small_dataset()
+        net = MultiLayerNetwork(mlp_conf())
+        net.init()
+        net.warmup(ds, kinds=["output"])
+        aot = os.path.join(cache_dir, "aot")
+        for name in os.listdir(aot):
+            if name.endswith(".jaxec"):
+                with open(os.path.join(aot, name), "wb") as f:
+                    f.write(b"\x00corrupt garbage\xff")
+        compilation.reset()  # fresh store: drop the in-process executables
+
+        fresh = MultiLayerNetwork(mlp_conf())
+        fresh.init()
+        with pytest.warns(UserWarning, match="unusable AOT"):
+            out = np.asarray(fresh.output(ds.features))
+
+        clean = MultiLayerNetwork(mlp_conf())
+        clean.init()
+        expected = np.asarray(clean.output(ds.features))
+        np.testing.assert_array_equal(out, expected)
+
+    def test_disabled_knob_returns_raw_program(self, monkeypatch):
+        monkeypatch.setenv(compilation.ENV_KNOB, "0")
+        compilation.reset()
+        try:
+            assert compilation.cache_root() is None
+            assert compilation.get_store() is None
+            sentinel = object()
+            assert compilation.wrap_program(sentinel, None, "output",
+                                            {}) is sentinel
+        finally:
+            monkeypatch.undo()
+            compilation.reset()
+
+
+# ---------------------------------------------------------------- warmup
+
+
+class TestWarmup:
+    def test_warmup_then_fit_compiles_nothing_new(self, cache_dir):
+        obs.install_jax_compile_hook(obs.metrics)
+        ds = small_dataset()
+        net = MultiLayerNetwork(mlp_conf())
+        net.init()
+        params_before = [np.asarray(p) for p in
+                         __import__("jax").tree_util.tree_leaves(
+                             net.params_tree)]
+        net.warmup(ds)
+        params_after = [np.asarray(p) for p in
+                        __import__("jax").tree_util.tree_leaves(
+                            net.params_tree)]
+        for a, b in zip(params_before, params_after):
+            np.testing.assert_array_equal(a, b)
+
+        compiles_before = _counter_total("dl4j_xla_compiles_total")
+        net.fit(ds)
+        net.output(ds.features)
+        assert _counter_total("dl4j_xla_compiles_total") == compiles_before
+
+    def test_background_warmup_thread(self, cache_dir):
+        net = MultiLayerNetwork(mlp_conf())
+        net.init()
+        thread = net.warmup(small_dataset(), background=True)
+        thread.join(timeout=120)
+        assert not thread.is_alive()
+        assert thread.warmup_error is None
+        assert thread.warmup_result["programs"] >= 3
+
+    def test_synthetic_dataset_from_input_type(self):
+        net = MultiLayerNetwork(mlp_conf())
+        ds = warmup_mod.synthetic_dataset(net, 8)
+        assert np.asarray(ds.features).shape == (8, 4)
+        assert np.asarray(ds.labels).shape == (8, 3)
+
+
+_CHILD_SCRIPT = r"""
+import json, os
+import numpy as np
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu import observability as obs
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+
+obs.install_jax_compile_hook(obs.metrics)
+conf = (NeuralNetConfiguration.builder()
+        .seed(42).learning_rate(0.1).updater("sgd").weight_init("xavier")
+        .list()
+        .layer(DenseLayer(n_out=8, activation="relu"))
+        .layer(OutputLayer(n_out=3, activation="softmax",
+                           loss_function="mcxent"))
+        .set_input_type(InputType.feed_forward(4))
+        .build())
+net = MultiLayerNetwork(conf)
+net.init()
+r = np.random.RandomState(0)
+x = r.rand(16, 4).astype("float32")
+y = np.eye(3, dtype="float32")[r.randint(0, 3, 16)]
+ds = DataSet(x, y)
+mode = os.environ["CHILD_MODE"]
+if mode == "warm":
+    net.warmup(ds)
+else:
+    net.fit(ds)
+    net.output(x)
+
+def total(name, source=None):
+    fam = obs.metrics.get_family(name)
+    if fam is None:
+        return 0.0
+    return sum(c.get() for c in fam.children()
+               if source is None or c.labels.get("source") == source)
+
+print(json.dumps({
+    "xla_compiles": total("dl4j_xla_compiles_total"),
+    "aot_hits": total("dl4j_compile_cache_hits_total", "aot"),
+    "aot_misses": total("dl4j_compile_cache_misses_total", "aot"),
+}))
+"""
+
+
+def _run_child(cache_dir, mode):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", CHILD_MODE=mode)
+    env["DL4J_TPU_COMPILE_CACHE"] = cache_dir
+    env.pop("XLA_FLAGS", None)  # plain 1-device CPU child
+    proc = subprocess.run([sys.executable, "-c", _CHILD_SCRIPT],
+                          capture_output=True, text=True, env=env,
+                          timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+class TestCrossProcessWarmStart:
+    def test_populated_cache_means_zero_traces_in_fresh_process(
+            self, tmp_path):
+        cache = str(tmp_path / "shared-cache")
+        cold = _run_child(cache, "warm")
+        assert cold["xla_compiles"] > 0
+        assert cold["aot_misses"] > 0
+        warm = _run_child(cache, "fit")
+        # The whole point of the PR: a fresh process replays every seen
+        # program from the executable store — zero full XLA traces.
+        assert warm["xla_compiles"] == 0
+        assert warm["aot_hits"] >= 2  # train_step + output at minimum
+
+
+class TestWarmupCLI:
+    def test_cli_smoke(self, tmp_path):
+        from deeplearning4j_tpu.checkpoint import save_checkpoint
+
+        net = MultiLayerNetwork(mlp_conf())
+        net.init()
+        ckpt = str(tmp_path / "ckpt")
+        save_checkpoint(net, ckpt)
+        cache = str(tmp_path / "cli-cache")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("XLA_FLAGS", None)
+        env.pop("DL4J_TPU_COMPILE_CACHE", None)
+        proc = subprocess.run(
+            [sys.executable, "-m", "deeplearning4j_tpu.compilation.warmup",
+             ckpt, "--batch-size", "4", "--cache-dir", cache],
+            capture_output=True, text=True, env=env, timeout=300)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        summary = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert summary["programs"] >= 1
+        assert summary["cache_dir"] == cache
+        assert os.path.isdir(os.path.join(cache, "aot"))
+        assert any(f.endswith(".jaxec")
+                   for f in os.listdir(os.path.join(cache, "aot")))
+
+
+# --------------------------------------------------------------- serving
+
+
+class _BlockingNet:
+    """output() blocks until released — holds the server in "warming"."""
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.calls = 0
+
+    def output(self, x):
+        self.calls += 1
+        if self.calls == 1:  # only the warmup batch blocks
+            self.release.wait(timeout=60)
+        return np.zeros((np.asarray(x).shape[0], 2), np.float32)
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+class TestServingWarmup:
+    def test_healthz_and_503_while_warming(self):
+        from deeplearning4j_tpu.serving import InferenceServer
+
+        net = _BlockingNet()
+        server = InferenceServer(net, max_batch_size=4, warmup=True,
+                                 warmup_shape=(3,),
+                                 predict_timeout_s=30.0).start()
+        try:
+            deadline = time.monotonic() + 10
+            while (server._status != "warming"
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            assert _get_json(server.url + "/healthz")["status"] == "warming"
+
+            req = urllib.request.Request(
+                server.url + "/predict",
+                data=json.dumps({"data": [[0.0, 0.0, 0.0]]}).encode(),
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(req, timeout=10)
+            assert exc_info.value.code == 503
+            assert exc_info.value.headers.get("Retry-After") == "1"
+
+            net.release.set()
+            assert server.wait_ready(timeout=30)
+            assert _get_json(server.url + "/healthz")["status"] == "ready"
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                preds = json.loads(resp.read())["predictions"]
+            assert len(preds) == 1
+        finally:
+            net.release.set()
+            server.stop()
+
+    def test_warmed_first_request_latency_near_steady_state(self, cache_dir):
+        from deeplearning4j_tpu.serving import InferenceServer
+
+        net = MultiLayerNetwork(mlp_conf())
+        net.init()
+        server = InferenceServer(net, max_batch_size=8, max_delay_ms=1.0,
+                                 warmup=True).start()
+        try:
+            assert server.wait_ready(timeout=120)
+            fam = obs.metrics.get_family("dl4j_request_latency_seconds")
+            count0 = fam.summarize().get("count", 0)
+            row = [[0.1, 0.2, 0.3, 0.4]]
+            times = []
+            for _ in range(6):
+                t0 = time.perf_counter()
+                server.predict(row)
+                times.append(time.perf_counter() - t0)
+            assert fam.summarize()["count"] == count0 + 6
+            steady = sorted(times[1:])[len(times[1:]) // 2]
+            # Warmed: the first request pays no XLA compile, so it sits
+            # within 2x of steady state (floor absorbs scheduler noise on
+            # sub-millisecond CPU batches).
+            assert times[0] <= max(2.0 * steady, 0.25)
+        finally:
+            server.stop()

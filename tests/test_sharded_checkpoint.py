@@ -94,6 +94,22 @@ class TestArrayStore:
         assert len(chunks) == 1
         assert chunks[0][0] == ((0, 6),)
 
+    def test_chunks_are_owned_copies_not_device_views(self):
+        """Chunk data must not alias the XLA buffer: the async writer reads
+        it after training has resumed, and the train step donates (reuses)
+        its input buffers — an aliased view would silently capture a LATER
+        step's values in the checkpoint."""
+        mesh = mesh_mod.create_mesh((4, 2), ("data", "model"))
+        for x in (jax.numpy.arange(12.0),
+                  jax.device_put(np.arange(8 * 4, dtype=np.float64)
+                                 .reshape(8, 4),
+                                 NamedSharding(mesh, P(None, "model")))):
+            for _, data in array_store.leaf_chunks(x):
+                assert data.base is None or isinstance(data.base, np.ndarray)
+                assert not any(
+                    np.shares_memory(data, np.asarray(sh.data))
+                    for sh in x.addressable_shards)
+
 
 class TestAtomicCommitAndCorruption:
     def _committed(self, tmp_path, steps=(5, 10)):

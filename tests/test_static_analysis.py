@@ -345,12 +345,71 @@ def stage(x):
         assert [f for f in lint_file(str(p)) if f.rule == "JX006"] == []
 
 
+class TestJX007AotOutsideCompilation:
+    def test_lower_compile_chain_fires(self):
+        src = """
+import jax
+
+def precompile(fn, x):
+    return fn.lower(x).compile()
+"""
+        fs = lint(src, ["JX007"])
+        assert len(fs) == 2  # the .lower(x) call and the .compile() call
+        assert any(".lower" in f.message for f in fs)
+        assert any(".compile" in f.message for f in fs)
+
+    def test_jax_export_and_serialize_import_fire(self):
+        src = """
+import jax
+from jax.experimental import serialize_executable
+
+def ship(fn, x):
+    return jax.export.export(jax.jit(fn))(x)
+"""
+        fs = lint(src, ["JX007"])
+        assert any("serialize_executable" in f.message for f in fs)
+        assert any("jax.export" in f.message for f in fs)
+
+    def test_str_lower_and_re_compile_are_clean(self):
+        src = """
+import re
+
+def normalize(name):
+    return re.compile(r"\\s+").sub("-", name.lower())
+"""
+        assert lint(src, ["JX007"]) == []
+
+    def test_compilation_package_is_allowed(self, tmp_path):
+        src = """
+def precompile(fn, x):
+    return fn.lower(x).compile()
+"""
+        d = tmp_path / "compilation"
+        d.mkdir(parents=True)
+        p = d / "program.py"
+        p.write_text(src)
+        from deeplearning4j_tpu.analysis import lint_file
+        assert [f for f in lint_file(str(p)) if f.rule == "JX007"] == []
+
+    def test_profiler_probe_is_allowed(self, tmp_path):
+        src = """
+def probe(fn, x):
+    return fn.lower(x).compile().cost_analysis()
+"""
+        d = tmp_path / "observability"
+        d.mkdir(parents=True)
+        p = d / "profiler.py"
+        p.write_text(src)
+        from deeplearning4j_tpu.analysis import lint_file
+        assert [f for f in lint_file(str(p)) if f.rule == "JX007"] == []
+
+
 # ------------------------------------------------------------ framework
 
 class TestLinterFramework:
-    def test_registry_has_all_six_rules(self):
+    def test_registry_has_all_rules(self):
         assert set(ALL_RULES) >= {"JX001", "JX002", "JX003", "JX004",
-                                  "JX005", "JX006"}
+                                  "JX005", "JX006", "JX007"}
 
     def test_findings_are_typed_and_sorted(self):
         src = """
