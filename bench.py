@@ -656,6 +656,120 @@ def bench_transformer(steps, warmup):
     return e
 
 
+def bench_serving_slo(steps, warmup):
+    """Serving-tier SLO config: continuous-batching generation throughput
+    (tokens/sec) vs the drain-then-refill control arm on the SAME model
+    and request trace, plus TTFT p50/p99 per arm and predict-path request
+    latency p50/p99 through the shape-bucket batcher. No BASELINE row
+    (the reference never had a serving tier); anchors at its first
+    record."""
+    import threading
+
+    from deeplearning4j_tpu import observability as obs
+    from deeplearning4j_tpu.models.zoo import transformer_lm
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.serving import InferenceServer
+
+    V = 256
+    cap = int(os.environ.get("BENCH_SERVING_CACHE", "128"))
+    slots = int(os.environ.get("BENCH_SERVING_SLOTS", "4"))
+    n_req = max(12, steps)
+    gen_cap = int(os.environ.get("BENCH_SERVING_GEN_STEPS", "64"))
+    rng = np.random.RandomState(0)
+    prompts = [list(rng.randint(1, V, rng.randint(4, 17)))
+               for _ in range(n_req)]
+    # Varying generation lengths are what continuous batching exploits:
+    # short sequences free their slot mid-flight; drain mode idles those
+    # slots until the longest sequence in the batch finishes.
+    lengths = [4 + (i * 13) % gen_cap for i in range(n_req)]
+
+    def run_arm(mode, name):
+        cg = ComputationGraph(transformer_lm(
+            vocab_size=V, t=64, d_model=64, n_heads=4, n_blocks=2,
+            decode_cache_length=cap)).init()
+        server = InferenceServer(cg, default_model=name, decode_slots=slots,
+                                 scheduler_mode=mode, max_batch_size=8,
+                                 max_delay_ms=1.0,
+                                 generate_queue_depth=max(64, n_req))
+        # Compile every prefill bucket + the decode step outside the
+        # timed window (production pays this once, at startup).
+        server.models.get(name).scheduler.warmup()
+        generated, errors = [], []
+
+        def client(i):
+            try:
+                out = server.generate(prompts[i], lengths[i],
+                                      temperature=1.0, seed=i)
+                generated.append(len(out) - len(prompts[i]))
+            except Exception as e:
+                errors.append(f"{type(e).__name__}: {e}")
+
+        threads = []
+        t0 = time.perf_counter()
+        for i in range(n_req):
+            th = threading.Thread(target=client, args=(i,))
+            th.start()
+            threads.append(th)
+            time.sleep(0.002)  # staggered arrivals: mid-flight admission
+        for th in threads:
+            th.join()
+        dt = time.perf_counter() - t0
+        server.stop()
+        if errors:
+            raise RuntimeError(f"serving bench arm {mode}: {errors[:3]}")
+        ttft = obs.metrics.get_family("dl4j_serving_ttft_seconds").labels(
+            model=name).summarize(quantiles=(0.5, 0.99))
+        return sum(generated) / dt, ttft
+
+    cont_tps, cont_ttft = run_arm("continuous", "slo_cont")
+    drain_tps, drain_ttft = run_arm("drain", "slo_drain")
+
+    head = _entry("serving_continuous_tokens_per_sec", cont_tps,
+                  "tokens/sec")
+    head["continuous_vs_drain"] = round(cont_tps / max(drain_tps, 1e-9), 2)
+    head["ttft_p50_ms"] = round(cont_ttft.get("p50", 0.0) * 1e3, 1)
+    head["ttft_p99_ms"] = round(cont_ttft.get("p99", 0.0) * 1e3, 1)
+    drain = _entry("serving_drain_tokens_per_sec", drain_tps, "tokens/sec")
+    drain["ttft_p50_ms"] = round(drain_ttft.get("p50", 0.0) * 1e3, 1)
+    drain["ttft_p99_ms"] = round(drain_ttft.get("p99", 0.0) * 1e3, 1)
+
+    # Predict-path SLO through the shape-bucket batcher: concurrent
+    # mixed-size requests, per-model latency histogram -> p50/p99.
+    cg = ComputationGraph(transformer_lm(
+        vocab_size=V, t=64, d_model=64, n_heads=4, n_blocks=2,
+        decode_cache_length=cap)).init()
+    server = InferenceServer(cg, default_model="slo_predict",
+                             max_batch_size=8, max_delay_ms=1.0)
+    server.models.get("slo_predict").batcher.warm()
+    perr = []
+
+    prng = np.random.RandomState(1)
+    rows = prng.randint(1, V, (max(16, steps), 8)).astype(np.int32)
+
+    def pclient(i):
+        try:
+            server.predict(np.tile(rows[i], (1 + i % 3, 1)))
+        except Exception as e:
+            perr.append(f"{type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=pclient, args=(i,))
+               for i in range(max(16, steps))]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    server.stop()
+    if perr:
+        raise RuntimeError(f"serving bench predict arm: {perr[:3]}")
+    lat = obs.metrics.get_family("dl4j_serving_request_seconds").labels(
+        model="slo_predict", route="predict").summarize(
+            quantiles=(0.5, 0.99))
+    pe = _entry("serving_predict_p99_ms", lat.get("p99", 0.0) * 1e3, "ms")
+    pe["p50_ms"] = round(lat.get("p50", 0.0) * 1e3, 2)
+    pe["requests"] = int(lat.get("count", 0))
+    return [head, drain, pe]
+
+
 def bench_resnet50(steps, warmup):
     import ml_dtypes
 
@@ -739,7 +853,7 @@ def main():
     configs = os.environ.get(
         "BENCH_CONFIGS",
         "resnet50,lenet,char_rnn,lenet_step,lenet_superstep,lenet_cold_warm,"
-        "word2vec,vgg16,flash_attn,flash_tri,transformer"
+        "word2vec,vgg16,flash_attn,flash_tri,transformer,serving_slo"
     ).split(",")
 
     head, extra = None, {}
@@ -783,6 +897,9 @@ def main():
     if "transformer" in configs:
         e = bench_transformer(steps, warmup)
         extra[e["metric"]] = e
+    if "serving_slo" in configs:
+        for e in bench_serving_slo(steps, warmup):
+            extra[e["metric"]] = e
     if head is None:  # resnet50 excluded: promote the first extra metric
         if not extra:
             _emit({

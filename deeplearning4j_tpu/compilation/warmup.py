@@ -80,10 +80,13 @@ def _label_shape(net, batch: int) -> Optional[Tuple[int, ...]]:
 
 
 def synthetic_dataset(net, batch_size: int,
-                      shape: Optional[Sequence[int]] = None):
+                      shape: Optional[Sequence[int]] = None,
+                      dtype=np.float32):
     """A zeros DataSet matching the model's declared input (and, when the
     output layer declares `n_out`, labels) — enough to warm every default
-    program kind."""
+    program kind. `dtype` must match what live traffic will send: an
+    int32-ids model warmed with float32 features is a DIFFERENT compiled
+    program, and the warmup buys nothing."""
     from deeplearning4j_tpu.datasets.dataset import DataSet
 
     fshape = tuple(shape) if shape else infer_feature_shape(net)
@@ -92,10 +95,36 @@ def synthetic_dataset(net, batch_size: int,
             "cannot infer the model's input shape (no set_input_type on "
             "the config and no first-layer n_in); pass an example batch "
             "or an explicit shape")
-    x = np.zeros((batch_size,) + fshape, np.float32)
+    x = np.zeros((batch_size,) + fshape, dtype)
     lshape = _label_shape(net, batch_size)
     y = None if lshape is None else np.zeros(lshape, np.float32)
     return DataSet(x, y)
+
+
+def warmup_buckets(net, batch_sizes: Sequence[int],
+                   shape: Optional[Sequence[int]] = None,
+                   dtype=np.float32) -> Dict[int, Dict[str, Any]]:
+    """Bucket-ladder warmup for the serving tier: warm the inference
+    program (`output`, train=False — the exact static signature
+    `net.output` dispatches) at EVERY padded batch-size bucket, so no
+    admitted request shape ever triggers an XLA compile. Features-only —
+    parameters, optimizer state and RNG are untouched. Returns
+    `{bucket: warmup summary}`."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+
+    fshape = tuple(shape) if shape else infer_feature_shape(net)
+    if fshape is None:
+        raise ValueError(
+            "cannot infer the model's input shape for bucket warmup; pass "
+            "shape=(...)")
+    is_graph = type(net).__name__ == "ComputationGraph"
+    out: Dict[int, Dict[str, Any]] = {}
+    for b in sorted({int(b) for b in batch_sizes}):
+        x = np.zeros((b,) + fshape, dtype)
+        ds = (MultiDataSet(features=[x], labels=None) if is_graph
+              else DataSet(x, None))
+        out[b] = warmup_net(net, ds, kinds=("output",))
+    return out
 
 
 # ----------------------------------------------------------- program args

@@ -443,3 +443,156 @@ def generate_lm_batch(cg, prompts, n_steps: int, *, temperature: float = 1.0,
         step_out = cg.rnn_time_step(
             nxt.astype(np.float32)[:, None, None])[0]  # [B, 1, V]
     return np.concatenate(out, axis=1)
+
+
+def decode_cache_capacity(cg) -> int:
+    """Smallest `decode_cache_length` across the graph's attention layers —
+    the hard per-sequence step budget. Raises when the model was built
+    without a KV cache."""
+    caps = [v.layer.decode_cache_length
+            for v in cg.layer_vertices.values()
+            if type(v.layer).__name__ == "SelfAttentionLayer"]
+    if not caps or any(c is None for c in caps):
+        raise ValueError(
+            "model has no KV cache; build it with "
+            "transformer_lm(..., decode_cache_length=N)")
+    return min(caps)
+
+
+class DecodeStepper:
+    """Step-granular decode entry point for a `transformer_lm` graph — the
+    seam the serving tier's continuous-batching scheduler drives.
+
+    `generate_lm_batch` advances B sequences in lockstep from prompt to
+    finish: a new request must wait for the whole batch to drain. This
+    class instead owns a fixed-width batch of `slots` whose per-slot KV
+    caches and cursors live in ONE batched rnn-state overlay ([slots]
+    int32 cursor vectors — the vector-`kv_pos` path in
+    `nn/layers/attention.py` / `nn/layers/feedforward.py`), so sequences
+    at DIFFERENT depths decode in the same single dispatch and a finished
+    slot is recycled at the next step boundary:
+
+    - `prefill(ids, pad_to)` runs one prompt through a fresh batch-1
+      forward (right-padded to `pad_to`, a warmable shape bucket) and
+      returns the next-token distribution plus the slot's primed cache;
+    - `install(slot, slot_state, length)` scatters that cache into the
+      batched overlay;
+    - `step(tokens)` advances ALL slots one token in one jitted dispatch
+      ([slots, V] distributions out); free slots ride along on a dummy
+      token and are masked by their own cursors;
+    - `clear(slot)` retires a sequence (cursor back to 0; its stale cache
+      rows are never attended and are overwritten by the next occupant).
+
+    Both entry points go through `cg._get_jit`, so every shape is served
+    from (and warmed into) the AOT executable store like any other
+    program.
+    """
+
+    def __init__(self, cg, slots: int):
+        import jax
+
+        if slots < 1:
+            raise ValueError("need at least one decode slot")
+        self.cg = cg
+        self.slots = int(slots)
+        self.capacity = decode_cache_capacity(cg)
+        self._declared = cg._declared_state()
+        self._state = None  # batched rnn overlay; allocated on first install
+        self._rng0 = jax.random.PRNGKey(0)
+
+    # -- prompt path ------------------------------------------------------
+
+    def prefill(self, ids, pad_to: int = None):
+        """Prime one sequence from scratch. `ids` is a 1-D int prompt;
+        `pad_to` right-pads the forward to a bucketed length (causal
+        attention: the distribution at the last REAL position never sees
+        the pad tail, and the tail's stale cache rows sit beyond the
+        rewound cursor, masked until overwritten). Returns
+        `(probs [V], slot_state, length)`."""
+        import numpy as np
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn import rnn_state as rnn_mod
+
+        ids = [int(i) for i in ids]
+        n = len(ids)
+        if not n:
+            raise ValueError("need at least one prompt token")
+        pad_to = int(pad_to or n)
+        if pad_to < n:
+            raise ValueError(f"pad_to ({pad_to}) < prompt length ({n})")
+        if pad_to > self.capacity:
+            raise ValueError(
+                f"prompt bucket {pad_to} exceeds decode cache capacity "
+                f"{self.capacity}")
+        x = np.zeros((1, pad_to, 1), np.float32)
+        x[0, :n, 0] = ids
+        fn = self.cg._get_jit("output", train=False, keep_rnn_state=True)
+        outs, new_state = fn(self.cg.params_tree, self.cg.state,
+                             [jnp.asarray(x)], None, self._rng0)
+        rnn = rnn_mod.split_rnn_state(new_state, self._declared)
+        # Rewind every cursor from pad_to to the real length.
+        rnn = {layer: {k: (jnp.int32(n) if jnp.ndim(v) == 0 else v)
+                       for k, v in s.items()}
+               for layer, s in rnn.items()}
+        probs = np.asarray(outs[0])[0, n - 1]
+        return probs, rnn, n
+
+    # -- slot management --------------------------------------------------
+
+    def _alloc(self, template):
+        import jax.numpy as jnp
+
+        self._state = {
+            layer: {k: jnp.zeros((self.slots,), jnp.int32)
+                    if jnp.ndim(v) == 0
+                    else jnp.zeros((self.slots,) + v.shape[1:], v.dtype)
+                    for k, v in s.items()}
+            for layer, s in template.items()
+        }
+
+    def install(self, slot: int, slot_state, length: int):
+        """Scatter a primed batch-1 cache into the batched overlay."""
+        import jax.numpy as jnp
+
+        if self._state is None:
+            self._alloc(slot_state)
+        for layer, s in slot_state.items():
+            dst = self._state[layer]
+            for k, v in s.items():
+                if jnp.ndim(v) == 0:
+                    dst[k] = dst[k].at[slot].set(jnp.int32(length))
+                else:
+                    dst[k] = dst[k].at[slot].set(v[0])
+
+    def clear(self, slot: int):
+        """Retire a slot: cursor to 0 so the next occupant's writes start
+        at row 0 and stale rows are never visible."""
+        import jax.numpy as jnp
+
+        if self._state is None:
+            return
+        for s in self._state.values():
+            for k, v in s.items():
+                if v.ndim == 1 and jnp.issubdtype(v.dtype, jnp.integer):
+                    s[k] = v.at[slot].set(0)
+
+    # -- decode path ------------------------------------------------------
+
+    def step(self, tokens):
+        """Advance every slot one token. `tokens` is [slots] ints (free
+        slots take any dummy value). Returns [slots, V] next-token
+        distributions."""
+        import numpy as np
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn import rnn_state as rnn_mod
+
+        if self._state is None:
+            raise RuntimeError("no sequence installed; call prefill/install")
+        x = np.asarray(tokens, np.float32).reshape(self.slots, 1, 1)
+        fn = self.cg._get_jit("output", train=False, keep_rnn_state=True)
+        state = rnn_mod.merge_rnn_state(self.cg.state, self._state)
+        outs, new_state = fn(self.cg.params_tree, state,
+                             [jnp.asarray(x)], None, self._rng0)
+        self._state = rnn_mod.split_rnn_state(new_state, self._declared)
+        out = np.asarray(outs[0])
+        return out[:, -1] if out.ndim == 3 else out

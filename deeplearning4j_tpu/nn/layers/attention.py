@@ -56,7 +56,11 @@ def _masked_dense_attention(q, k, v, mask, causal, scale):
 def _cached_decode_attention(q, kc, vc, pos, causal):
     """Decode-step attention against a fixed-size KV cache. q: [B, T, H, D]
     (the NEW positions, globally at [pos, pos+T)); kc/vc: [B, L, H, D] with
-    valid keys in [0, pos+T). Causal: query i sees keys <= pos+i."""
+    valid keys in [0, pos+T). Causal: query i sees keys <= pos+i.
+
+    `pos` is either a scalar cursor (every row at the same position — the
+    single-sequence decode path) or a [B] vector of per-row cursors (the
+    continuous-batching scheduler, where each slot is at its own depth)."""
     B, T, H, D = q.shape
     L = kc.shape[1]
     acc = jnp.promote_types(q.dtype, jnp.float32)
@@ -65,11 +69,12 @@ def _cached_decode_attention(q, kc, vc, pos, causal):
     vt = jnp.swapaxes(vc, 1, 2).astype(acc)
     s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt)
     kpos = jnp.arange(L)
+    pos_b = jnp.reshape(pos, (-1, 1))            # [1,1] scalar / [B,1] vector
     if causal:
-        limit = pos + 1 + jnp.arange(T)          # query i sees < pos+i+1
+        limit = pos_b + 1 + jnp.arange(T)[None, :]  # query i sees < pos+i+1
     else:
-        limit = jnp.full((T,), pos + T)
-    s = jnp.where(kpos[None, None, None, :] < limit[None, None, :, None],
+        limit = jnp.broadcast_to(pos_b + T, (pos_b.shape[0], T))
+    s = jnp.where(kpos[None, None, None, :] < limit[:, None, :, None],
                   s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bhqk,bhkd->bhqd", p, vt)
@@ -122,10 +127,17 @@ def self_attention_apply(conf, params, state, x, *, rng=None, train=False,
         # cursor, attend against the valid prefix.
         pos = state["kv_pos"]
         zero = jnp.zeros((), jnp.int32)
-        kc = jax.lax.dynamic_update_slice(state["k_cache"], k,
-                                          (zero, pos, zero, zero))
-        vc = jax.lax.dynamic_update_slice(state["v_cache"], v,
-                                          (zero, pos, zero, zero))
+        if jnp.ndim(pos):
+            # Per-slot cursors ([B] int32): each row lands at its own depth.
+            upd = jax.vmap(lambda c, u, p: jax.lax.dynamic_update_slice(
+                c, u, (p, zero, zero)))
+            kc = upd(state["k_cache"], k, pos)
+            vc = upd(state["v_cache"], v, pos)
+        else:
+            kc = jax.lax.dynamic_update_slice(state["k_cache"], k,
+                                              (zero, pos, zero, zero))
+            vc = jax.lax.dynamic_update_slice(state["v_cache"], v,
+                                              (zero, pos, zero, zero))
         o = _cached_decode_attention(q, kc, vc, pos, conf.causal)
         out = o.reshape(B, T, conf.n_out) @ params["Wo"] + params["oB"]
         out = activations.resolve(conf.activation)(out)

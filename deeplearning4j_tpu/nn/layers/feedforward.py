@@ -175,6 +175,12 @@ def positional_embedding_apply(conf, params, state, x, *, rng=None,
     if not getattr(conf, "stateful", False):
         return x + params["P"][:T], state, mask
     start = state.get("pos", jnp.int32(0))
-    rows = jax.lax.dynamic_slice(
-        params["P"], (start, jnp.int32(0)), (T, params["P"].shape[1]))
+    if jnp.ndim(start):
+        # Per-slot cursors ([B] int32): gather each row's own position rows.
+        idx = jnp.clip(start[:, None] + jnp.arange(T)[None, :],
+                       0, conf.max_length - 1)
+        rows = params["P"][idx]                  # [B, T, F]
+    else:
+        rows = jax.lax.dynamic_slice(
+            params["P"], (start, jnp.int32(0)), (T, params["P"].shape[1]))
     return x + rows, {"pos": start + jnp.int32(T)}, mask

@@ -37,7 +37,7 @@ __all__ = [
     "metrics", "tracer", "config", "StepProfiler", "MetricsRegistry",
     "Tracer", "DEFAULT_BUCKETS", "enable", "disable", "iteration_span",
     "host_nbytes", "install_jax_compile_hook", "bench_snapshot",
-    "chip_peak_flops", "estimate_step_flops",
+    "prometheus_payload", "chip_peak_flops", "estimate_step_flops",
 ]
 
 OBS_ENABLED = os.environ.get("DL4J_TPU_OBS", "1").lower() not in (
@@ -215,6 +215,23 @@ def install_jax_compile_hook(registry: Optional[MetricsRegistry] = None) -> bool
         return True
 
 
+# ------------------------------------------------------------- exposition
+
+
+def prometheus_payload(fmt: str = "prometheus",
+                       registry: Optional[MetricsRegistry] = None):
+    """One scrape body for every HTTP surface (`UIServer` and the serving
+    tier both mount `GET /metrics` on this): returns `(body_bytes,
+    content_type)`. `fmt="json"` serves the structured snapshot instead of
+    Prometheus text 0.0.4."""
+    import json
+
+    reg = registry or metrics
+    if fmt == "json":
+        return (json.dumps(reg.to_json()).encode(), "application/json")
+    return (reg.to_prometheus().encode(), "text/plain; version=0.0.4")
+
+
 # ------------------------------------------------------------ bench glue
 
 
@@ -237,7 +254,8 @@ def bench_snapshot(registry: Optional[MetricsRegistry] = None) -> Dict[str, Any]
 
     for hist in ("dl4j_step_latency_seconds", "dl4j_step_dispatch_seconds",
                  "dl4j_infer_latency_seconds", "dl4j_request_latency_seconds",
-                 "dl4j_compile_seconds"):
+                 "dl4j_serving_request_seconds", "dl4j_serving_ttft_seconds",
+                 "dl4j_serving_decode_step_seconds", "dl4j_compile_seconds"):
         fam = reg.get_family(hist)
         if fam is None:
             continue
@@ -250,6 +268,9 @@ def bench_snapshot(registry: Optional[MetricsRegistry] = None) -> Dict[str, Any]
     for name in ("dl4j_xla_compiles_total", "dl4j_xla_compile_seconds_total",
                  "dl4j_compile_cache_hits_total",
                  "dl4j_compile_cache_misses_total",
+                 "dl4j_requests_total",
+                 "dl4j_serving_generated_tokens_total",
+                 "dl4j_serving_evictions_total",
                  "dl4j_jit_cache_hits_total", "dl4j_jit_cache_misses_total",
                  "dl4j_host_to_device_bytes_total",
                  "dl4j_checkpoint_bytes_written_total",

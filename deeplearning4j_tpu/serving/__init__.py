@@ -1,0 +1,61 @@
+"""Production serving tier.
+
+What was one module (`deeplearning4j_tpu/serving.py`: one model, one
+unbounded queue, one fixed padded batch shape) is now a package:
+
+- `batcher`   — shape-bucket batching with bounded admission, deadlines
+                and cancellation (`ShapeBucketBatcher`);
+- `scheduler` — continuous-batching LM generation over per-slot KV-cache
+                cursors (`GenerationScheduler`);
+- `host`      — multi-model hosting with HBM budgets + LRU eviction
+                (`ModelHost`);
+- `server`    — the `InferenceServer` facade and the back-compat predict
+                path;
+- `http`      — the route handlers;
+- `errors`    — typed failures with their HTTP statuses;
+- `metrics`   — the SLO instrument families.
+
+`from deeplearning4j_tpu.serving import InferenceServer` and
+`InferenceServer.from_checkpoint(...)` are unchanged from the module era.
+"""
+
+from deeplearning4j_tpu.serving.batcher import (
+    ShapeBucketBatcher,
+    bucket_ladder,
+    canonicalize_features,
+    expected_input_kind,
+)
+from deeplearning4j_tpu.serving.errors import (
+    InputValidationError,
+    ModelNotFoundError,
+    ModelNotReadyError,
+    RequestTimeoutError,
+    ServerOverloadedError,
+    ServingError,
+)
+from deeplearning4j_tpu.serving.host import ModelHost, ServedModel
+from deeplearning4j_tpu.serving.scheduler import (
+    GenerationRequest,
+    GenerationScheduler,
+    prompt_bucket_ladder,
+)
+from deeplearning4j_tpu.serving.server import InferenceServer
+
+__all__ = [
+    "InferenceServer",
+    "ShapeBucketBatcher",
+    "GenerationScheduler",
+    "GenerationRequest",
+    "ModelHost",
+    "ServedModel",
+    "ServingError",
+    "InputValidationError",
+    "ModelNotFoundError",
+    "ModelNotReadyError",
+    "ServerOverloadedError",
+    "RequestTimeoutError",
+    "bucket_ladder",
+    "prompt_bucket_ladder",
+    "canonicalize_features",
+    "expected_input_kind",
+]

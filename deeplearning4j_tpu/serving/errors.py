@@ -1,0 +1,51 @@
+"""Typed serving errors: every failure mode the tier can hand a caller
+maps to exactly one HTTP status, so the handler layer is a table lookup
+and overload/timeout/validation can NEVER surface as a 500 traceback."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class ServingError(Exception):
+    """Base: `status` is the HTTP code; `retry_after` (seconds) adds a
+    `Retry-After` header when set (503 load shedding / warming)."""
+
+    status = 500
+    retry_after: Optional[int] = None
+
+    def payload(self) -> dict:
+        return {"error": str(self)}
+
+
+class InputValidationError(ServingError):
+    """Request payload rejected before touching the device (bad dtype,
+    non-numeric data, shape that can't batch)."""
+
+    status = 400
+
+
+class ModelNotFoundError(ServingError):
+    status = 404
+
+
+class ModelNotReadyError(ServingError):
+    """Model still warming (or reloading after eviction): callers retry
+    instead of stalling behind an XLA compile."""
+
+    status = 503
+    retry_after = 1
+
+
+class ServerOverloadedError(ServingError):
+    """Bounded queue full — load is shed, never buffered without bound."""
+
+    status = 503
+    retry_after = 1
+
+
+class RequestTimeoutError(ServingError, TimeoutError):
+    """Deadline expired (in queue or waiting for a batch). Subclasses
+    TimeoutError so pre-package callers catching TimeoutError still work."""
+
+    status = 504

@@ -1,0 +1,221 @@
+"""Multi-model hosting with HBM budgets and LRU eviction.
+
+One serving process fronts several models. Each entry tracks an estimated
+device-resident footprint — the summed `nbytes` of its param/state leaves
+once loaded, or the checkpoint COMMIT manifest's file sizes before the
+first load (no array data read). When the sum of resident footprints
+exceeds `hbm_budget_bytes`, the host evicts the least-recently-USED
+unpinned model: its batcher/scheduler stop, the engine reference drops
+(freeing device buffers), and the entry stays registered so the next
+request triggers a reload (503 `Retry-After` while it happens, never a
+silent stall). Models constructed from a live net (no path) are pinned —
+there is nothing to reload them from.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from deeplearning4j_tpu.serving import metrics as _m
+from deeplearning4j_tpu.serving.errors import ModelNotFoundError
+
+
+def estimate_hbm_bytes(net) -> int:
+    """Summed `nbytes` over the engine's param + state leaves (the arrays
+    actually resident on device once the model serves)."""
+    import jax
+
+    total = 0
+    for attr in ("params_tree", "state"):
+        tree = getattr(net, attr, None)
+        if tree is not None:
+            total += sum(int(getattr(leaf, "nbytes", 0))
+                         for leaf in jax.tree_util.tree_leaves(tree))
+    return total
+
+
+def estimate_checkpoint_bytes(path) -> int:
+    """Footprint estimate WITHOUT loading: the COMMIT manifest's summed
+    file sizes (sharded store), the latest committed step under a manager
+    root, or the ZIP size for a legacy checkpoint. 0 when unreadable —
+    the estimate firms up to leaf nbytes after the first load."""
+    from deeplearning4j_tpu.checkpoint import store
+
+    path = str(path)
+    try:
+        if os.path.isdir(path):
+            if not store.is_sharded_checkpoint(path):
+                from deeplearning4j_tpu.checkpoint.manager import (
+                    CheckpointManager,
+                )
+
+                latest = CheckpointManager(path).latest_path()
+                if latest is None:
+                    return 0
+                path = latest
+            commit = store.verify_checkpoint(path)
+            return sum(int(s) for s in commit.get("files", {}).values())
+        return int(os.path.getsize(path))
+    except Exception:
+        return 0
+
+
+class ServedModel:
+    """One hosted model: the engine plus its serving runtime (batcher and,
+    for LMs, the generation scheduler), readiness, and LRU bookkeeping."""
+
+    def __init__(self, name: str, net=None, path=None, pinned=False,
+                 options: Optional[dict] = None):
+        self.name = name
+        self.net = net
+        self.path = None if path is None else str(path)
+        self.pinned = bool(pinned)
+        self.options = dict(options or {})
+        self.batcher = None
+        self.scheduler = None
+        self.ready = threading.Event()
+        self.last_used = time.monotonic()
+        self.hbm_bytes = (estimate_hbm_bytes(net) if net is not None
+                          else estimate_checkpoint_bytes(path)
+                          if path is not None else 0)
+
+    @property
+    def resident(self) -> bool:
+        return self.net is not None
+
+    def touch(self) -> None:
+        self.last_used = time.monotonic()
+
+
+class ModelHost:
+    """Registry + admission point for every hosted model. All structural
+    mutation (add / load / evict) happens under one lock; the hot path
+    (`get` on a resident model) only touches the LRU stamp."""
+
+    def __init__(self, hbm_budget_bytes: Optional[int] = None,
+                 on_load: Optional[Callable[[ServedModel], None]] = None,
+                 on_evict: Optional[Callable[[ServedModel], None]] = None):
+        self.hbm_budget_bytes = hbm_budget_bytes
+        self.on_load = on_load      # server attaches batcher/scheduler here
+        self.on_evict = on_evict
+        self._lock = threading.RLock()
+        self._models: Dict[str, ServedModel] = {}
+        _m.MODELS_RESIDENT.set_function(
+            lambda: sum(1 for m in self._models.values() if m.resident))
+
+    # ----------------------------------------------------------- registry
+
+    def add(self, name: str, net=None, path=None, pinned=None,
+            **options) -> ServedModel:
+        if net is None and path is None:
+            raise ValueError("add() needs a live net or a checkpoint path")
+        if pinned is None:
+            pinned = path is None  # nothing to reload a net-only model from
+        model = ServedModel(name, net=net, path=path, pinned=pinned,
+                            options=options)
+        with self._lock:
+            if name in self._models:
+                raise ValueError(f"model {name!r} is already hosted")
+            self._models[name] = model
+            _m.MODEL_HBM_BYTES.labels(model=name).set(model.hbm_bytes)
+            if model.net is not None and self.on_load is not None:
+                self.on_load(model)
+            self._enforce_budget(keep=model)
+        return model
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._models)
+
+    def get(self, name: str) -> ServedModel:
+        """Resolve a model for a request: touches the LRU stamp and
+        reloads an evicted entry (synchronously, under the lock — callers
+        that must not block should check `.resident` first)."""
+        model = self._models.get(name)
+        if model is None:
+            raise ModelNotFoundError(f"no model named {name!r}; hosted: "
+                                     f"{self.names() or '(none)'}")
+        model.touch()
+        if not model.resident:
+            self._reload(model)
+        return model
+
+    # ----------------------------------------------------- budget/evict
+
+    def _reload(self, model: ServedModel) -> None:
+        from deeplearning4j_tpu.checkpoint.legacy import load_any
+
+        with self._lock:
+            if model.resident:
+                return
+            model.ready.clear()
+            net = load_any(model.path)
+            model.net = net
+            model.hbm_bytes = estimate_hbm_bytes(net)
+            _m.MODEL_HBM_BYTES.labels(model=model.name).set(model.hbm_bytes)
+            if self.on_load is not None:
+                self.on_load(model)
+            self._enforce_budget(keep=model)
+
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return sum(m.hbm_bytes for m in self._models.values()
+                       if m.resident)
+
+    def _enforce_budget(self, keep: Optional[ServedModel] = None) -> None:
+        """Evict LRU unpinned resident models until under budget. `keep`
+        (the model just loaded) is never evicted — a budget smaller than
+        one model still serves that model."""
+        if self.hbm_budget_bytes is None:
+            return
+        while True:
+            victims = [m for m in self._models.values()
+                       if m.resident and not m.pinned and m is not keep]
+            if (sum(m.hbm_bytes for m in self._models.values()
+                    if m.resident) <= self.hbm_budget_bytes or not victims):
+                return
+            self._evict(min(victims, key=lambda m: m.last_used))
+
+    def _evict(self, model: ServedModel) -> None:
+        model.ready.clear()
+        if model.batcher is not None:
+            model.batcher.stop()
+            model.batcher = None
+        if model.scheduler is not None:
+            model.scheduler.stop()
+            model.scheduler = None
+        if self.on_evict is not None:
+            self.on_evict(model)
+        model.net = None  # drop the device buffers
+        _m.MODEL_HBM_BYTES.labels(model=model.name).set(0)
+        _m.EVICTIONS.labels(model=model.name).inc()
+        model.hbm_bytes = (estimate_checkpoint_bytes(model.path)
+                           if model.path else 0)
+
+    # ---------------------------------------------------------- introspect
+
+    def snapshot(self) -> List[dict]:
+        """`GET /v1/models` payload: one row per hosted model."""
+        with self._lock:
+            return [{
+                "name": m.name,
+                "status": ("ready" if m.ready.is_set()
+                           else "warming" if m.resident else "evicted"),
+                "resident": m.resident,
+                "pinned": m.pinned,
+                "hbm_bytes": int(m.hbm_bytes),
+                "path": m.path,
+                "lm": m.scheduler is not None,
+            } for m in self._models.values()]
+
+    def stop(self) -> None:
+        _m.MODELS_RESIDENT.set_function(None)
+        with self._lock:
+            for m in self._models.values():
+                if m.batcher is not None:
+                    m.batcher.stop()
+                if m.scheduler is not None:
+                    m.scheduler.stop()

@@ -1,0 +1,157 @@
+"""HTTP surface of the serving tier.
+
+Routes (all JSON):
+
+- `GET  /health`     liveness (+ hosted model names)
+- `GET  /healthz`    readiness: `{"status": "warming"|"ready", "models": …}`
+- `GET  /metrics`    Prometheus scrape (`?format=json` for the snapshot)
+- `GET  /v1/models`  per-model status / residency / HBM estimate
+- `POST /predict`    `{"data": [[...]], "model"?, "timeout_ms"?}`
+- `POST /generate`   `{"prompt_ids": [...], "n_steps": N, "temperature"?,
+                       "top_k"?, "top_p"?, "seed"?, "eos_id"?, "model"?,
+                       "timeout_ms"?}`
+
+Failure mapping is a table over the typed errors in `serving/errors.py`:
+the status comes off the exception class, `Retry-After` appears whenever
+the error carries one (load shedding, warming, eviction reload), plain
+`TimeoutError` is a 504, malformed payloads are a 400 — a traceback-500
+is reserved for genuinely unexpected failures."""
+
+from __future__ import annotations
+
+import json
+from http.server import BaseHTTPRequestHandler
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from deeplearning4j_tpu import observability as _obs
+from deeplearning4j_tpu.serving.errors import ServingError
+
+
+def make_handler(server):
+    """Build the request-handler class bound to one `InferenceServer`."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):
+            pass
+
+        def _json(self, obj, code=200, headers=None):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _error(self, e: Exception):
+            if isinstance(e, ServingError):
+                headers = ({"Retry-After": str(e.retry_after)}
+                           if e.retry_after is not None else None)
+                return self._json(e.payload(), e.status, headers=headers)
+            if isinstance(e, TimeoutError):
+                return self._json({"error": str(e)}, 504)
+            if isinstance(e, (KeyError, ValueError, json.JSONDecodeError)):
+                return self._json({"error": f"bad request: {e}"}, 400)
+            return self._json({"error": str(e)}, 500)
+
+        # ------------------------------------------------------------- GET
+
+        def do_GET(self):
+            url = urlparse(self.path)
+            if url.path == "/health":
+                try:
+                    model = type(server.net).__name__
+                except Exception:
+                    model = None
+                self._json({"status": "ok", "model": model,
+                            "models": server.models.names()})
+            elif url.path == "/healthz":
+                statuses = {row["name"]: row["status"]
+                            for row in server.models.snapshot()}
+                self._json({"status": server._status, "models": statuses})
+            elif url.path == "/metrics":
+                q = parse_qs(url.query)
+                fmt = (q.get("format") or ["prometheus"])[0]
+                body, ctype = _obs.prometheus_payload(fmt)
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif url.path == "/v1/models":
+                self._json({"models": server.models.snapshot()})
+            else:
+                self._json({"error": "not found",
+                            "routes": ["/health", "/healthz", "/metrics",
+                                       "/v1/models", "/predict",
+                                       "/generate"]}, 404)
+
+        # ------------------------------------------------------------ POST
+
+        def _payload(self) -> dict:
+            length = int(self.headers.get("Content-Length", 0))
+            return json.loads(self.rfile.read(length))
+
+        def _timeout_s(self, payload: dict) -> Optional[object]:
+            from deeplearning4j_tpu.serving.server import _UNSET
+
+            ms = payload.get("timeout_ms")
+            return _UNSET if ms is None else float(ms) / 1000.0
+
+        def _check_ready(self, name: Optional[str]) -> Optional[dict]:
+            """503 + Retry-After while the server (or the target model) is
+            warming: never park a caller behind an XLA compile."""
+            if server._status != "ready":
+                return {"error": "warming up", "status": server._status}
+            if name is not None:
+                model = server.models._models.get(name)
+                if (model is not None and model.resident
+                        and not model.ready.is_set()):
+                    return {"error": f"model {name!r} is warming",
+                            "status": "warming"}
+            return None
+
+        def do_POST(self):
+            if self.path == "/predict":
+                return self._post_predict()
+            if self.path == "/generate":
+                return self._post_generate()
+            return self._json({"error": "not found"}, 404)
+
+        def _post_predict(self):
+            try:
+                payload = self._payload()
+                name = payload.get("model")
+                warming = self._check_ready(name)
+                if warming is not None:
+                    return self._json(warming, 503,
+                                      headers={"Retry-After": "1"})
+                preds = server.predict(payload["data"], model=name,
+                                       timeout_s=self._timeout_s(payload))
+            except Exception as e:
+                return self._error(e)
+            self._json({"predictions": preds.tolist()})
+
+        def _post_generate(self):
+            try:
+                payload = self._payload()
+                name = payload.get("model")
+                warming = self._check_ready(name)
+                if warming is not None:
+                    return self._json(warming, 503,
+                                      headers={"Retry-After": "1"})
+                sampling = {k: payload[k] for k in
+                            ("temperature", "top_k", "top_p", "seed",
+                             "eos_id") if k in payload}
+                ids = server.generate(payload["prompt_ids"],
+                                      int(payload["n_steps"]),
+                                      model=name,
+                                      timeout_s=self._timeout_s(payload),
+                                      **sampling)
+            except Exception as e:
+                return self._error(e)
+            self._json({"ids": [int(t) for t in ids]})
+
+    return Handler

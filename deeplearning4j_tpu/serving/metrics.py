@@ -1,0 +1,76 @@
+"""Serving-tier instruments on the process-global registry.
+
+Two generations coexist deliberately:
+
+- the PR 5-era unlabeled families (`dl4j_serving_requests_total{outcome}`,
+  `dl4j_request_latency_seconds`, `dl4j_serving_batch_size`,
+  `dl4j_serving_queue_depth`) keep their names and shapes — dashboards and
+  the observability acceptance tests scrape them, and the registry
+  (correctly) refuses to re-register a family with different labels;
+- the SLO families below are labeled per model/route so a multi-model
+  host exposes p50/p99 request latency, TTFT, queue depth, and HBM
+  residency PER MODEL in one `GET /metrics` scrape.
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu import observability as _obs
+
+# ---------------------------------------------------------------- legacy
+REQUESTS_LEGACY = _obs.metrics.counter(
+    "dl4j_serving_requests_total", "predict() requests",
+    label_names=("outcome",))
+REQ_LATENCY = _obs.metrics.histogram(
+    "dl4j_request_latency_seconds",
+    "End-to-end predict() latency (queue wait + batch + forward)")
+BATCH_SIZE = _obs.metrics.histogram(
+    "dl4j_serving_batch_size",
+    "Real (pre-padding) rows per coalesced inference batch",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512))
+QUEUE_DEPTH = _obs.metrics.gauge(
+    "dl4j_serving_queue_depth",
+    "Requests waiting in the batcher queue (scrape-time)")
+
+# ------------------------------------------------------------------- SLO
+REQUESTS = _obs.metrics.counter(
+    "dl4j_requests_total",
+    "Serving requests by model, route and outcome (ok / timeout / shed / "
+    "invalid / error)",
+    label_names=("model", "route", "outcome"))
+REQUEST_SECONDS = _obs.metrics.histogram(
+    "dl4j_serving_request_seconds",
+    "Per-model end-to-end request latency (SLO histogram: p50/p99 via "
+    "bucket interpolation)",
+    label_names=("model", "route"))
+TTFT_SECONDS = _obs.metrics.histogram(
+    "dl4j_serving_ttft_seconds",
+    "Generation time-to-first-token: submit -> first sampled token",
+    label_names=("model",))
+DECODE_STEP_SECONDS = _obs.metrics.histogram(
+    "dl4j_serving_decode_step_seconds",
+    "One continuous-batching decode step (all slots, one dispatch)",
+    label_names=("model",))
+GENERATED_TOKENS = _obs.metrics.counter(
+    "dl4j_serving_generated_tokens_total",
+    "Tokens sampled by the generation scheduler",
+    label_names=("model",))
+MODEL_QUEUE_DEPTH = _obs.metrics.gauge(
+    "dl4j_serving_model_queue_depth",
+    "Queued requests per model and route (scrape-time)",
+    label_names=("model", "route"))
+MODEL_HBM_BYTES = _obs.metrics.gauge(
+    "dl4j_serving_model_hbm_bytes",
+    "Estimated device-resident bytes per hosted model (params + state; "
+    "checkpoint manifest size before load)",
+    label_names=("model",))
+MODELS_RESIDENT = _obs.metrics.gauge(
+    "dl4j_serving_models_resident",
+    "Hosted models currently resident (loaded) in this process")
+EVICTIONS = _obs.metrics.counter(
+    "dl4j_serving_evictions_total",
+    "LRU evictions of cold models from the multi-model host",
+    label_names=("model",))
+DECODE_SLOTS_BUSY = _obs.metrics.gauge(
+    "dl4j_serving_decode_slots_busy",
+    "Generation scheduler slots currently holding an active sequence",
+    label_names=("model",))

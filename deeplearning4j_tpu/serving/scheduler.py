@@ -1,0 +1,297 @@
+"""Continuous-batching generation scheduler for the LM serving path.
+
+`generate_lm_batch` advances B prompts in lockstep: a request arriving
+mid-flight waits for the WHOLE batch to drain (p99 TTFT = longest
+generation in front of you). This scheduler owns a `models.zoo.
+DecodeStepper` — a fixed-width slot batch with per-slot KV-cache cursors —
+and admits new sequences at STEP BOUNDARIES: a request waits only for the
+next single-token dispatch (+ its own prefill), and a slot is recycled the
+moment its sequence hits EOS / its token budget.
+
+Per-request sampling replays `generate_lm`'s exact draw sequence (one
+`np.random.RandomState(seed)` per request, `_sample_token` per token), so
+a continuously-batched generation is float-close to the sequential
+single-sequence path — the acceptance property `tests/test_serving_tier.py`
+pins down.
+
+`mode="drain"` disables mid-flight admission (refill only when every slot
+is free): the control arm `bench.py serving_slo` compares against.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.serving import metrics as _m
+from deeplearning4j_tpu.serving.errors import (
+    InputValidationError,
+    RequestTimeoutError,
+    ServerOverloadedError,
+)
+
+
+def prompt_bucket_ladder(capacity: int,
+                         buckets: Optional[Sequence[int]] = None):
+    """Prompt-length pad ladder: powers of two from 8 up to the decode
+    cache capacity (explicit `buckets` override, capped at capacity)."""
+    if buckets:
+        ladder = sorted({int(b) for b in buckets if 0 < int(b) <= capacity})
+        if not ladder:
+            raise ValueError(
+                f"prompt_buckets must contain a size in [1, {capacity}]")
+        if ladder[-1] < capacity:
+            ladder.append(capacity)
+        return tuple(ladder)
+    out, b = [], 8
+    while b < capacity:
+        out.append(b)
+        b *= 2
+    out.append(int(capacity))
+    return tuple(out)
+
+
+class GenerationRequest:
+    __slots__ = ("prompt", "n_steps", "temperature", "top_k", "top_p",
+                 "seed", "eos_id", "ids", "error", "deadline", "cancelled",
+                 "event", "t_submit", "rng")
+
+    def __init__(self, prompt, n_steps, *, temperature=1.0, top_k=0,
+                 top_p=0.0, seed=0, eos_id=None, deadline=None):
+        self.prompt = [int(t) for t in prompt]
+        self.n_steps = int(n_steps)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.seed = int(seed)
+        self.eos_id = None if eos_id is None else int(eos_id)
+        self.ids: List[int] = list(self.prompt)
+        self.error: Optional[str] = None
+        self.deadline = deadline
+        self.cancelled = False
+        self.event = threading.Event()
+        self.t_submit = time.monotonic()
+        self.rng = np.random.RandomState(self.seed)
+
+    @property
+    def done(self) -> bool:
+        gen = len(self.ids) - len(self.prompt)
+        if gen >= self.n_steps:
+            return True
+        return (self.eos_id is not None and gen > 0
+                and self.ids[-1] == self.eos_id)
+
+
+class GenerationScheduler:
+    """One LM's continuous-batching decode loop (see module docstring)."""
+
+    def __init__(self, cg, model_name: str = "default", slots: int = 4,
+                 prompt_buckets: Optional[Sequence[int]] = None,
+                 queue_depth: int = 64, mode: str = "continuous"):
+        from deeplearning4j_tpu.models.zoo import DecodeStepper
+
+        if mode not in ("continuous", "drain"):
+            raise ValueError(f"unknown scheduler mode {mode!r}")
+        self.model_name = model_name
+        self.mode = mode
+        self.stepper = DecodeStepper(cg, slots)
+        self.slots = self.stepper.slots
+        self.capacity = self.stepper.capacity
+        self.prompt_buckets = prompt_bucket_ladder(self.capacity,
+                                                   prompt_buckets)
+        self._queue: "queue.Queue[Optional[GenerationRequest]]" = queue.Queue(
+            maxsize=int(queue_depth))
+        self._thread: Optional[threading.Thread] = None
+        _m.MODEL_QUEUE_DEPTH.labels(
+            model=model_name, route="generate").set_function(self._queue.qsize)
+
+    # ------------------------------------------------------------ control
+
+    def start(self) -> "GenerationScheduler":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name=f"dl4j-decode-{self.model_name}",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            try:
+                self._queue.put_nowait(None)
+            except queue.Full:
+                pass
+            self._thread = None
+
+    def qsize(self) -> int:
+        return self._queue.qsize()
+
+    # ------------------------------------------------------------- warmup
+
+    def warmup(self) -> None:
+        """Compile every prefill bucket + the step program into the AOT
+        store before traffic (one short throwaway generation per bucket)."""
+        for b in self.prompt_buckets:
+            probs, slot_state, n = self.stepper.prefill([0], pad_to=b)
+        self.stepper.install(0, slot_state, n)
+        self.stepper.step([0] * self.slots)
+        self.stepper.clear(0)
+
+    # ---------------------------------------------------------- admission
+
+    def submit(self, req: GenerationRequest) -> GenerationRequest:
+        if not req.prompt:
+            raise InputValidationError("prompt_ids must be non-empty")
+        if req.n_steps < 1:
+            raise InputValidationError("n_steps must be >= 1")
+        if len(req.prompt) + req.n_steps > self.capacity:
+            raise InputValidationError(
+                f"prompt ({len(req.prompt)}) + n_steps ({req.n_steps}) "
+                f"exceeds the decode cache capacity {self.capacity}")
+        try:
+            self._queue.put_nowait(req)
+        except queue.Full:
+            raise ServerOverloadedError(
+                f"model {self.model_name!r} generation queue is full "
+                f"({self._queue.maxsize} requests); retry later")
+        return req
+
+    def generate(self, prompt_ids, n_steps: int, *,
+                 timeout_s: Optional[float] = None,
+                 **sampling) -> List[int]:
+        """Blocking helper: submit + wait; cancels the request (recycled at
+        the next step boundary) when the caller's timeout expires."""
+        deadline = (None if timeout_s is None
+                    else time.monotonic() + timeout_s)
+        req = GenerationRequest(prompt_ids, n_steps, deadline=deadline,
+                                **sampling)
+        self.submit(req)
+        req.event.wait(timeout=timeout_s)
+        if not req.event.is_set():
+            req.cancelled = True
+            raise TimeoutError(
+                f"generation timed out after {timeout_s}s; the slot is "
+                "recycled at the next step boundary")
+        if req.error == "__deadline__":
+            raise RequestTimeoutError(
+                "generation deadline expired before completion")
+        if req.error is not None:
+            raise RuntimeError(req.error)
+        return req.ids
+
+    # --------------------------------------------------------------- loop
+
+    def _sample(self, req: GenerationRequest, probs) -> int:
+        from deeplearning4j_tpu.models.zoo import _sample_token
+
+        tok = _sample_token(probs, req.rng, req.temperature, req.top_k,
+                            req.top_p)
+        req.ids.append(tok)
+        _m.GENERATED_TOKENS.labels(model=self.model_name).inc()
+        return tok
+
+    def _finish_timeout(self, req: GenerationRequest) -> None:
+        _m.REQUESTS.labels(model=self.model_name, route="generate",
+                           outcome="timeout").inc()
+        if not req.cancelled:
+            req.error = "__deadline__"
+        req.event.set()
+
+    def _admit(self, slot: int, req: GenerationRequest) -> bool:
+        """Prefill + install + first token. Returns True when the request
+        stays active in `slot` (False: finished or failed at admission)."""
+        pad_to = next(b for b in self.prompt_buckets
+                      if len(req.prompt) <= b)
+        try:
+            probs, slot_state, n = self.stepper.prefill(req.prompt,
+                                                        pad_to=pad_to)
+            self.stepper.install(slot, slot_state, n)
+        except Exception as e:
+            req.error = f"{type(e).__name__}: {e}"
+            req.event.set()
+            return False
+        _m.TTFT_SECONDS.labels(model=self.model_name).observe(
+            time.monotonic() - req.t_submit)
+        self._sample(req, probs)
+        if req.done:
+            self.stepper.clear(slot)
+            req.event.set()
+            return False
+        return True
+
+    def _retire(self, slot: int, req: GenerationRequest,
+                timed_out: bool = False) -> None:
+        self.stepper.clear(slot)
+        if timed_out:
+            self._finish_timeout(req)
+        else:
+            req.event.set()
+
+    def _loop(self) -> None:
+        active: Dict[int, GenerationRequest] = {}
+        free = list(reversed(range(self.slots)))
+        busy_gauge = _m.DECODE_SLOTS_BUSY.labels(model=self.model_name)
+        step_hist = _m.DECODE_STEP_SECONDS.labels(model=self.model_name)
+        while True:
+            # Admission happens ONLY here — a step boundary. Continuous
+            # mode refills any free slot mid-flight; drain mode waits for
+            # the whole batch to finish (the control arm for the bench).
+            admitting = bool(free) and (self.mode == "continuous"
+                                        or not active)
+            while admitting and free:
+                try:
+                    req = self._queue.get(timeout=None if not active
+                                          else 0.0)
+                except queue.Empty:
+                    break
+                if req is None:
+                    self._shutdown(active)
+                    return
+                now = time.monotonic()
+                if req.cancelled or (req.deadline is not None
+                                     and now > req.deadline):
+                    self._finish_timeout(req)
+                    continue
+                slot = free.pop()
+                if self._admit(slot, req):
+                    active[slot] = req
+                else:
+                    free.append(slot)
+            busy_gauge.set(len(active))
+            if not active:
+                continue
+            tokens = [active[s].ids[-1] if s in active else 0
+                      for s in range(self.slots)]
+            t0 = time.perf_counter()
+            probs = self.stepper.step(tokens)
+            step_hist.observe(time.perf_counter() - t0)
+            now = time.monotonic()
+            for slot, req in list(active.items()):
+                if req.cancelled or (req.deadline is not None
+                                     and now > req.deadline):
+                    self._retire(slot, req, timed_out=True)
+                    del active[slot]
+                    free.append(slot)
+                    continue
+                self._sample(req, probs[slot])
+                if req.done:
+                    self._retire(slot, req)
+                    del active[slot]
+                    free.append(slot)
+
+    def _shutdown(self, active: Dict[int, GenerationRequest]) -> None:
+        for slot, req in active.items():
+            req.error = "server stopped"
+            req.event.set()
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            if req is not None:
+                req.error = "server stopped"
+                req.event.set()

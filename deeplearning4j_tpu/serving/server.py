@@ -1,0 +1,377 @@
+"""The serving facade: `InferenceServer`, now a multi-model host.
+
+The PR 5 single-model server (`deeplearning4j_tpu/serving.py`) became this
+package; the constructor, `from_checkpoint`, `predict`, `wait_ready`,
+`url`, `stop` and the HTTP surface (`/health`, `/healthz`, `/metrics`,
+`/predict`) are unchanged for existing callers. What's new underneath:
+
+- admission goes through a per-model `ShapeBucketBatcher` (bounded queue,
+  bucket-ladder padding, deadline/cancellation drops) instead of one
+  unbounded queue + one fixed compile shape;
+- `add_model(name, net=..., path=...)` hosts several models in one
+  process under a `ModelHost` HBM budget (LRU eviction + reload);
+- LM engines with a KV-cached decode path get a continuous-batching
+  `GenerationScheduler` (`generate()`, `POST /generate`);
+- warmup drives EVERY batch bucket (and every prompt bucket + the decode
+  step) through the `compilation/` AOT store, so mixed-shape traffic
+  never compiles post-startup.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from http.server import ThreadingHTTPServer
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.serving import metrics as _m
+from deeplearning4j_tpu.serving.batcher import (
+    ShapeBucketBatcher,
+    canonicalize_features,
+)
+from deeplearning4j_tpu.serving.errors import (
+    InputValidationError,
+    ModelNotReadyError,
+    RequestTimeoutError,
+    ServerOverloadedError,
+    ServingError,
+)
+from deeplearning4j_tpu.serving.host import ModelHost
+from deeplearning4j_tpu.serving.scheduler import GenerationScheduler
+
+_UNSET = object()
+
+
+class InferenceServer:
+    """HTTP predict/generate server over trained engines (anything with
+    `output(x)`; LM generation needs a ComputationGraph with a KV-cached
+    attention decode path).
+
+    `max_batch_size` bounds the LARGEST padded compile shape; requests pad
+    to the smallest bucket in `batch_buckets` (powers of two up to
+    `max_batch_size` by default). `max_delay_ms` is the coalescing window.
+    With `warmup=True`, `start()` returns immediately and compiles every
+    bucket on a background thread; poll `GET /healthz` or `wait_ready()`
+    before sending traffic. `hbm_budget_bytes` turns on LRU eviction of
+    cold checkpoint-backed models.
+    """
+
+    def __init__(self, net=None, port: int = 0, host: str = "127.0.0.1",
+                 max_batch_size: int = 32, max_delay_ms: float = 5.0,
+                 predict_timeout_s: Optional[float] = 300.0,
+                 warmup: bool = False,
+                 warmup_shape: Optional[Tuple[int, ...]] = None,
+                 batch_buckets: Optional[Sequence[int]] = None,
+                 queue_depth: int = 256,
+                 hbm_budget_bytes: Optional[int] = None,
+                 decode_slots: int = 4,
+                 prompt_buckets: Optional[Sequence[int]] = None,
+                 generate_queue_depth: int = 64,
+                 scheduler_mode: str = "continuous",
+                 default_model: str = "default"):
+        self.host = host
+        self.port = port
+        # How long predict() waits for its batch; the first request after a
+        # model/shape change pays a fresh XLA compile, so the default is
+        # generous. None waits indefinitely.
+        self.predict_timeout_s = predict_timeout_s
+        self.max_batch_size = int(max_batch_size)
+        self.max_delay_s = float(max_delay_ms) / 1000.0
+        self.warmup = bool(warmup)
+        self.warmup_shape = (None if warmup_shape is None
+                             else tuple(warmup_shape))
+        self.batch_buckets = batch_buckets
+        self.queue_depth = int(queue_depth)
+        self.decode_slots = int(decode_slots)
+        self.prompt_buckets = prompt_buckets
+        self.generate_queue_depth = int(generate_queue_depth)
+        self.scheduler_mode = scheduler_mode
+        self.default_model = default_model
+        self.models = ModelHost(hbm_budget_bytes=hbm_budget_bytes,
+                                on_load=self._attach)
+        self._ready = threading.Event()
+        self._ready.set()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._serve_thread: Optional[threading.Thread] = None
+        self._warmup_thread: Optional[threading.Thread] = None
+        if net is not None:
+            self.add_model(default_model, net=net)
+
+    @classmethod
+    def from_checkpoint(cls, path, **kwargs) -> "InferenceServer":
+        """Serve straight from a checkpoint on disk: a sharded checkpoint
+        directory (a committed step or a `CheckpointManager` root — latest
+        committed step wins) or a legacy model ZIP. The deploy path is one
+        call: train anywhere, point the server at the checkpoint store —
+        with `warmup=True` the checkpointed model is pre-compiled before
+        the first request arrives (watch `GET /healthz` for "ready").
+        Keeping `path` on the default model makes it evictable (and
+        reloadable) under an `hbm_budget_bytes`."""
+        server = cls(None, **kwargs)
+        server.add_model(server.default_model, path=path)
+        return server
+
+    # --------------------------------------------------------------- models
+
+    @property
+    def net(self):
+        """The default model's engine (the PR 5 single-model attribute)."""
+        return self.models.get(self.default_model).net
+
+    def add_model(self, name: str, net=None, path=None, *,
+                  max_batch_size: Optional[int] = None,
+                  batch_buckets: Optional[Sequence[int]] = None,
+                  max_delay_ms: Optional[float] = None,
+                  queue_depth: Optional[int] = None,
+                  warmup_shape: Optional[Tuple[int, ...]] = None,
+                  lm: object = "auto",
+                  decode_slots: Optional[int] = None,
+                  prompt_buckets: Optional[Sequence[int]] = None,
+                  generate_queue_depth: Optional[int] = None,
+                  scheduler_mode: Optional[str] = None,
+                  pinned: Optional[bool] = None):
+        """Host another model (server-level knobs are the defaults). With
+        `path`, the checkpoint loads now and can be LRU-evicted/reloaded
+        under the HBM budget; a live `net` with no path is pinned."""
+        if net is None:
+            if path is None:
+                raise ValueError("add_model needs a net or a path")
+            from deeplearning4j_tpu.checkpoint.legacy import load_any
+
+            net = load_any(path)
+        opts = {
+            "max_batch_size": (self.max_batch_size if max_batch_size is None
+                               else int(max_batch_size)),
+            "batch_buckets": (self.batch_buckets if batch_buckets is None
+                              else batch_buckets),
+            "max_delay_s": (self.max_delay_s if max_delay_ms is None
+                            else float(max_delay_ms) / 1000.0),
+            "queue_depth": (self.queue_depth if queue_depth is None
+                            else int(queue_depth)),
+            "warmup_shape": (self.warmup_shape if warmup_shape is None
+                             else tuple(warmup_shape)),
+            "lm": lm,
+            "decode_slots": (self.decode_slots if decode_slots is None
+                             else int(decode_slots)),
+            "prompt_buckets": (self.prompt_buckets if prompt_buckets is None
+                               else prompt_buckets),
+            "generate_queue_depth": (
+                self.generate_queue_depth if generate_queue_depth is None
+                else int(generate_queue_depth)),
+            "scheduler_mode": (self.scheduler_mode if scheduler_mode is None
+                               else scheduler_mode),
+        }
+        return self.models.add(name, net=net, path=path, pinned=pinned,
+                               **opts)
+
+    def _attach(self, model) -> None:
+        """ModelHost on_load hook: build + start the model's serving
+        runtime (runs at add time and again after an eviction reload)."""
+        o = model.options
+        model.batcher = ShapeBucketBatcher(
+            model.net, model_name=model.name,
+            max_batch_size=o["max_batch_size"], buckets=o["batch_buckets"],
+            max_delay_s=o["max_delay_s"], queue_depth=o["queue_depth"],
+            warmup_shape=o["warmup_shape"]).start()
+        if o["lm"] and hasattr(model.net, "_get_jit"):
+            try:
+                model.scheduler = GenerationScheduler(
+                    model.net, model_name=model.name,
+                    slots=o["decode_slots"],
+                    prompt_buckets=o["prompt_buckets"],
+                    queue_depth=o["generate_queue_depth"],
+                    mode=o["scheduler_mode"]).start()
+            except Exception:
+                # lm="auto" probes: a model without a KV-cached decode path
+                # simply doesn't serve /generate.
+                if o["lm"] is not True:
+                    model.scheduler = None
+                else:
+                    raise
+        model.ready.set()
+
+    # -------------------------------------------------------------- warmup
+
+    @property
+    def _status(self) -> str:
+        # Derived from the Event (its own lock) so the warmup thread and
+        # the HTTP handlers never race on a plain attribute.
+        return "ready" if self._ready.is_set() else "warming"
+
+    def wait_ready(self, timeout: Optional[float] = None) -> bool:
+        """Block until warmup finished (immediately True without warmup)."""
+        return self._ready.wait(timeout)
+
+    def _warmup_run(self) -> None:
+        """Drive every model's batch-bucket ladder (and, for LMs, every
+        prompt bucket + the decode step) through the AOT store so no real
+        request triggers an XLA compile. Failures flip to "ready" anyway —
+        the first real request then pays the compile, exactly the
+        no-warmup behavior."""
+        try:
+            for name in self.models.names():
+                model = self.models.get(name)
+                try:
+                    if model.batcher is not None:
+                        model.batcher.warm()
+                    if model.scheduler is not None:
+                        model.scheduler.warmup()
+                except Exception as e:
+                    import warnings
+
+                    warnings.warn(
+                        f"serving warmup failed ({type(e).__name__}: {e}); "
+                        "the first request will pay the compile")
+                finally:
+                    model.ready.set()
+        finally:
+            self._ready.set()
+
+    # ------------------------------------------------------------- predict
+
+    def predict(self, data, model: Optional[str] = None,
+                timeout_s: object = _UNSET) -> np.ndarray:
+        """In-process entry (the HTTP handler calls this too). Observed once
+        per caller request into the latency histograms, however many
+        bucket-sized chunks it splits into."""
+        name = self.default_model if model is None else model
+        timeout = (self.predict_timeout_s if timeout_s is _UNSET
+                   else timeout_s)
+        t0 = time.perf_counter()
+        try:
+            served = self.models.get(name)
+            arr = canonicalize_features(served.net, data)
+            result = self._predict_rows(served, arr, timeout)
+        except Exception as e:
+            _m.REQUESTS_LEGACY.labels(outcome="error").inc()
+            _m.REQUESTS.labels(model=name, route="predict",
+                               outcome=self._outcome(e)).inc()
+            raise
+        _m.REQUESTS_LEGACY.labels(outcome="ok").inc()
+        _m.REQUESTS.labels(model=name, route="predict", outcome="ok").inc()
+        dt = time.perf_counter() - t0
+        _m.REQ_LATENCY.observe(dt)
+        _m.REQUEST_SECONDS.labels(model=name, route="predict").observe(dt)
+        return result
+
+    @staticmethod
+    def _outcome(e: Exception) -> str:
+        if isinstance(e, ServerOverloadedError):
+            return "shed"
+        if isinstance(e, (InputValidationError, ModelNotReadyError)):
+            return "invalid"
+        if isinstance(e, TimeoutError):
+            # The batcher/scheduler already counted "timeout" when it
+            # dropped the request; don't double count under it.
+            return "error"
+        return "error"
+
+    def _predict_rows(self, served, arr: np.ndarray,
+                      timeout: Optional[float]) -> np.ndarray:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        size = served.batcher.max_batch_size
+        # Split oversized requests into bucket-sized chunks; all chunks are
+        # queued up front so they coalesce into consecutive batches.
+        chunks = ([arr[i:i + size] for i in range(0, arr.shape[0], size)]
+                  or [arr])
+        pendings = [served.batcher.submit(c, deadline) for c in chunks]
+        results = []
+        for p in pendings:
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            p.event.wait(timeout=remaining)
+            if not p.event.is_set():
+                for q in pendings:
+                    q.cancelled = True  # the batcher drops + counts them
+                raise TimeoutError(
+                    f"prediction timed out after {timeout}s "
+                    "(cold XLA compiles can be slow; raise predict_timeout_s "
+                    "or pass None to wait indefinitely)")
+            if p.error == "__deadline__":
+                for q in pendings:
+                    q.cancelled = True
+                raise RequestTimeoutError(
+                    f"prediction deadline ({timeout}s) expired in the "
+                    "batch queue")
+            if p.error is not None:
+                raise RuntimeError(p.error)
+            results.append(p.result)
+        if len(results) == 1:
+            return results[0]
+        return np.concatenate(results, axis=0)
+
+    # ------------------------------------------------------------ generate
+
+    def generate(self, prompt_ids, n_steps: int,
+                 model: Optional[str] = None,
+                 timeout_s: object = _UNSET, **sampling):
+        """Continuously-batched LM generation: returns the full token list
+        (prompt + generated), float-close to `generate_lm(use_cache=True)`
+        for the same seed/sampling knobs."""
+        name = self.default_model if model is None else model
+        timeout = (self.predict_timeout_s if timeout_s is _UNSET
+                   else timeout_s)
+        t0 = time.perf_counter()
+        try:
+            served = self.models.get(name)
+            if served.scheduler is None:
+                raise InputValidationError(
+                    f"model {name!r} does not serve generation (no "
+                    "KV-cached decode path)")
+            ids = served.scheduler.generate(prompt_ids, n_steps,
+                                            timeout_s=timeout, **sampling)
+        except Exception as e:
+            _m.REQUESTS.labels(model=name, route="generate",
+                               outcome=self._outcome(e)).inc()
+            raise
+        _m.REQUESTS.labels(model=name, route="generate",
+                           outcome="ok").inc()
+        _m.REQUEST_SECONDS.labels(model=name, route="generate").observe(
+            time.perf_counter() - t0)
+        return ids
+
+    # ---------------------------------------------------------------- http
+
+    def start(self) -> "InferenceServer":
+        from deeplearning4j_tpu.serving.http import make_handler
+
+        _m.QUEUE_DEPTH.set_function(self._total_queue_depth)
+        self._httpd = ThreadingHTTPServer((self.host, self.port),
+                                          make_handler(self))
+        self.port = self._httpd.server_address[1]
+        self._serve_thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._serve_thread.start()
+        if self.warmup:
+            # The port is already bound and /healthz answers "warming", so
+            # orchestrators can watch readiness while the models compile.
+            self._ready.clear()
+            for name in self.models.names():
+                self.models.get(name).ready.clear()
+            self._warmup_thread = threading.Thread(
+                target=self._warmup_run, name="dl4j-serving-warmup",
+                daemon=True)
+            self._warmup_thread.start()
+        return self
+
+    def _total_queue_depth(self) -> int:
+        total = 0
+        for name in self.models.names():
+            m = self.models._models.get(name)
+            if m is not None and m.batcher is not None:
+                total += m.batcher.qsize()
+        return total
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        _m.QUEUE_DEPTH.set_function(None)
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        self.models.stop()

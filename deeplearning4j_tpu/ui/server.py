@@ -585,9 +585,10 @@ class _Handler(BaseHTTPRequestHandler):
         elif url.path == "/metrics":
             from deeplearning4j_tpu import observability as obs
 
-            body = obs.metrics.to_prometheus().encode()
+            body, ctype = obs.prometheus_payload(
+                (q.get("format") or ["prometheus"])[0])
             self.send_response(200)
-            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
