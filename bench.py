@@ -20,7 +20,8 @@ recorded BENCH_r*.json that carries it (the first measurement establishes
 the number to beat — the reference publishes none, BASELINE.md).
 
 Env knobs: BENCH_CONFIGS (comma list), BENCH_STEPS, BENCH_WARMUP,
-BENCH_BATCH_<CONFIG>, BENCH_PEAK_FLOPS, BENCH_SUPERSTEP_K.
+BENCH_BATCH_<CONFIG>, BENCH_PEAK_FLOPS, BENCH_SUPERSTEP_K,
+BENCH_OBS_STEPS/BENCH_OBS_WARMUP (obs_overhead arms).
 """
 
 import glob
@@ -367,6 +368,73 @@ def bench_lenet_cold_vs_warm(steps, warmup):
     head["xla_compiles_cold"] = cold["xla_compiles"]
     head["xla_compiles_warm"] = warm["xla_compiles"]
     head["cache_hits_warm"] = warm["cache_hits"]
+    return head
+
+
+# Fresh interpreter per arm: DL4J_TPU_OBS / DL4J_TPU_FLIGHT are read at
+# import, so toggling them honestly needs a new process.
+_OBS_OVERHEAD_CHILD = r"""
+import json, os, time
+import numpy as np
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.models import zoo
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+steps = int(os.environ.get("BENCH_OBS_STEPS", "150"))
+warmup = int(os.environ.get("BENCH_OBS_WARMUP", "20"))
+batch = int(os.environ.get("BENCH_BATCH_LENET", "64"))
+net = MultiLayerNetwork(zoo.lenet_mnist()).init()
+rng = np.random.RandomState(0)
+x = rng.rand(batch, 28, 28, 1).astype("float32")
+y = np.eye(10, dtype="float32")[rng.randint(0, 10, batch)]
+ds = DataSet(x, y)
+for _ in range(warmup):
+    net.fit(ds)
+_ = float(net.score_value)
+t0 = time.perf_counter()
+for _ in range(steps):
+    net.fit(ds)
+_ = float(net.score_value)
+dt = time.perf_counter() - t0
+print(json.dumps({"steps": steps, "seconds": dt,
+                  "step_seconds": dt / steps}))
+"""
+
+
+def bench_obs_overhead(steps, warmup):
+    """Recorder-budget proof (observability tier): the SAME steady-state
+    lenet loop in three fresh interpreters — all observability disabled,
+    metrics registry on, registry + flight recorder on. The ratios are the
+    always-on cost; the flight-recorder budget is <2% (PERF.md §16)."""
+    import subprocess
+
+    arms = (
+        ("disabled", {"DL4J_TPU_OBS": "0", "DL4J_TPU_FLIGHT": "0"}),
+        ("metrics", {"DL4J_TPU_OBS": "1", "DL4J_TPU_FLIGHT": "0"}),
+        ("metrics_flight", {"DL4J_TPU_OBS": "1", "DL4J_TPU_FLIGHT": "1"}),
+    )
+    res = {}
+    for name, env_over in arms:
+        env = dict(os.environ, **env_over)
+        env.setdefault("BENCH_OBS_STEPS", str(max(150, steps)))
+        proc = subprocess.run([sys.executable, "-c", _OBS_OVERHEAD_CHILD],
+                              capture_output=True, text=True, env=env,
+                              timeout=1800)
+        if proc.returncode != 0:
+            raise RuntimeError(f"obs_overhead child {name!r} failed: "
+                               f"{proc.stderr[-2000:]}")
+        res[name] = json.loads(proc.stdout.strip().splitlines()[-1])
+    base = res["disabled"]["step_seconds"]
+    ratio_m = res["metrics"]["step_seconds"] / max(base, 1e-12)
+    ratio_f = res["metrics_flight"]["step_seconds"] / max(base, 1e-12)
+    head = _entry("obs_overhead_flight_ratio", ratio_f,
+                  "x vs disabled (fresh process)",
+                  note="steady-state lenet step seconds with metrics + "
+                       "flight recorder on, vs all observability off; "
+                       "recorder budget is <1.02x (PERF.md §16)")
+    head["metrics_only_ratio"] = round(ratio_m, 4)
+    for name, r in res.items():
+        head[f"step_seconds_{name}"] = round(r["step_seconds"], 6)
     return head
 
 
@@ -853,7 +921,8 @@ def main():
     configs = os.environ.get(
         "BENCH_CONFIGS",
         "resnet50,lenet,char_rnn,lenet_step,lenet_superstep,lenet_cold_warm,"
-        "word2vec,vgg16,flash_attn,flash_tri,transformer,serving_slo"
+        "word2vec,vgg16,flash_attn,flash_tri,transformer,serving_slo,"
+        "obs_overhead"
     ).split(",")
 
     head, extra = None, {}
@@ -900,6 +969,9 @@ def main():
     if "serving_slo" in configs:
         for e in bench_serving_slo(steps, warmup):
             extra[e["metric"]] = e
+    if "obs_overhead" in configs:
+        e = bench_obs_overhead(steps, warmup)
+        extra[e["metric"]] = e
     if head is None:  # resnet50 excluded: promote the first extra metric
         if not extra:
             _emit({

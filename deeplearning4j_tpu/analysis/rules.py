@@ -1,4 +1,4 @@
-"""tpulint rules JX001-JX007.
+"""tpulint rules JX001-JX008.
 
 Each rule is a class with a stable ``id``; registration is
 registry-driven (`@register_rule`) so satellite PRs add rules without
@@ -556,3 +556,63 @@ class AotOutsideCompilationRule(Rule):
                     ".compile() outside compilation/: AOT-compile through "
                     "the executable store (compilation/program.py) so the "
                     "artifact is fingerprinted and reused")
+
+
+@register_rule
+class MetricsInHotPathRule(Rule):
+    """JX008: metrics family creation in jit- or hot-loop-reachable code.
+
+    `registry.counter/gauge/histogram(...)` resolves or creates a family
+    under the registry lock — cheap once, but a per-step call site adds a
+    lock acquire + dict lookups to every iteration, and under `jit` it is
+    a trace-time side effect that silently stops firing. The convention
+    (observability/metrics.py) is to resolve families and `.labels(...)`
+    children ONCE at module import and call `.inc()/.observe()` on the
+    cached child in the hot path. Flags family-creation calls whose
+    receiver looks like a registry (`metrics`, `registry`, `reg`, `_reg`,
+    `_registry`) when they sit inside a jit-reachable function or inside
+    a for/while loop of any function; module-level registration (the
+    sanctioned pattern) is exempt.
+    """
+
+    id = "JX008"
+    description = ("metrics family creation (registry.counter/gauge/"
+                   "histogram) in jit-reachable or looped code")
+
+    _FACTORY = ("counter", "gauge", "histogram")
+    _REGISTRY_NAMES = ("metrics", "registry", "reg", "_reg", "_registry")
+
+    def _in_loop(self, ctx, node) -> bool:
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, (ast.For, ast.While, ast.AsyncFor)):
+                return True
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return False
+        return False
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in self._FACTORY
+                    and terminal_attr(node.func.value)
+                    in self._REGISTRY_NAMES):
+                continue
+            context = ctx.context_of(node)
+            if context == "<module>":
+                continue  # import-time registration is the convention
+            in_jit = context in ctx.jit_reachable
+            in_loop = self._in_loop(ctx, node)
+            if not (in_jit or in_loop):
+                continue
+            where = ("jit-reachable code" if in_jit
+                     else "a per-iteration loop")
+            yield self.finding(
+                ctx, node,
+                f"`.{node.func.attr}(...)` family creation in {where}: "
+                "resolve the family and its `.labels(...)` child once at "
+                "module import and call the cached child here "
+                "(registry lock + dict lookups per step"
+                + (", and a trace-time-only side effect under jit"
+                   if in_jit else "") + ")")

@@ -171,9 +171,21 @@ def install_nan_guard(net, check_every: int = 1) -> Callable[[], None]:
         if state["n"] % check_every == 0:
             v = net.score_value
             if v is not None and (math.isnan(v) or math.isinf(v)):
+                it = getattr(net, "iteration", "?")
+                try:
+                    # Forensics before the raise: the bundle holds the ring
+                    # of step records leading up to the divergence.
+                    from deeplearning4j_tpu import observability as obs
+
+                    obs.flight.record_event(
+                        "nan_loss", engine=type(net).__name__,
+                        iteration=it, loss=repr(v))
+                    obs.flight.dump(reason="nan-loss", force=False)
+                except Exception:
+                    pass
                 raise FloatingPointError(
                     f"tpulint strict mode: non-finite loss ({v}) at "
-                    f"iteration {getattr(net, 'iteration', '?')}")
+                    f"iteration {it}")
         return out
 
     net._fit_dispatch = dispatch

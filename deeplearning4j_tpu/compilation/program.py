@@ -30,6 +30,7 @@ from typing import Any, Dict, Optional, Tuple
 
 from deeplearning4j_tpu.compilation import cache as _cache
 from deeplearning4j_tpu.compilation import store as _store
+from deeplearning4j_tpu.observability import memory as _obsmem
 
 _store_lock = threading.Lock()
 _store_singleton: Optional[_store.AOTStore] = None
@@ -130,6 +131,7 @@ class CachedProgram:
         loaded = store.load(fp)
         if loaded is not None:
             _store._M_HITS_AOT.inc()
+            self._record_memory(loaded)
             return loaded
         _store._M_MISSES_AOT.inc()
         try:
@@ -143,7 +145,16 @@ class CachedProgram:
             self._warn_fallback("AOT compilation failed", e)
             return self._fn
         store.save(fp, compiled, dict(doc, compile_seconds=dt))
+        self._record_memory(compiled)
         return compiled
+
+    def _record_memory(self, compiled) -> None:
+        """Static HBM accounting: every executable that materializes here
+        (AOT hit or live compile) reports its memory_analysis() into
+        `dl4j_program_hbm_bytes{program,kind}`. Never raises."""
+        _obsmem.record_program_memory(
+            _obsmem.program_label(self.kind, self.static), compiled,
+            net=self._net)
 
     def _warn_fallback(self, what: str, e: Exception) -> None:
         if not self._fallback_warned:

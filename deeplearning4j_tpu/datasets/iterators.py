@@ -17,6 +17,7 @@ import logging
 import os
 import queue
 import threading
+import time
 from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence
 
 import numpy as np
@@ -30,6 +31,11 @@ _M_CACHE_BYTES = _obs.metrics.gauge(
     "dl4j_device_cache_bytes",
     "Bytes of training batches resident in HBM across "
     "DeviceCacheDataSetIterator caches")
+_M_INPUT_WAIT = _obs.metrics.histogram(
+    "dl4j_input_wait_seconds",
+    "Host seconds blocked in iterator-next waiting for the next batch "
+    "(input starvation; the device is idle while this accrues)",
+    label_names=("source",)).labels(source="superstep")
 
 
 def maybe_reset(iterator) -> bool:
@@ -574,7 +580,17 @@ class SuperbatchIterator(DataSetIterator):
                 return buf[0]
             return stack_superbatch(buf, stage=self.stage)
 
-        for item in self.base:
+        base_it = iter(self.base)
+        while True:
+            # Time the base iterator's next separately: when K batches
+            # stack into one dispatch, the per-batch waits here are the
+            # starvation the engine loop can no longer see.
+            t_wait = time.perf_counter()
+            try:
+                item = next(base_it)
+            except StopIteration:
+                break
+            _M_INPUT_WAIT.observe(time.perf_counter() - t_wait)
             if self.transform is not None:
                 item = self.transform(item)
             s = batch_signature(item)

@@ -82,6 +82,11 @@ _M_JIT_MISS = _obs.metrics.counter(
     "dl4j_jit_cache_misses_total",
     "Engine jit-program cache misses (a new program will trace+compile)",
     label_names=("engine",)).labels(engine="graph")
+_M_INPUT_WAIT = _obs.metrics.histogram(
+    "dl4j_input_wait_seconds",
+    "Host seconds blocked in iterator-next waiting for the next batch "
+    "(input starvation; the device is idle while this accrues)",
+    label_names=("source",)).labels(source="graph")
 
 
 def _as_mds(data, labels=None) -> MultiDataSet:
@@ -638,14 +643,21 @@ class ComputationGraph:
             listener.on_epoch_start(self)
         with _obs.tracer.span("graph.fit", cat="train", epoch=self.epoch):
             k = self._superstep_k()
-            if k > 1:
-                for item in self._superstep_wrap(iterator, k):
-                    self._fit_dispatch(
-                        item if isinstance(item, MultiSuperbatch)
-                        else _as_mds(item))
-            else:
-                for item in iterator:
-                    self._fit_dispatch(_as_mds(item))
+            src = self._superstep_wrap(iterator, k) if k > 1 else iterator
+            src_it = iter(src)
+            while True:
+                # iterator-next is timed separately: with async/staged
+                # input tiers this wait is pure device starvation.
+                t_wait = time.perf_counter()
+                try:
+                    item = next(src_it)
+                except StopIteration:
+                    break
+                self._last_input_wait = time.perf_counter() - t_wait
+                _M_INPUT_WAIT.observe(self._last_input_wait)
+                self._fit_dispatch(
+                    item if isinstance(item, MultiSuperbatch)
+                    else _as_mds(item))
         self.epoch += 1
         _M_EPOCHS.inc()
         for listener in self.listeners:
@@ -658,22 +670,33 @@ class ComputationGraph:
         `ParallelWrapper`. Observability choke point (see
         `MultiLayerNetwork._fit_dispatch`); `StepProfiler` patches this
         method on the instance."""
-        _M_H2D.inc(_obs.host_nbytes(mds.features, mds.labels,
-                                    mds.features_masks
-                                    if hasattr(mds, "features_masks")
-                                    else mds.features_mask,
-                                    mds.labels_masks
-                                    if hasattr(mds, "labels_masks")
-                                    else mds.labels_mask))
+        h2d = _obs.host_nbytes(mds.features, mds.labels,
+                               mds.features_masks
+                               if hasattr(mds, "features_masks")
+                               else mds.features_mask,
+                               mds.labels_masks
+                               if hasattr(mds, "labels_masks")
+                               else mds.labels_mask)
+        _M_H2D.inc(h2d)
         it0 = self.iteration
         t0 = time.perf_counter()
         with _obs.iteration_span("graph", it0 + 1):
             try:
                 return self._fit_dispatch_inner(mds)
+            except Exception as e:
+                # Forensics for uncaught dispatch failures: the bundle is
+                # written before the exception unwinds the fit loop.
+                _obs.flight.on_crash("graph.dispatch", e)
+                raise
             finally:
-                _dispatch_observe(int(getattr(mds, "k", 1)),
-                                  time.perf_counter() - t0)
+                dt = time.perf_counter() - t0
+                _dispatch_observe(int(getattr(mds, "k", 1)), dt)
                 _M_ITERS.inc(max(0, self.iteration - it0))
+                _obs.flight.record_step(
+                    "graph", self.iteration, loss=self._score, seconds=dt,
+                    k=int(getattr(mds, "k", 1)), h2d_bytes=h2d,
+                    input_wait=getattr(self, "_last_input_wait", None),
+                    jit_hits=_M_JIT_HIT.get(), jit_misses=_M_JIT_MISS.get())
 
     def _fit_dispatch_inner(self, mds):
         if isinstance(mds, (MultiSuperbatch, Superbatch)):

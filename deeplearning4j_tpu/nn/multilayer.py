@@ -87,6 +87,11 @@ _M_JIT_MISS = _obs.metrics.counter(
     "dl4j_jit_cache_misses_total",
     "Engine jit-program cache misses (a new program will trace+compile)",
     label_names=("engine",)).labels(engine="mln")
+_M_INPUT_WAIT = _obs.metrics.histogram(
+    "dl4j_input_wait_seconds",
+    "Host seconds blocked in iterator-next waiting for the next batch "
+    "(input starvation; the device is idle while this accrues)",
+    label_names=("source",)).labels(source="mln")
 
 
 def _as_dataset(data, labels=None) -> DataSet:
@@ -655,7 +660,17 @@ class MultiLayerNetwork:
             if self.conf.backprop:
                 k = self._superstep_k()
                 src = self._superstep_wrap(iterator, k) if k > 1 else iterator
-                for ds in src:
+                src_it = iter(src)
+                while True:
+                    # iterator-next is timed separately: with async/staged
+                    # input tiers this wait is pure device starvation.
+                    t_wait = time.perf_counter()
+                    try:
+                        ds = next(src_it)
+                    except StopIteration:
+                        break
+                    self._last_input_wait = time.perf_counter() - t_wait
+                    _M_INPUT_WAIT.observe(self._last_input_wait)
                     self._fit_dispatch(ds)
         self.epoch += 1
         _M_EPOCHS.inc()
@@ -671,17 +686,28 @@ class MultiLayerNetwork:
         path (plain / tBPTT / solver / superstep, local or sharded) stages
         batches through here, and `StepProfiler` patches this method on the
         instance."""
-        _M_H2D.inc(_obs.host_nbytes(ds.features, ds.labels,
-                                    ds.features_mask, ds.labels_mask))
+        h2d = _obs.host_nbytes(ds.features, ds.labels,
+                               ds.features_mask, ds.labels_mask)
+        _M_H2D.inc(h2d)
         it0 = self.iteration
         t0 = time.perf_counter()
         with _obs.iteration_span("mln", it0 + 1):
             try:
                 return self._fit_dispatch_inner(ds)
+            except Exception as e:
+                # Forensics for uncaught dispatch failures: the bundle is
+                # written before the exception unwinds the fit loop.
+                _obs.flight.on_crash("mln.dispatch", e)
+                raise
             finally:
-                _dispatch_observe(int(getattr(ds, "k", 1)),
-                                  time.perf_counter() - t0)
+                dt = time.perf_counter() - t0
+                _dispatch_observe(int(getattr(ds, "k", 1)), dt)
                 _M_ITERS.inc(max(0, self.iteration - it0))
+                _obs.flight.record_step(
+                    "mln", self.iteration, loss=self._score, seconds=dt,
+                    k=int(getattr(ds, "k", 1)), h2d_bytes=h2d,
+                    input_wait=getattr(self, "_last_input_wait", None),
+                    jit_hits=_M_JIT_HIT.get(), jit_misses=_M_JIT_MISS.get())
 
     def _fit_dispatch_inner(self, ds):
         if isinstance(ds, Superbatch):

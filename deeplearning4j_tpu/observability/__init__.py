@@ -19,6 +19,14 @@ Env knobs (read once at import):
 - `DL4J_TPU_OBS_SAMPLE_EVERY` — record every Nth iteration span (default 1;
                                 metrics are never sampled, only spans).
 - `DL4J_TPU_TRACE_BUFFER`     — trace ring-buffer capacity (default 16384).
+- `DL4J_TPU_FLIGHT*`          — flight-recorder knobs (see `flight.py`).
+
+PR 7 adds the forensics + memory tier: `flight` (always-on crash/NaN/
+preemption FlightRecorder, bundles inspectable with `python -m
+deeplearning4j_tpu.observability.flight <bundle>`) and `memory`
+(per-program HBM gauges from `memory_analysis()`, live-buffer
+attribution, measured serving footprints). UIServer serves both at
+`/api/flight` and `/api/memory`.
 """
 
 from __future__ import annotations
@@ -28,16 +36,18 @@ import threading
 from typing import Any, Dict, Optional
 
 from deeplearning4j_tpu.observability.metrics import (
-    DEFAULT_BUCKETS, MetricsRegistry, install_builtin_collectors)
+    DEFAULT_BUCKETS, WIDE_BUCKETS, MetricsRegistry,
+    install_builtin_collectors)
 from deeplearning4j_tpu.observability.tracing import NOOP_SPAN, Tracer
 from deeplearning4j_tpu.observability.profiler import (
     StepProfiler, chip_peak_flops, estimate_step_flops)
 
 __all__ = [
     "metrics", "tracer", "config", "StepProfiler", "MetricsRegistry",
-    "Tracer", "DEFAULT_BUCKETS", "enable", "disable", "iteration_span",
-    "host_nbytes", "install_jax_compile_hook", "bench_snapshot",
-    "prometheus_payload", "chip_peak_flops", "estimate_step_flops",
+    "Tracer", "DEFAULT_BUCKETS", "WIDE_BUCKETS", "enable", "disable",
+    "iteration_span", "host_nbytes", "install_jax_compile_hook",
+    "bench_snapshot", "prometheus_payload", "chip_peak_flops",
+    "estimate_step_flops", "flight", "FlightRecorder", "memory",
 ]
 
 OBS_ENABLED = os.environ.get("DL4J_TPU_OBS", "1").lower() not in (
@@ -134,7 +144,7 @@ def _register_hook_families(reg: MetricsRegistry) -> None:
                   "Seconds to make one program runnable, by source (trace = "
                   "full lowering + backend compile, persistent = XLA cache "
                   "retrieval, aot = executable deserialization)",
-                  label_names=("source",))
+                  label_names=("source",), buckets=WIDE_BUCKETS)
 
 
 def install_jax_compile_hook(registry: Optional[MetricsRegistry] = None) -> bool:
@@ -255,7 +265,8 @@ def bench_snapshot(registry: Optional[MetricsRegistry] = None) -> Dict[str, Any]
     for hist in ("dl4j_step_latency_seconds", "dl4j_step_dispatch_seconds",
                  "dl4j_infer_latency_seconds", "dl4j_request_latency_seconds",
                  "dl4j_serving_request_seconds", "dl4j_serving_ttft_seconds",
-                 "dl4j_serving_decode_step_seconds", "dl4j_compile_seconds"):
+                 "dl4j_serving_decode_step_seconds", "dl4j_compile_seconds",
+                 "dl4j_input_wait_seconds"):
         fam = reg.get_family(hist)
         if fam is None:
             continue
@@ -274,6 +285,7 @@ def bench_snapshot(registry: Optional[MetricsRegistry] = None) -> Dict[str, Any]
                  "dl4j_jit_cache_hits_total", "dl4j_jit_cache_misses_total",
                  "dl4j_host_to_device_bytes_total",
                  "dl4j_checkpoint_bytes_written_total",
+                 "dl4j_program_hbm_bytes", "dl4j_flight_dumps_total",
                  "dl4j_profiler_compile_seconds",
                  "dl4j_profiler_execute_seconds_median",
                  "dl4j_train_flops_per_step", "dl4j_train_mfu"):
@@ -281,3 +293,15 @@ def bench_snapshot(registry: Optional[MetricsRegistry] = None) -> Dict[str, Any]
         if vals:
             out[name] = vals
     return out
+
+
+# ------------------------------------------------- forensics + memory tier
+# Imported LAST: both modules resolve their metric families from the
+# process-global `metrics` defined above. `flight` is re-exported as the
+# recorder INSTANCE (`observability.flight.dump()` / `.record_step(...)`);
+# the module itself stays importable as
+# `deeplearning4j_tpu.observability.flight` (and runnable with -m).
+
+from deeplearning4j_tpu.observability import memory  # noqa: E402,F401
+from deeplearning4j_tpu.observability.flight import (  # noqa: E402
+    FlightRecorder, recorder as flight)

@@ -35,6 +35,14 @@ _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
 DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
 
+# Wide ladder for families whose observations routinely run multi-second
+# to multi-minute (XLA compiles, serving requests riding a cold model
+# reload, TTFT behind a long prefill). The default ladder tops out at 30s,
+# which would clamp such a family's p99 into `+Inf` — the acceptance smoke
+# asserts no scraped family has a majority of observations there.
+WIDE_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0)
+
 
 def _escape_label(v: str) -> str:
     return (str(v).replace("\\", "\\\\").replace("\n", "\\n")

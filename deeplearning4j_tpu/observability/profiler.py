@@ -59,10 +59,21 @@ def estimate_step_flops(net, ds) -> Optional[float]:
                     jnp.asarray(np.asarray(ds.features)),
                     jnp.asarray(np.asarray(ds.labels)), None, None, clock)
         lowered = fn.lower(*args)
+        compiled = None
         try:
-            cost = lowered.compile().cost_analysis()
+            compiled = lowered.compile()
+            cost = compiled.cost_analysis()
         except Exception:
             cost = lowered.cost_analysis()
+        if compiled is not None:
+            # Piggyback: the compiled step is in hand, so its static HBM
+            # footprint feeds dl4j_program_hbm_bytes for free.
+            from deeplearning4j_tpu.observability import memory as _mem
+
+            engine = ("graph" if type(net).__name__ == "ComputationGraph"
+                      else "mln")
+            _mem.record_program_memory(f"{engine}.train_step", compiled,
+                                       net=net)
         if isinstance(cost, (list, tuple)):
             cost = cost[0]
         flops = float(cost.get("flops", 0.0))
@@ -151,8 +162,15 @@ class StepProfiler:
         from deeplearning4j_tpu import observability as obs
 
         obs.install_jax_compile_hook(self.registry)
+        try:
+            from deeplearning4j_tpu.observability import memory as _mem
+
+            _mem.register_tree(type(self.net).__name__, self.net)
+        except Exception:
+            pass
         self._compile_s0 = self._compile_seconds()
         self._cache_counts0 = self._cache_counts()
+        self._input_wait0 = self._input_wait_totals()
         self._jit_known = len(self.net._jit_cache)
         self._orig_dispatch = self.net._fit_dispatch
         self._orig_output = self.net.output
@@ -242,6 +260,25 @@ class StepProfiler:
                 out[key] = delta
         return out
 
+    def _input_wait_totals(self) -> tuple:
+        fam = self.registry.get_family("dl4j_input_wait_seconds")
+        if fam is None:
+            return (0.0, 0)
+        s_total, c_total = 0.0, 0
+        for child in fam.children():
+            _, _, s, c = child.histogram_state()
+            s_total += s
+            c_total += c
+        return (s_total, c_total)
+
+    def input_wait(self) -> tuple:
+        """(seconds, observations) the host spent blocked in iterator-next
+        inside the profiled window — starvation shows up here, not in step
+        latency."""
+        s0, c0 = getattr(self, "_input_wait0", (0.0, 0))
+        s, c = self._input_wait_totals()
+        return (max(0.0, s - s0), max(0, c - c0))
+
     def execute_seconds_median(self) -> Optional[float]:
         if not self.step_times:
             return None
@@ -280,6 +317,10 @@ class StepProfiler:
         cache = self.compile_cache_deltas()
         if cache:
             out["compile_cache"] = cache
+        wait_s, wait_n = self.input_wait()
+        if wait_n:
+            out["input_wait"] = {"seconds": wait_s, "observations": wait_n,
+                                 "mean": wait_s / wait_n}
         if self.step_times:
             s = sorted(self.step_times)
             out["step_latency"] = {

@@ -34,6 +34,11 @@ from deeplearning4j_tpu import observability as _obs
 _M_BATCHES = _obs.metrics.counter(
     "dl4j_parallel_batches_total",
     "Batches sharded and dispatched through ParallelWrapper.fit")
+_M_INPUT_WAIT = _obs.metrics.histogram(
+    "dl4j_input_wait_seconds",
+    "Host seconds blocked in iterator-next waiting for the next batch "
+    "(input starvation; the device is idle while this accrues)",
+    label_names=("source",)).labels(source="parallel")
 _M_SHARD_SECONDS = _obs.metrics.counter(
     "dl4j_parallel_shard_dispatch_seconds_total",
     "Host seconds spent padding + device_put-sharding batches over the mesh "
@@ -229,6 +234,7 @@ class ParallelWrapper:
         sig = None
 
         def flush():
+            nonlocal wait_accum
             if not pending:
                 return
             t0 = time.perf_counter()
@@ -245,8 +251,22 @@ class ParallelWrapper:
                                   k=int(getattr(sharded, "k", 1))):
                 with parallel_context(getattr(self, "context", None)):
                     net._fit_dispatch(sharded)
+            wait_accum = 0.0
 
-        for ds in iterator:
+        src_it = iter(iterator)
+        wait_accum = 0.0
+        while True:
+            t_wait = time.perf_counter()
+            try:
+                ds = next(src_it)
+            except StopIteration:
+                break
+            wait = time.perf_counter() - t_wait
+            _M_INPUT_WAIT.observe(wait)
+            # K batches feed one stacked dispatch: the flight record's
+            # input_wait is the summed wait behind that dispatch.
+            wait_accum += wait
+            net._last_input_wait = wait_accum
             t0 = time.perf_counter()
             padded = self._prepare(ds, is_graph)
             _M_SHARD_SECONDS.inc(time.perf_counter() - t0)

@@ -275,10 +275,20 @@ class ShapeBucketBatcher:
         except Exception as e:  # surface the failure to every caller; the
             for p in live:      # loop thread must survive any bad batch
                 p.error = f"{type(e).__name__}: {e}"
+            _obs.flight.on_crash("serving.batch", e)
         for p in live:
             p.event.set()
 
     def _batch_loop(self) -> None:
+        try:
+            self._batch_loop_inner()
+        except Exception as e:
+            # The loop thread is about to die with requests in flight:
+            # capture the flight bundle before the stack unwinds.
+            _obs.flight.on_crash("serving.batch_loop", e)
+            raise
+
+    def _batch_loop_inner(self) -> None:
         holdover: Optional[_Pending] = None
         while True:
             first = holdover if holdover is not None else self._queue.get()

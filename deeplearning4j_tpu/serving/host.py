@@ -63,6 +63,26 @@ def estimate_checkpoint_bytes(path) -> int:
         return 0
 
 
+def _measure_hbm(model: "ServedModel") -> None:
+    """Firm the footprint up from the leaf-nbytes estimate to measured
+    device bytes (live jax.Array nbytes + the largest recorded program's
+    temp+output scratch) when the runtime can report them; also registers
+    the net for live-buffer attribution in `observability.memory`."""
+    measured = None
+    try:
+        from deeplearning4j_tpu.observability import memory as _obsmem
+
+        _obsmem.register_tree(model.name, model.net)
+        measured = _obsmem.measured_model_bytes(model.net)
+    except Exception:
+        measured = None
+    if measured:
+        model.hbm_bytes = int(measured)
+        model.hbm_source = "measured"
+    else:
+        model.hbm_source = "estimated"
+
+
 class ServedModel:
     """One hosted model: the engine plus its serving runtime (batcher and,
     for LMs, the generation scheduler), readiness, and LRU bookkeeping."""
@@ -78,6 +98,7 @@ class ServedModel:
         self.scheduler = None
         self.ready = threading.Event()
         self.last_used = time.monotonic()
+        self.hbm_source = "estimated"
         self.hbm_bytes = (estimate_hbm_bytes(net) if net is not None
                           else estimate_checkpoint_bytes(path)
                           if path is not None else 0)
@@ -120,6 +141,8 @@ class ModelHost:
             if name in self._models:
                 raise ValueError(f"model {name!r} is already hosted")
             self._models[name] = model
+            if model.net is not None:
+                _measure_hbm(model)
             _m.MODEL_HBM_BYTES.labels(model=name).set(model.hbm_bytes)
             if model.net is not None and self.on_load is not None:
                 self.on_load(model)
@@ -155,6 +178,7 @@ class ModelHost:
             net = load_any(model.path)
             model.net = net
             model.hbm_bytes = estimate_hbm_bytes(net)
+            _measure_hbm(model)
             _m.MODEL_HBM_BYTES.labels(model=model.name).set(model.hbm_bytes)
             if self.on_load is not None:
                 self.on_load(model)
@@ -190,8 +214,15 @@ class ModelHost:
         if self.on_evict is not None:
             self.on_evict(model)
         model.net = None  # drop the device buffers
+        try:
+            from deeplearning4j_tpu.observability import memory as _obsmem
+
+            _obsmem.unregister_tree(model.name)
+        except Exception:
+            pass
         _m.MODEL_HBM_BYTES.labels(model=model.name).set(0)
         _m.EVICTIONS.labels(model=model.name).inc()
+        model.hbm_source = "estimated"
         model.hbm_bytes = (estimate_checkpoint_bytes(model.path)
                            if model.path else 0)
 
@@ -207,6 +238,7 @@ class ModelHost:
                 "resident": m.resident,
                 "pinned": m.pinned,
                 "hbm_bytes": int(m.hbm_bytes),
+                "hbm_source": m.hbm_source,
                 "path": m.path,
                 "lm": m.scheduler is not None,
             } for m in self._models.values()]

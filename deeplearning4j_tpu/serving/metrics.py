@@ -22,7 +22,8 @@ REQUESTS_LEGACY = _obs.metrics.counter(
     label_names=("outcome",))
 REQ_LATENCY = _obs.metrics.histogram(
     "dl4j_request_latency_seconds",
-    "End-to-end predict() latency (queue wait + batch + forward)")
+    "End-to-end predict() latency (queue wait + batch + forward)",
+    buckets=_obs.WIDE_BUCKETS)
 BATCH_SIZE = _obs.metrics.histogram(
     "dl4j_serving_batch_size",
     "Real (pre-padding) rows per coalesced inference batch",
@@ -41,11 +42,11 @@ REQUEST_SECONDS = _obs.metrics.histogram(
     "dl4j_serving_request_seconds",
     "Per-model end-to-end request latency (SLO histogram: p50/p99 via "
     "bucket interpolation)",
-    label_names=("model", "route"))
+    label_names=("model", "route"), buckets=_obs.WIDE_BUCKETS)
 TTFT_SECONDS = _obs.metrics.histogram(
     "dl4j_serving_ttft_seconds",
     "Generation time-to-first-token: submit -> first sampled token",
-    label_names=("model",))
+    label_names=("model",), buckets=_obs.WIDE_BUCKETS)
 DECODE_STEP_SECONDS = _obs.metrics.histogram(
     "dl4j_serving_decode_step_seconds",
     "One continuous-batching decode step (all slots, one dispatch)",

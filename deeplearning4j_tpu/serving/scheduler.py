@@ -27,6 +27,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from deeplearning4j_tpu import observability as _obs
 from deeplearning4j_tpu.serving import metrics as _m
 from deeplearning4j_tpu.serving.errors import (
     InputValidationError,
@@ -233,6 +234,18 @@ class GenerationScheduler:
 
     def _loop(self) -> None:
         active: Dict[int, GenerationRequest] = {}
+        try:
+            self._loop_inner(active)
+        except Exception as e:
+            # Decode-loop death strands every active sequence: dump the
+            # flight bundle, fail the callers, then let the thread die.
+            _obs.flight.on_crash("serving.decode_loop", e)
+            for req in active.values():
+                req.error = f"{type(e).__name__}: {e}"
+                req.event.set()
+            raise
+
+    def _loop_inner(self, active: Dict[int, GenerationRequest]) -> None:
         free = list(reversed(range(self.slots)))
         busy_gauge = _m.DECODE_SLOTS_BUSY.labels(model=self.model_name)
         step_hist = _m.DECODE_STEP_SECONDS.labels(model=self.model_name)

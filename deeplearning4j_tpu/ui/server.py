@@ -596,6 +596,14 @@ class _Handler(BaseHTTPRequestHandler):
             from deeplearning4j_tpu import observability as obs
 
             self._json(obs.tracer.export_chrome())
+        elif url.path == "/api/flight":
+            from deeplearning4j_tpu import observability as obs
+
+            self._json(obs.flight.status())
+        elif url.path == "/api/memory":
+            from deeplearning4j_tpu.observability import memory as obsmem
+
+            self._json(obsmem.report())
         elif url.path == "/api":
             self._json({"routes": _ROUTES})
         else:
@@ -606,8 +614,8 @@ class _Handler(BaseHTTPRequestHandler):
 _ROUTES = [
     "/", "/histogram", "/model", "/system", "/flow", "/tsne",
     "/activations", "/metrics", "/api", "/api/sessions", "/api/static",
-    "/api/updates", "/api/tsne", "/api/trace", "POST /remote",
-    "POST /api/tsne",
+    "/api/updates", "/api/tsne", "/api/trace", "/api/flight", "/api/memory",
+    "POST /remote", "POST /api/tsne",
 ]
 
 

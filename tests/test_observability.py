@@ -734,8 +734,26 @@ class TestStepProfilerAcceptance:
                 "dl4j_serving_batch_size_bucket",
                 'dl4j_jit_cache_misses_total{engine="mln"}',
                 "dl4j_train_flops_per_step",
+                "dl4j_program_hbm_bytes",                 # static HBM gauges
+                "dl4j_input_wait_seconds_bucket",         # starvation split
         ):
             assert needle in scrape, f"missing {needle} in /metrics"
+
+        # Bucket-ladder audit: every histogram family with observations must
+        # resolve the majority of them inside its finite ladder — a family
+        # whose observations mostly clamp into +Inf is measuring nothing.
+        for name, fam in obs.metrics.to_json().items():
+            if fam["type"] != "histogram":
+                continue
+            for series in fam["series"]:
+                count = series["count"]
+                if not count:
+                    continue
+                finite = max(series["buckets"].values(), default=0)
+                assert count - finite <= count / 2, (
+                    f"{name}{series['labels']}: {count - finite}/{count} "
+                    "observations beyond the largest finite bucket — widen "
+                    "the ladder (WIDE_BUCKETS)")
 
         doc = json.loads(json.dumps(obs.tracer.export_chrome()))
         events = doc["traceEvents"]
@@ -767,9 +785,17 @@ class TestUIServerObsRoutes:
             status, body = _http_get(base + "/api/trace")
             doc = json.loads(body)
             assert any(e["name"] == "ui.probe" for e in doc["traceEvents"])
+            status, body = _http_get(base + "/api/flight")
+            flight = json.loads(body)
+            assert {"enabled", "capacity", "records",
+                    "dump_dir"} <= set(flight)
+            status, body = _http_get(base + "/api/memory")
+            memdoc = json.loads(body)
+            assert {"programs", "live"} <= set(memdoc)
             status, body = _http_get(base + "/api")
             routes = json.loads(body)["routes"]
             assert "/metrics" in routes and "/api/trace" in routes
+            assert "/api/flight" in routes and "/api/memory" in routes
             with pytest.raises(urllib.error.HTTPError) as ei:
                 urllib.request.urlopen(base + "/definitely/not/a/route",
                                        timeout=5)

@@ -404,12 +404,86 @@ def probe(fn, x):
         assert [f for f in lint_file(str(p)) if f.rule == "JX007"] == []
 
 
+class TestJX008MetricsInHotPath:
+    def test_family_creation_in_jit_reachable_fires(self):
+        src = """
+import jax
+from deeplearning4j_tpu import observability as obs
+
+@jax.jit
+def step(x):
+    obs.metrics.counter("dl4j_steps_total", "steps").inc()
+    return x + 1
+"""
+        fs = lint(src, ["JX008"])
+        assert rules_of(fs) == {"JX008"}
+        assert "jit-reachable" in fs[0].message
+
+    def test_family_creation_in_loop_fires(self):
+        src = """
+from deeplearning4j_tpu import observability as obs
+
+def train(batches):
+    for b in batches:
+        h = obs.metrics.histogram("dl4j_lat_seconds", "latency")
+        h.observe(0.1)
+"""
+        fs = lint(src, ["JX008"])
+        assert rules_of(fs) == {"JX008"}
+        assert "per-iteration loop" in fs[0].message
+
+    def test_self_registry_receiver_fires(self):
+        src = """
+class Worker:
+    def run(self, items):
+        while items:
+            self._reg.gauge("dl4j_depth", "queue depth").set(len(items))
+            items.pop()
+"""
+        fs = lint(src, ["JX008"])
+        assert rules_of(fs) == {"JX008"}
+
+    def test_module_level_and_cached_child_are_clean(self):
+        src = """
+from deeplearning4j_tpu import observability as obs
+
+_M_STEPS = obs.metrics.counter("dl4j_steps_total", "steps",
+                               label_names=("engine",)).labels(engine="mln")
+
+def train(batches):
+    for b in batches:
+        _M_STEPS.inc()
+"""
+        assert lint(src, ["JX008"]) == []
+
+    def test_non_registry_receiver_is_clean(self):
+        # `.counter(...)` on something that does not look like a registry
+        # (e.g. a collections.Counter factory) must not fire
+        src = """
+def tally(conn, rows):
+    for r in rows:
+        conn.counter("hits").bump()
+"""
+        assert lint(src, ["JX008"]) == []
+
+    def test_one_shot_function_registration_is_clean(self):
+        # straight-line registration in a setup function: neither jit-
+        # reachable nor looped
+        src = """
+from deeplearning4j_tpu import observability as obs
+
+def install(reg):
+    return reg.histogram("dl4j_lat_seconds", "latency")
+"""
+        assert lint(src, ["JX008"]) == []
+
+
 # ------------------------------------------------------------ framework
 
 class TestLinterFramework:
     def test_registry_has_all_rules(self):
         assert set(ALL_RULES) >= {"JX001", "JX002", "JX003", "JX004",
-                                  "JX005", "JX006", "JX007"}
+                                  "JX005", "JX006", "JX007", "JX008"}
 
     def test_findings_are_typed_and_sorted(self):
         src = """
