@@ -147,12 +147,18 @@ def _timed_fit(net, make_batch, batch, steps, warmup, distinct=4, cached=False):
 
     rng = np.random.RandomState(0)
     pool = [make_batch(rng, batch) for _ in range(distinct)]
+    # DtypePolicy transfer knob: when the net's policy names a transfer
+    # dtype, the staging iterators cast floating features/labels host-side
+    # before the put — the link carries bf16, not f32 (PERF.md §17; this
+    # replaces the r05-era ad-hoc ml_dtypes cast inside make_batch).
+    tdt = getattr(getattr(net, "dtype_policy", None), "transfer_dtype", None)
 
     def batches(n):
         return [DataSet(*pool[i % distinct]) for i in range(n)]
 
     if cached:
-        it = DeviceCacheDataSetIterator(batches(distinct))
+        it = DeviceCacheDataSetIterator(batches(distinct),
+                                        transfer_dtype=tdt)
         epochs = max(1, steps // distinct)
         net.fit(it)  # stages the cache + compiles
         _ = net.score_value
@@ -164,10 +170,12 @@ def _timed_fit(net, make_batch, batch, steps, warmup, distinct=4, cached=False):
         n_steps = epochs * distinct
         return batch * n_steps / dt, dt / n_steps
 
-    net.fit(AsyncDataSetIterator(batches(max(warmup, 2)), queue_size=4))
+    net.fit(AsyncDataSetIterator(batches(max(warmup, 2)), queue_size=4,
+                                 transfer_dtype=tdt))
     _ = net.score_value
     t0 = time.perf_counter()
-    net.fit(AsyncDataSetIterator(batches(steps), queue_size=4))
+    net.fit(AsyncDataSetIterator(batches(steps), queue_size=4,
+                                 transfer_dtype=tdt))
     _ = net.score_value
     dt = time.perf_counter() - t0
     return batch * steps / dt, dt / steps
@@ -839,21 +847,23 @@ def bench_serving_slo(steps, warmup):
 
 
 def bench_resnet50(steps, warmup):
-    import ml_dtypes
-
     from deeplearning4j_tpu.models.resnet import resnet50
     from deeplearning4j_tpu.nn.graph import ComputationGraph
 
     batch = int(os.environ.get("BENCH_BATCH_RESNET50", "256"))
     image = int(os.environ.get("BENCH_IMAGE_RESNET50", "224"))
-    net = ComputationGraph(
-        resnet50(n_classes=1000, image=image, dtype="bfloat16")
-    ).init()
+    conf = resnet50(n_classes=1000, image=image, dtype="bfloat16")
+    # The r05 ad-hoc `x.astype(ml_dtypes.bfloat16)` in the batch maker is
+    # now the policy's transfer_dtype knob: batches stay f32 host-side and
+    # the staging iterators (_timed_fit reads net.dtype_policy) cast before
+    # the put, so the link carries bf16 for every config that opts in.
+    conf.global_conf.dtype_policy = {"name": "mixed_bfloat16",
+                                     "transfer_dtype": "bfloat16"}
+    net = ComputationGraph(conf).init()
 
     def mk(rng, b):
         x = rng.rand(b, image, image, 3).astype("float32")
-        return (x.astype(ml_dtypes.bfloat16),
-                np.eye(1000, dtype="float32")[rng.randint(0, 1000, b)])
+        return (x, np.eye(1000, dtype="float32")[rng.randint(0, 1000, b)])
 
     # Headline: device-resident dataset through the public fit() path
     # (DeviceCacheDataSetIterator — see PERF.md: the tunneled transport
@@ -910,6 +920,130 @@ def bench_resnet50(steps, warmup):
     return head, extra_metrics
 
 
+def bench_resnet50_bf16(steps, warmup):
+    """A/B the DtypePolicy on the same model: full-f32 vs mixed_bfloat16
+    with bf16 transfer staging. Reports the bf16 training throughput, the
+    speedup over f32, and the measured h2d byte ratio (the transfer knob
+    should halve the feature bytes on the link: f32 -> bf16)."""
+    from deeplearning4j_tpu import observability as obs
+    from deeplearning4j_tpu.models.resnet import resnet50
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    batch = int(os.environ.get("BENCH_BATCH_RESNET50_BF16", "64"))
+    image = int(os.environ.get("BENCH_IMAGE_RESNET50_BF16", "96"))
+
+    def mk(rng, b):
+        x = rng.rand(b, image, image, 3).astype("float32")
+        return (x, np.eye(1000, dtype="float32")[rng.randint(0, 1000, b)])
+
+    def h2d_total():
+        fam = obs.metrics.get_family("dl4j_host_to_device_bytes_total")
+        if fam is None:
+            return 0.0
+        return float(sum(c.get() for c in fam.children()))
+
+    def run_arm(policy):
+        conf = resnet50(n_classes=1000, image=image, dtype="float32")
+        if policy is not None:
+            conf.global_conf.dtype_policy = policy
+        net = ComputationGraph(conf).init()
+        sps, _ = _timed_fit(net, mk, batch, steps, warmup, distinct=2,
+                            cached=True)
+        # Spot check for the link bytes: feed host batches straight to
+        # fit() — the dispatch choke point applies the policy's transfer
+        # cast, so the h2d counter sees the bytes actually shipped.
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        rng = np.random.RandomState(0)
+        before = h2d_total()
+        for _ in range(2):
+            net.fit(DataSet(*mk(rng, batch)))
+        per_batch = (h2d_total() - before) / 2
+        return sps, per_batch
+
+    f32_sps, f32_bytes = run_arm(None)
+    bf16_sps, bf16_bytes = run_arm({"name": "mixed_bfloat16",
+                                    "transfer_dtype": "bfloat16"})
+    head = _entry("resnet50_bf16_fit_samples_per_sec_per_chip", bf16_sps,
+                  "samples/sec/chip")
+    head["vs_f32_same_run"] = round(bf16_sps / max(f32_sps, 1e-9), 2)
+    head["h2d_bytes_ratio_vs_f32"] = round(
+        bf16_bytes / max(f32_bytes, 1e-9), 3)
+    return head
+
+
+def bench_lm_int8_serving(steps, warmup):
+    """Post-training int8 serving: quantize a checkpointed transformer LM
+    (checkpoint/quantize.py), serve it through the batcher, and report
+    predict p50/p99 plus the measured HBM ratio vs the f32 original and
+    the output parity error."""
+    import shutil
+    import tempfile
+    import threading
+
+    from deeplearning4j_tpu import observability as obs
+    from deeplearning4j_tpu.checkpoint import (
+        quantize_checkpoint,
+        restore_checkpoint,
+        save_checkpoint,
+    )
+    from deeplearning4j_tpu.models.zoo import transformer_lm
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.serving import InferenceServer
+    from deeplearning4j_tpu.serving.host import estimate_hbm_bytes
+
+    V = 256
+    net = ComputationGraph(transformer_lm(
+        vocab_size=V, t=64, d_model=128, n_heads=4, n_blocks=2)).init()
+    tmp = tempfile.mkdtemp(prefix="bench_int8_")
+    try:
+        src = os.path.join(tmp, "step_00000001")
+        dst = os.path.join(tmp, "int8")
+        save_checkpoint(net, src)
+        quantize_checkpoint(src, dst)
+        qnet = restore_checkpoint(dst)
+        hbm_ratio = estimate_hbm_bytes(qnet) / max(estimate_hbm_bytes(net),
+                                                   1)
+        rng = np.random.RandomState(0)
+        rows = rng.randint(1, V, (max(16, steps), 8)).astype(np.int32)
+        ref = np.asarray(net.output(rows[:8]))
+        got = np.asarray(qnet.output(rows[:8]))
+        parity = float(np.max(np.abs(ref - got)))
+
+        server = InferenceServer(qnet, default_model="lm_int8",
+                                 max_batch_size=8, max_delay_ms=1.0)
+        server.models.get("lm_int8").batcher.warm()
+        errors = []
+
+        def client(i):
+            try:
+                server.predict(rows[i:i + 1])
+            except Exception as e:
+                errors.append(f"{type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(max(16, steps))]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        server.stop()
+        if errors:
+            raise RuntimeError(f"lm_int8_serving bench: {errors[:3]}")
+        lat = obs.metrics.get_family(
+            "dl4j_serving_request_seconds").labels(
+                model="lm_int8", route="predict").summarize(
+                    quantiles=(0.5, 0.99))
+        head = _entry("lm_int8_predict_p99_ms", lat.get("p99", 0.0) * 1e3,
+                      "ms")
+        head["p50_ms"] = round(lat.get("p50", 0.0) * 1e3, 2)
+        head["hbm_ratio_vs_f32"] = round(hbm_ratio, 3)
+        head["parity_max_abs_err"] = round(parity, 5)
+        return head
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main():
     # Compile-time accounting for the self-attribution snapshot in _emit():
     # every XLA compile during the run lands in dl4j_xla_compile_* counters.
@@ -920,9 +1054,9 @@ def main():
     warmup = int(os.environ.get("BENCH_WARMUP", "5"))
     configs = os.environ.get(
         "BENCH_CONFIGS",
-        "resnet50,lenet,char_rnn,lenet_step,lenet_superstep,lenet_cold_warm,"
-        "word2vec,vgg16,flash_attn,flash_tri,transformer,serving_slo,"
-        "obs_overhead"
+        "resnet50,resnet50_bf16,lenet,char_rnn,lenet_step,lenet_superstep,"
+        "lenet_cold_warm,word2vec,vgg16,flash_attn,flash_tri,transformer,"
+        "serving_slo,lm_int8_serving,obs_overhead"
     ).split(",")
 
     head, extra = None, {}
@@ -966,9 +1100,15 @@ def main():
     if "transformer" in configs:
         e = bench_transformer(steps, warmup)
         extra[e["metric"]] = e
+    if "resnet50_bf16" in configs:
+        e = bench_resnet50_bf16(max(8, steps // 3), warmup)
+        extra[e["metric"]] = e
     if "serving_slo" in configs:
         for e in bench_serving_slo(steps, warmup):
             extra[e["metric"]] = e
+    if "lm_int8_serving" in configs:
+        e = bench_lm_int8_serving(steps, warmup)
+        extra[e["metric"]] = e
     if "obs_overhead" in configs:
         e = bench_obs_overhead(steps, warmup)
         extra[e["metric"]] = e

@@ -616,3 +616,62 @@ class MetricsInHotPathRule(Rule):
                 "(registry lock + dict lookups per step"
                 + (", and a trace-time-only side effect under jit"
                    if in_jit else "") + ")")
+
+
+@register_rule
+class HardcodedComputeDtypeRule(Rule):
+    """JX009: hardcoded float32 compute dtype in layer forward code.
+
+    Layer kernels (`nn/layers/`) receive params already cast to the
+    model's DtypePolicy compute dtype (`nn/params.prep_layer_params`); a
+    literal `jnp.float32` / `astype(jnp.float32)` / `dtype='float32'`
+    inside them silently pins that op back to f32, defeating
+    `mixed_bfloat16` (the cast re-materializes f32 copies and the MXU
+    runs the wide path). The sanctioned idiom for accumulator widening is
+    `jnp.promote_types(x.dtype, jnp.float32)` — it WIDENS relative to the
+    incoming dtype instead of pinning it, so bf16 inputs still get f32
+    accumulation without forcing f32 math elsewhere — and is exempt, as
+    is anything under an explicit `# tpulint: disable=JX009` with the
+    reason on the line.
+    """
+
+    id = "JX009"
+    description = ("hardcoded float32 literal / astype in nn/layers/ "
+                   "forward code (defeats DtypePolicy compute dtype)")
+
+    def _in_promote_types(self, ctx, node) -> bool:
+        for anc in ctx.ancestors(node):
+            if (isinstance(anc, ast.Call)
+                    and isinstance(anc.func, ast.Attribute)
+                    and anc.func.attr == "promote_types"):
+                return True
+        return False
+
+    def check(self, ctx):
+        rel = ctx.rel.replace("\\", "/")
+        if "nn/layers/" not in rel:
+            return
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Attribute)
+                    and node.attr in ("float32", "float16")
+                    and attr_base(node)
+                    in ctx.jnp_aliases | ctx.numpy_aliases):
+                if self._in_promote_types(ctx, node):
+                    continue  # accumulator widening: the sanctioned idiom
+                yield self.finding(
+                    ctx, node,
+                    f"hardcoded `{attr_base(node)}.{node.attr}` in a layer "
+                    "kernel pins the op to one dtype and defeats the "
+                    "model's DtypePolicy compute dtype — derive the dtype "
+                    "from the incoming arrays (x.dtype) or widen with "
+                    "jnp.promote_types(x.dtype, jnp.float32)")
+            elif isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    if (kw.arg == "dtype"
+                            and isinstance(kw.value, ast.Constant)
+                            and kw.value.value in ("float32", "float16")):
+                        yield self.finding(
+                            ctx, kw.value,
+                            f"dtype={kw.value.value!r} string literal in a "
+                            "layer kernel defeats the DtypePolicy compute "
+                            "dtype — derive it from the incoming arrays")

@@ -23,6 +23,10 @@ from deeplearning4j_tpu.checkpoint.array_store import (
 )
 from deeplearning4j_tpu.checkpoint.legacy import load_any, migrate_zip
 from deeplearning4j_tpu.checkpoint.manager import CheckpointManager
+from deeplearning4j_tpu.checkpoint.quantize import (
+    quantize_checkpoint,
+    quantize_net,
+)
 from deeplearning4j_tpu.checkpoint.store import (
     is_sharded_checkpoint,
     restore_checkpoint,
@@ -37,6 +41,8 @@ __all__ = [
     "is_sharded_checkpoint",
     "load_any",
     "migrate_zip",
+    "quantize_checkpoint",
+    "quantize_net",
     "restore_checkpoint",
     "save_checkpoint",
     "verify_checkpoint",

@@ -33,6 +33,18 @@ class CheckpointCorruptError(CheckpointError):
     missing file, uncovered region, no COMMIT manifest)."""
 
 
+def resolve_dtype(s: str) -> np.dtype:
+    """np.dtype from a saved dtype string, including the ml_dtypes names
+    (bfloat16 etc.) a plain `np.dtype(str)` can't parse — bf16-param
+    checkpoints (DtypePolicy `bfloat16`/`float16` presets) store those."""
+    try:
+        return np.dtype(s)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, s))
+
+
 def leaf_chunks(arr) -> Iterator[Tuple[Tuple[Tuple[int, int], ...], np.ndarray]]:
     """Yield `(index, data)` for each DISTINCT shard region of `arr`:
     `index` is a `((start, stop), ...)` interval per dimension into the
@@ -116,7 +128,7 @@ def read_region(dirpath: str, entry: dict, region) -> np.ndarray:
     `jax.make_array_from_callback` hands the per-device callback). Raises
     `CheckpointCorruptError` if the chunks don't fully cover the region."""
     shape = tuple(entry["shape"])
-    dtype = np.dtype(entry["dtype"])
+    dtype = resolve_dtype(entry["dtype"])
     if not shape:
         return _open_chunk(dirpath, entry["chunks"][0], dtype).copy()
     region = tuple(sl.indices(dim) for sl, dim in zip(region, shape))

@@ -1,0 +1,161 @@
+"""Post-training int8 quantization of committed checkpoints (serving).
+
+`quantize_checkpoint(src, dst)` reads a committed sharded checkpoint and
+writes a NEW committed checkpoint whose eligible weight leaves are stored
+as int8 plus a per-channel f32 scale:
+
+    W  (float32 [in, out])  ->  W        (int8 [in, out])
+                                W__scale (float32 [out])
+
+Scheme: per-channel symmetric over the LAST axis — the output-channel axis
+for both Dense ([in, out]) and Conv HWIO ([kh, kw, cin, cout]) layouts, so
+one scale per output unit. `scale = max|W| / 127` per channel,
+`q = clip(round(W / scale), -127, 127)`. Eligible leaves are floating
+matrices/tensors (ndim >= 2); biases, gains, and BN running stats stay f32
+(negligible bytes, disproportionate accuracy cost).
+
+The quantized checkpoint is a SERVING artifact: updater state is dropped
+(you don't resume Adam from int8 weights), and `meta["quantization"]`
+marks it so `restore_checkpoint` assembles the params tree from the index
+(the f32 template can't pattern-match the extra `__scale` leaves) and so
+`serving/host.py` can report the dtype without loading weights. At
+inference the int8 tensors live in HBM as-is — ~4x smaller than f32 — and
+`nn/params.prep_layer_params` dequantizes `q * scale` at the compute
+dtype, fused by XLA into the consuming matmul/conv.
+
+CLI:  python -m deeplearning4j_tpu.checkpoint.quantize <src_step_dir> <dst_step_dir>
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.checkpoint import store as store_mod
+from deeplearning4j_tpu.checkpoint.array_store import (
+    CheckpointError,
+    leaf_chunks,
+    read_full,
+)
+
+INT8_SCHEME = "int8_per_channel_symmetric"
+SCALE_SUFFIX = "__scale"
+
+
+def quantize_array(w: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-channel symmetric int8 over the last axis; returns (q, scale)
+    with `scale` shaped (w.shape[-1],). All-zero channels get scale 1.0
+    (q is zero there anyway) so dequant never divides by zero."""
+    w = np.asarray(w, np.float32)
+    reduce_axes = tuple(range(w.ndim - 1))
+    amax = np.max(np.abs(w), axis=reduce_axes) if reduce_axes else np.abs(w)
+    scale = np.where(amax > 0.0, amax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.rint(w / scale), -127, 127).astype(np.int8)
+    return q, scale
+
+
+def _eligible(arr: np.ndarray) -> bool:
+    return arr.ndim >= 2 and np.issubdtype(arr.dtype, np.floating)
+
+
+def quantize_tree(params: Dict[str, Any]) -> Dict[str, Any]:
+    """In-memory variant: quantize a `{layer: {name: array}}` params tree.
+    Eligible leaves become int8 with a `<name>__scale` sibling; everything
+    else passes through as f32 host arrays."""
+    out: Dict[str, Any] = {}
+    for k, v in params.items():
+        if isinstance(v, dict):
+            out[k] = quantize_tree(v)
+            continue
+        a = np.asarray(v)
+        if _eligible(a):
+            q, scale = quantize_array(a)
+            out[k] = q
+            out[k + SCALE_SUFFIX] = scale
+        elif np.issubdtype(a.dtype, np.floating):
+            out[k] = a.astype(np.float32)
+        else:
+            out[k] = a
+    return out
+
+
+def quantize_net(net):
+    """Quantize a live net's params IN PLACE for serving (the checkpoint
+    path is `quantize_checkpoint`; this covers bench/eval flows that never
+    touch disk). Training after this is undefined — serve only."""
+    import jax.numpy as jnp
+
+    q = quantize_tree(net.params_tree)
+    net.params_tree = {
+        lk: {pn: jnp.asarray(a) for pn, a in lp.items()}
+        for lk, lp in q.items()
+    }
+    net._jit_cache = {}
+    return net
+
+
+def quantize_checkpoint(src: str, dst: str,
+                        meta_extra: Optional[dict] = None) -> str:
+    """Read the committed checkpoint at `src`, write the int8-quantized
+    serving checkpoint at `dst` (same atomic commit protocol). Returns
+    `dst`."""
+    src, dst = str(src), str(dst)
+    store_mod.verify_checkpoint(src)
+    meta = store_mod.read_meta(src)
+    index = store_mod.read_index(src)
+    if meta.get("quantization"):
+        raise CheckpointError(f"{src} is already quantized")
+
+    leaves = []
+    n_quant = 0
+
+    def add(key: str, arr: np.ndarray) -> None:
+        chunks = list(leaf_chunks(arr))
+        leaves.append({"key": key, "shape": tuple(arr.shape),
+                       "dtype": str(arr.dtype), "chunks": chunks})
+
+    for key, entry in index["leaves"].items():
+        if key.startswith(store_mod._UPDATER + "/"):
+            continue  # serving artifact: optimizer state dropped
+        arr = read_full(src, entry)
+        if key.startswith(store_mod._PARAMS + "/") and _eligible(arr):
+            q, scale = quantize_array(arr)
+            add(key, q)
+            add(key + SCALE_SUFFIX, scale)
+            n_quant += 1
+        else:
+            arr = np.asarray(arr)
+            if np.issubdtype(arr.dtype, np.floating):
+                arr = arr.astype(np.float32)
+            add(key, arr)
+
+    meta = dict(meta)
+    meta["quantization"] = {
+        "scheme": INT8_SCHEME,
+        "axis": "last",
+        "quantized_leaves": n_quant,
+    }
+    meta.pop("dtype_policy", None)  # weights are int8 now, not policy-typed
+    if meta_extra:
+        meta.update(meta_extra)
+    return store_mod.write_snapshot({"leaves": leaves, "meta": meta}, dst)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m deeplearning4j_tpu.checkpoint.quantize",
+        description="Post-training int8 quantization of a committed "
+                    "checkpoint (per-channel symmetric, serving-only).")
+    ap.add_argument("src", help="committed checkpoint step directory")
+    ap.add_argument("dst", help="output directory for the int8 checkpoint")
+    args = ap.parse_args(argv)
+    out = quantize_checkpoint(args.src, args.dst)
+    print(out)
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via CLI test
+    raise SystemExit(main())

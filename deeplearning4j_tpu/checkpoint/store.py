@@ -44,6 +44,7 @@ from deeplearning4j_tpu.checkpoint.array_store import (
     leaf_chunks,
     read_full,
     read_region,
+    resolve_dtype,
     write_leaf,
     _fsync_write,
 )
@@ -121,18 +122,23 @@ def snapshot_net(net) -> Dict[str, Any]:
                 "dtype": str(chunks[0][1].dtype),
                 "chunks": chunks,
             })
-    return {
-        "leaves": leaves,
-        "meta": {
-            "format": FORMAT,
-            "version": VERSION,
-            "engine": type(net).__name__,
-            "conf_json": net.conf.to_json(),
-            "iteration": int(net.iteration),
-            "epoch": int(net.epoch),
-            "rng": np.asarray(_current_rng_key(net)).tolist(),
-        },
+    meta = {
+        "format": FORMAT,
+        "version": VERSION,
+        "engine": type(net).__name__,
+        "conf_json": net.conf.to_json(),
+        "iteration": int(net.iteration),
+        "epoch": int(net.epoch),
+        "rng": np.asarray(_current_rng_key(net)).tolist(),
     }
+    pol = getattr(net, "dtype_policy", None)
+    if pol is not None and not pol.is_default:
+        # Emitted only for non-default policies so default-policy checkpoint
+        # bytes (and golden-checkpoint tests) are unchanged. The restore
+        # side uses this for the policy-mismatch guard; conf_json carries
+        # the same policy for `net=None` rebuilds.
+        meta["dtype_policy"] = pol.to_dict()
+    return {"leaves": leaves, "meta": meta}
 
 
 def _fsync_dir(path: str) -> None:
@@ -258,11 +264,32 @@ def _build_net(meta: dict):
     return MultiLayerNetwork(conf).init()
 
 
-def _make_leaf(base: str, entry: dict, like, sharding):
-    """One restored leaf, cast to the target leaf's dtype and placed in the
-    target sharding. With a sharding, each device's region is read straight
-    from the overlapping chunks; without one, the leaf is assembled on host
-    and handed to the default device."""
+def _check_leaf_dtype(key: str, entry: dict, like) -> np.dtype:
+    """Restore-time dtype contract: f32<->f64 coercion (the pre-policy
+    elastic-restore behavior) stays silent; any mismatch involving a
+    low-precision float (bf16/f16) or an integer (quantized) leaf raises —
+    restoring a bf16-param checkpoint onto a default-policy net must be an
+    explicit decision (`.dtype_policy(...)` on the target), never a silent
+    upcast that doubles HBM and quietly changes serving numerics."""
+    saved = str(entry["dtype"])
+    tgt = getattr(like, "dtype", None)
+    target = saved if tgt is None else str(tgt)
+    if saved != target and not ({saved, target} <= {"float32", "float64"}):
+        raise CheckpointError(
+            f"leaf {key!r} dtype mismatch: checkpoint stores {saved}, "
+            f"target net expects {target} — the checkpoint was saved under "
+            "a different dtype policy (or post-training-quantized); build "
+            "the target net with a matching .dtype_policy(...) (or restore "
+            "with net=None to rebuild from the checkpoint's own config) "
+            "instead of relying on a silent cast")
+    return resolve_dtype(target)
+
+
+def _make_leaf(base: str, entry: dict, like, sharding, key: str = "?"):
+    """One restored leaf, placed in the target sharding. With a sharding,
+    each device's region is read straight from the overlapping chunks;
+    without one, the leaf is assembled on host and handed to the default
+    device. Dtype coercion is policed by `_check_leaf_dtype`."""
     import jax
     import jax.numpy as jnp
 
@@ -271,7 +298,7 @@ def _make_leaf(base: str, entry: dict, like, sharding):
         raise CheckpointError(
             f"leaf shape mismatch: checkpoint has {shape}, target net has "
             f"{tuple(np.shape(like))} — config/topology differs")
-    dtype = np.dtype(str(getattr(like, "dtype", entry["dtype"])))
+    dtype = _check_leaf_dtype(key, entry, like)
     if sharding is not None and shape:
         return jax.make_array_from_callback(
             shape, sharding,
@@ -301,8 +328,55 @@ def _restore_tree(tree, prefix: str, index: dict, base: str, shardings):
             raise CheckpointError(
                 f"checkpoint at {base} has no leaf {key!r} — was it saved "
                 "from a different model config?")
-        out.append(_make_leaf(base, entries[key], like, sh))
+        out.append(_make_leaf(base, entries[key], like, sh, key=key))
     return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _assemble_params_from_index(index: dict, base: str):
+    """Params tree taken structurally from the INDEX (not the target net's
+    init template): a quantized checkpoint stores int8 leaves plus
+    `<name>__scale` companions the f32 template doesn't have, so the
+    template-matching `_restore_tree` can't apply. Leaves keep their stored
+    dtypes (int8 weights stay int8 in HBM — that IS the serving win;
+    `nn/params.prep_layer_params` dequantizes at use)."""
+    import jax.numpy as jnp
+
+    params: Dict[str, Any] = {}
+    for key, entry in index["leaves"].items():
+        if not key.startswith(_PARAMS + "/"):
+            continue
+        node = params
+        parts = key.split("/")[1:]
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        arr = read_full(base, entry)
+        node[parts[-1]] = jnp.asarray(
+            np.asarray(arr, dtype=resolve_dtype(str(entry["dtype"]))))
+    return params
+
+
+def _check_policy_match(meta: dict, net, path: str) -> None:
+    """Fail fast (before any chunk I/O) when the checkpoint's saved dtype
+    policy stores params in a different dtype than the explicit target
+    net expects — the per-leaf `_check_leaf_dtype` would catch it anyway,
+    but this names the actual mismatch: the POLICY."""
+    saved = meta.get("dtype_policy")
+    if saved is None:
+        return
+    from deeplearning4j_tpu.nn.conf.dtype_policy import DtypePolicy
+
+    saved_pol = DtypePolicy.of(saved)
+    target = getattr(net, "dtype_policy", None) or DtypePolicy()
+    if saved_pol.resolved_param_dtype != target.resolved_param_dtype:
+        raise CheckpointError(
+            f"{path} was saved under dtype policy "
+            f"{saved_pol.name!r} (params stored as "
+            f"{saved_pol.resolved_param_dtype}), but the target net's "
+            f"policy {target.name!r} expects "
+            f"{target.resolved_param_dtype} params — refusing to silently "
+            "cast. Build the target with "
+            f".dtype_policy({saved_pol.name!r}) or restore with net=None "
+            "to rebuild from the checkpoint's own config.")
 
 
 def restore_checkpoint(path: str, net=None, mesh=None,
@@ -329,10 +403,25 @@ def restore_checkpoint(path: str, net=None, mesh=None,
     if context is not None:
         mesh = context.mesh
         model_axis = context.model_axis
+    if net is not None:
+        _check_policy_match(meta, net, path)
     if net is None:
         net = _build_net(meta)
     elif not net._initialized:
         net.init()
+
+    if meta.get("quantization"):
+        # Quantized serving checkpoint: int8 leaves + `__scale` companions
+        # don't pattern-match the f32 init template, so the params tree is
+        # assembled structurally from the index (dtypes preserved — the
+        # int8 weights ARE the HBM savings). Updater state was dropped at
+        # quantize time; BN running stats restore normally below.
+        net.params_tree = _assemble_params_from_index(index, path)
+        if net.state:
+            net.state = _restore_tree(net.state, _STATE, index, path, None)
+        net.iteration = int(meta.get("iteration", 0))
+        net.epoch = int(meta.get("epoch", 0))
+        return net
 
     p_sh = u_sh = s_sh = None
     if mesh is not None:

@@ -133,8 +133,64 @@ def _stage_arrays(parts: Sequence[np.ndarray]) -> List:
     return [jax.device_put(p) for p in parts]
 
 
-def stage_to_device(ds: DataSet) -> DataSet:
-    """Transfer one DataSet's arrays host->device (see _stage_arrays)."""
+def _np_transfer_dtype(transfer_dtype):
+    """Resolve a DtypePolicy `transfer_dtype` string to a numpy dtype
+    (bf16 via ml_dtypes). None passes through (no cast)."""
+    if transfer_dtype is None:
+        return None
+    s = str(transfer_dtype)
+    if s in ("bfloat16", "bf16"):
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    if s in ("float16", "f16", "fp16"):
+        return np.dtype(np.float16)
+    return np.dtype(s)
+
+
+def transfer_cast(item, transfer_dtype):
+    """Cast a batch's floating features/labels HOST-SIDE to the policy's
+    `transfer_dtype` before staging — the generalized BENCH_r05 streaming
+    cast: bytes over the host->device link halve (f32 -> bf16) and the
+    `dl4j_host_to_device_bytes_total` counters record the reduced size.
+    Masks and integer parts (embedding ids, image bytes) are untouched;
+    already-staged device arrays pass through (their transfer is sunk)."""
+    dt = _np_transfer_dtype(transfer_dtype)
+    if dt is None:
+        return item
+
+    def cast(a):
+        if (isinstance(a, np.ndarray)
+                and np.issubdtype(a.dtype, np.floating) and a.dtype != dt):
+            return a.astype(dt)
+        return a
+
+    def host(a):
+        return a if hasattr(a, "dtype") else np.asarray(a)
+
+    if isinstance(item, MultiDataSet):
+        return MultiDataSet(
+            features=[cast(host(f)) for f in item.features],
+            labels=[cast(host(l)) for l in item.labels],
+            features_masks=item.features_masks,
+            labels_masks=item.labels_masks,
+        )
+    if isinstance(item, DataSet):
+        return DataSet(
+            cast(host(item.features)),
+            None if item.labels is None else cast(host(item.labels)),
+            item.features_mask,
+            item.labels_mask,
+        )
+    return item
+
+
+def stage_to_device(ds: DataSet, transfer_dtype=None) -> DataSet:
+    """Transfer one DataSet's arrays host->device (see _stage_arrays),
+    optionally casting floating features/labels to `transfer_dtype` first
+    so the link carries the reduced representation."""
+    if transfer_dtype is not None:
+        ds = transfer_cast(ds, transfer_dtype)
     parts = [np.asarray(ds.features)]
     idx = {"features": 0}
     for name in ("labels", "features_mask", "labels_mask"):
@@ -156,15 +212,17 @@ class AsyncDataSetIterator(DataSetIterator):
     `AsyncDataSetIterator.java` — the host-side I/O boundary of the fit()
     call stack, SURVEY.md §3.1)."""
 
-    def __init__(self, base: Iterable, queue_size: int = 4, device_prefetch: bool = True):
+    def __init__(self, base: Iterable, queue_size: int = 4, device_prefetch: bool = True,
+                 transfer_dtype=None):
         self.base = base
         self.queue_size = max(1, int(queue_size))
         self.device_prefetch = device_prefetch
+        self.transfer_dtype = transfer_dtype
 
     def _put(self, ds: DataSet) -> DataSet:
         if not self.device_prefetch:
-            return ds
-        return stage_to_device(ds)
+            return transfer_cast(ds, self.transfer_dtype)
+        return stage_to_device(ds, transfer_dtype=self.transfer_dtype)
 
     def __iter__(self):
         q: "queue.Queue" = queue.Queue(maxsize=self.queue_size)
@@ -245,9 +303,11 @@ class DeviceCacheDataSetIterator(DataSetIterator):
     streaming-scale data use AsyncDataSetIterator and accept the link cost.
     """
 
-    def __init__(self, base: Iterable, max_bytes: Optional[int] = None):
+    def __init__(self, base: Iterable, max_bytes: Optional[int] = None,
+                 transfer_dtype=None):
         self.base = base
         self.max_bytes = max_bytes
+        self.transfer_dtype = transfer_dtype
         self._cache: Optional[List[DataSet]] = None
         self._cache_bytes = 0
 
@@ -263,6 +323,7 @@ class DeviceCacheDataSetIterator(DataSetIterator):
             staged, total = [], 0
             try:
                 for ds in self.base:
+                    ds = transfer_cast(ds, self.transfer_dtype)
                     total += self._ds_bytes(ds)
                     if self.max_bytes is not None and total > self.max_bytes:
                         raise MemoryError(
@@ -556,7 +617,8 @@ class SuperbatchIterator(DataSetIterator):
     def __init__(self, base: Iterable, k: int,
                  max_bytes: Optional[int] = None, stage: bool = True,
                  cache: Optional[bool] = None,
-                 transform: Optional[Callable] = None):
+                 transform: Optional[Callable] = None,
+                 transfer_dtype=None):
         self.base = base
         self.k = max(1, int(k))
         if max_bytes is None:
@@ -567,6 +629,7 @@ class SuperbatchIterator(DataSetIterator):
         self.cache = (isinstance(base, DeviceCacheDataSetIterator)
                       if cache is None else bool(cache))
         self.transform = transform
+        self.transfer_dtype = transfer_dtype
         self._blocks: Optional[List] = None
         self._built_from: Any = None
 
@@ -593,6 +656,12 @@ class SuperbatchIterator(DataSetIterator):
             _M_INPUT_WAIT.observe(time.perf_counter() - t_wait)
             if self.transform is not None:
                 item = self.transform(item)
+            if self.transfer_dtype is not None:
+                # Cast BEFORE signature/stacking: the stacked superbatch is
+                # staged in the reduced dtype, so one tuple-put moves half
+                # the bytes (satellite of PERF.md §17; singleton fall-through
+                # blocks get the same treatment since the cast happens here).
+                item = transfer_cast(item, self.transfer_dtype)
             s = batch_signature(item)
             if buf and s != sig:
                 yield flush()

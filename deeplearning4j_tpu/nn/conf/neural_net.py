@@ -87,7 +87,12 @@ class GlobalConf:
     mini_batch: bool = True
     convolution_mode: Any = ConvolutionMode.TRUNCATE
     max_num_line_search_iterations: int = 5
-    dtype: str = "float32"  # compute/param dtype policy ("float32" | "bfloat16")
+    dtype: str = "float32"  # legacy dtype knob ("float32" | "bfloat16" | "float64")
+    # First-class precision policy (nn/conf/dtype_policy.py): a DtypePolicy,
+    # preset name, or dict. None = derive from the legacy `dtype` string.
+    # Serialized ONLY when set, so default conf JSON — and the AOT
+    # compile-cache fingerprints built from it — stay byte-identical.
+    dtype_policy: Any = None
     # Superstep training: fuse up to K train iterations into ONE device
     # dispatch (lax.scan over stacked batches; PERF.md §13). 0/1 = per-batch
     # dispatch. Overridable at runtime via DL4J_TPU_SUPERSTEP_K.
@@ -96,7 +101,12 @@ class GlobalConf:
     def to_dict(self):
         d = {}
         for k, v in self.__dict__.items():
-            if isinstance(v, Distribution):
+            if k == "dtype_policy":
+                if v is None:
+                    continue  # unset policy serializes to nothing (bit-compat)
+                from deeplearning4j_tpu.nn.conf.dtype_policy import DtypePolicy
+                v = DtypePolicy.of(v).to_dict()
+            elif isinstance(v, Distribution):
                 v = v.to_dict()
             elif hasattr(v, "value") and not isinstance(v, (int, float, bool)):
                 v = v.value
@@ -108,6 +118,9 @@ class GlobalConf:
         d = dict(d or {})
         if isinstance(d.get("dist"), dict):
             d["dist"] = Distribution.from_dict(d["dist"])
+        if d.get("dtype_policy") is not None:
+            from deeplearning4j_tpu.nn.conf.dtype_policy import DtypePolicy
+            d["dtype_policy"] = DtypePolicy.of(d["dtype_policy"])
         if d.get("lr_schedule"):
             d["lr_schedule"] = {int(k): float(v) for k, v in d["lr_schedule"].items()}
         g = GlobalConf()
@@ -169,6 +182,9 @@ class Builder:
     def max_num_line_search_iterations(self, v): self._g.max_num_line_search_iterations = int(v); return self
     def regularization(self, v=True): return self  # reference compat no-op: l1/l2 always honored
     def dtype(self, v): self._g.dtype = str(v); return self
+    def dtype_policy(self, v):
+        from deeplearning4j_tpu.nn.conf.dtype_policy import DtypePolicy
+        self._g.dtype_policy = DtypePolicy.of(v); return self
 
     def list(self) -> "ListBuilder":
         """Start a sequential-network config (reference `:200`)."""

@@ -31,6 +31,7 @@ from deeplearning4j_tpu.nn.conf.graph import (
     LastTimeStepVertex,
     LayerVertex,
 )
+from deeplearning4j_tpu.nn.conf.dtype_policy import resolve_policy
 from deeplearning4j_tpu.nn.conf.layers import is_bias_param
 from deeplearning4j_tpu.nn.conf.neural_net import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.conf import preprocessors as preprocessors_mod
@@ -46,6 +47,7 @@ from deeplearning4j_tpu.datasets.iterators import (
     Superbatch,
     SuperbatchIterator,
     maybe_reset,
+    transfer_cast,
 )
 from deeplearning4j_tpu import observability as _obs
 
@@ -124,12 +126,16 @@ class ComputationGraph:
         self._collect_stats = False
         self.last_training_stats: Dict[str, Any] = {}
         self._initialized = False
-        self._compute_dtype = {
-            "bfloat16": jnp.bfloat16, "float64": jnp.float64,
-        }.get(conf.global_conf.dtype, jnp.float32)
+        # Precision policy (nn/conf/dtype_policy.py): explicit `dtype_policy`
+        # wins, else the legacy `dtype` string maps onto the matching preset.
+        self.dtype_policy = resolve_policy(conf.global_conf)
+        self._compute_dtype = self.dtype_policy.jnp_compute
         self._loss_dtype = (
-            jnp.float64 if conf.global_conf.dtype == "float64" else jnp.float32
+            jnp.float64
+            if self.dtype_policy.resolved_param_dtype == "float64"
+            else jnp.float32
         )
+        self._output_dtype = self.dtype_policy.jnp_output
         self._jit_cache: Dict[Any, Any] = {}
         self._rnn_state: Dict[str, Any] = {}
         self._clock = None  # on-device (step, rng) carry; see _device_clock
@@ -151,15 +157,24 @@ class ComputationGraph:
 
     def init(self, params=None) -> "ComputationGraph":
         g = self.conf.global_conf
+        pol = self.dtype_policy
         root = jax.random.PRNGKey(g.seed)
-        pdt = jnp.float64 if g.dtype == "float64" else jnp.float32
+        # Low-precision param policies INITIALIZE at f32 (the master copy);
+        # stored params are its cast. See MultiLayerNetwork.init.
+        pdt = jnp.float32 if pol.low_precision_params else pol.jnp_param
         names = sorted(self.layer_vertices)
         keys = jax.random.split(root, max(len(names), 1))
+        master = None
         if params is None:
             params = {
                 name: params_mod.init_layer_params(self.layer_vertices[name].layer, keys[i], dtype=pdt)
                 for i, name in enumerate(names)
             }
+            if pol.low_precision_params:
+                master = params
+                params = params_mod.cast_floating(params, pol.jnp_param)
+        elif pol.low_precision_params:
+            master = params_mod.cast_floating(params, jnp.float32)
         self.params_tree = params
         self.state = {
             name: params_mod.init_layer_state(v.layer, dtype=pdt)
@@ -184,10 +199,19 @@ class ComputationGraph:
                 g.lr_policy, g.lr_policy_decay_rate, g.lr_policy_power,
                 g.lr_policy_steps, g.max_num_iterations, g.lr_schedule,
             )
+        opt_base = master if master is not None else self.params_tree
         self.opt_state = {
-            name: self._updaters[name].init(self.params_tree[name])
+            name: self._updaters[name].init(opt_base[name])
             for name in self.layer_vertices
         }
+        # Reserved opt_state keys (never vertex names): f32 master params
+        # and the on-device (scale, good_count) loss-scale carry — see
+        # MultiLayerNetwork.init.
+        if master is not None:
+            self.opt_state["_master"] = master
+        if pol.uses_loss_scaling:
+            self.opt_state["_ls"] = (
+                jnp.float32(pol.initial_loss_scale), jnp.float32(0.0))
         self._train_rng = jax.random.PRNGKey(g.seed ^ 0x5EED)
         self._clock = None
         self._initialized = True
@@ -255,10 +279,10 @@ class ComputationGraph:
                     aux[f"center_loss_input:{name}"] = x
                     aux[f"centers:{name}"] = state.get(name, {}).get("centers")
                 lrng = jax.random.fold_in(rng, vi) if rng is not None else None
-                lparams = jax.tree_util.tree_map(
-                    lambda a: a.astype(cdt) if jnp.issubdtype(a.dtype, jnp.floating) else a,
-                    params.get(name, {}),
-                )
+                # Params stored at param_dtype, cast (or dequantized) to the
+                # policy's compute dtype at use (nn/params.py).
+                lparams = params_mod.prep_layer_params(params.get(name, {}),
+                                                       cdt)
                 out, lstate_new, mask = get_impl(layer)(
                     layer, lparams, state.get(name, {}), x,
                     rng=lrng, train=train, mask=mask,
@@ -353,7 +377,7 @@ class ComputationGraph:
                 final = []
                 for n, o in zip(self.conf.network_outputs, outs):
                     layer = self.layer_vertices.get(n)
-                    o = o.astype(self._loss_dtype)
+                    o = o.astype(self._output_dtype)
                     if layer is not None and type(layer.layer).__name__ in OUTPUT_LAYER_TYPES:
                         o = activations_mod.resolve(layer.layer.activation)(o)
                     final.append(o)
@@ -580,16 +604,45 @@ class ComputationGraph:
                 new_state.setdefault(n, {}).update(s)
             return loss, new_state
 
-        (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        pol = self.dtype_policy
+        scaling = pol.uses_loss_scaling
+        lowp = pol.low_precision_params
+
+        if scaling:
+            # Dynamic loss scaling (f16-class compute): backward on the
+            # SCALED loss, f32 unscale after; (scale, good_count) lives in
+            # opt_state so a fused superstep scan carries it on device.
+            # See MultiLayerNetwork._train_step.
+            scale, good = opt_state["_ls"]
+
+            def scaled_loss_fn(p):
+                loss, new_state = loss_fn(p)
+                return loss * scale.astype(loss.dtype), (loss, new_state)
+
+            (_, (loss, new_state)), grads = jax.value_and_grad(
+                scaled_loss_fn, has_aux=True)(params)
+            grads = jax.tree_util.tree_map(
+                lambda a: a.astype(jnp.float32) / scale, grads)
+            finite = jnp.bool_(True)
+            for leaf in jax.tree_util.tree_leaves(grads):
+                finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(leaf)))
+        else:
+            (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            if lowp:
+                grads = params_mod.cast_floating(grads, jnp.float32)
+
+        # Low-precision params: updates apply to the f32 MASTER copy; stored
+        # params are its cast (no bf16/f16 update underflow).
+        base = opt_state["_master"] if lowp else params
         g = self.conf.global_conf
         sign = 1.0 if g.minimize else -1.0
-        new_params, new_opt = {}, {}
+        new_base, new_opt = {}, {}
         stats: Dict[str, Any] = {}
         for name, v in self.layer_vertices.items():
             layer = v.layer
             lgrads = grads.get(name, {})
             if not lgrads:
-                new_params[name] = params.get(name, {})
+                new_base[name] = base.get(name, {})
                 new_opt[name] = opt_state.get(name, ())
                 continue
             lgrads = grad_norm_mod.normalize_layer_gradients(
@@ -606,7 +659,7 @@ class ComputationGraph:
                 # matching reference `LayerUpdater.java:243`.
                 deltas = {k: (d * factor if is_bias_param(k) else d)
                           for k, d in deltas.items()}
-            new_params[name] = {k: params[name][k] - sign * deltas[k] for k in params[name]}
+            new_base[name] = {k: base[name][k] - sign * deltas[k] for k in base[name]}
             new_opt[name] = st
             if collect_stats:
                 # In-jit per-param mean magnitudes (only scalars leave the
@@ -615,10 +668,46 @@ class ComputationGraph:
                     k: {
                         "grad_mm": jnp.mean(jnp.abs(lgrads[k])),
                         "update_mm": jnp.mean(jnp.abs(deltas[k])),
-                        "param_mm": jnp.mean(jnp.abs(new_params[name][k])),
+                        "param_mm": jnp.mean(jnp.abs(new_base[name][k])),
                     }
                     for k in lgrads
                 }
+
+        if scaling:
+            # Skip-step on non-finite scaled grads: per-leaf select of the
+            # OLD values, then scale backoff / growth bookkeeping — all
+            # on-device `jnp.where`, superstep-safe.
+            def sel(n, o):
+                return jnp.where(finite, n, o)
+
+            new_base = jax.tree_util.tree_map(
+                sel, new_base, {n: base[n] for n in new_base})
+            new_opt = jax.tree_util.tree_map(
+                sel, new_opt, {n: opt_state[n] for n in new_opt})
+            new_state = {
+                n: {k: (sel(v, state[n][k])
+                        if n in state and k in state[n] else v)
+                    for k, v in s.items()}
+                for n, s in new_state.items()
+            }
+            new_good = jnp.where(finite, good + 1.0, jnp.float32(0.0))
+            grow = new_good >= jnp.float32(pol.loss_scale_growth_interval)
+            new_scale = jnp.where(
+                finite,
+                jnp.where(grow,
+                          scale * jnp.float32(pol.loss_scale_growth_factor),
+                          scale),
+                scale * jnp.float32(pol.loss_scale_backoff_factor))
+            new_good = jnp.where(grow, jnp.float32(0.0), new_good)
+
+        if lowp:
+            new_params = params_mod.cast_floating(new_base, pol.jnp_param)
+            new_opt["_master"] = new_base
+        else:
+            new_params = new_base
+        if scaling:
+            new_opt["_ls"] = (new_scale, new_good)
+
         merged_state = dict(state)
         for n, s in new_state.items():
             merged = dict(merged_state.get(n, {}))
@@ -670,6 +759,9 @@ class ComputationGraph:
         `ParallelWrapper`. Observability choke point (see
         `MultiLayerNetwork._fit_dispatch`); `StepProfiler` patches this
         method on the instance."""
+        tdt = getattr(self.dtype_policy, "transfer_dtype", None)
+        if tdt is not None:
+            mds = transfer_cast(mds, tdt)
         h2d = _obs.host_nbytes(mds.features, mds.labels,
                                mds.features_masks
                                if hasattr(mds, "features_masks")
@@ -720,6 +812,7 @@ class ComputationGraph:
     def _fit_solver(self, mds: MultiDataSet, algo):
         """Full-batch LBFGS/CG/line-search optimize of one batch (reference:
         `Solver.java:41-110`); see `MultiLayerNetwork._fit_solver`."""
+        self._check_sgd_only_policy("solver optimizers (LBFGS/CG/line search)")
         g = self.conf.global_conf
         fn = self._get_jit("solver_step", algo=str(algo))
         fmasks = _as_mask_list(mds.features_masks)
@@ -760,17 +853,31 @@ class ComputationGraph:
             return 0
         return k
 
+    def _check_sgd_only_policy(self, what: str) -> None:
+        pol = self.dtype_policy
+        if pol.low_precision_params or pol.uses_loss_scaling:
+            raise ValueError(
+                f"{what} does not support dtype policy {pol.name!r}: "
+                "low-precision param storage (f32 master copies) and "
+                "dynamic loss scaling are SGD-train-step features; use a "
+                "float32 / float64 / mixed_bfloat16 policy here")
+
     def _superstep_wrap(self, iterator, k: int):
         """SuperbatchIterator over `iterator`, converting items to
         MultiDataSet BEFORE stacking; the wrapper is cached on the base so
-        device-cached epochs restack once (see MultiLayerNetwork twin)."""
+        device-cached epochs restack once (see MultiLayerNetwork twin). The
+        policy's `transfer_dtype` rides along so staged superbatches ship
+        at the reduced dtype (halved H2D bytes)."""
+        tdt = self.dtype_policy.transfer_dtype
         if isinstance(iterator, SuperbatchIterator):
             return iterator
         wrapper = getattr(iterator, "_superbatch_wrapper", None)
         if (isinstance(wrapper, SuperbatchIterator)
-                and wrapper.base is iterator and wrapper.k == k):
+                and wrapper.base is iterator and wrapper.k == k
+                and getattr(wrapper, "transfer_dtype", None) == tdt):
             return wrapper
-        wrapper = SuperbatchIterator(iterator, k, transform=_as_mds)
+        wrapper = SuperbatchIterator(iterator, k, transform=_as_mds,
+                                     transfer_dtype=tdt)
         try:
             iterator._superbatch_wrapper = wrapper
         except (AttributeError, TypeError):

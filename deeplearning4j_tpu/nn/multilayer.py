@@ -37,6 +37,7 @@ from deeplearning4j_tpu.nn.conf.enums import (
     LossFunction,
     OptimizationAlgorithm,
 )
+from deeplearning4j_tpu.nn.conf.dtype_policy import resolve_policy
 from deeplearning4j_tpu.nn.conf.layers import CenterLossOutputLayer, is_bias_param
 from deeplearning4j_tpu.nn.conf.neural_net import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.conf import preprocessors as preprocessors_mod
@@ -51,6 +52,7 @@ from deeplearning4j_tpu.datasets.iterators import (
     Superbatch,
     SuperbatchIterator,
     maybe_reset,
+    transfer_cast,
 )
 from deeplearning4j_tpu import observability as _obs
 
@@ -94,6 +96,15 @@ _M_INPUT_WAIT = _obs.metrics.histogram(
     label_names=("source",)).labels(source="mln")
 
 
+_cast_floating = params_mod.cast_floating
+
+# Keys in `opt_state` that are NOT layer entries: the f32 master param tree
+# (low-precision param policies) and the (scale, good_count) loss-scale
+# carry. `_apply_updates` iterates layer keys only, so these pass through
+# untouched and re-attach after each update.
+_RESERVED_OPT_KEYS = ("_master", "_ls")
+
+
 def _as_dataset(data, labels=None) -> DataSet:
     if isinstance(data, DataSet):
         return data
@@ -121,12 +132,16 @@ class MultiLayerNetwork:
         self._initialized = False
         self._collect_stats = False
         self.last_training_stats: Dict[str, Any] = {}
-        self._compute_dtype = {
-            "bfloat16": jnp.bfloat16, "float64": jnp.float64,
-        }.get(conf.global_conf.dtype, jnp.float32)
+        # Precision policy (nn/conf/dtype_policy.py): explicit `dtype_policy`
+        # wins, else the legacy `dtype` string maps onto the matching preset.
+        self.dtype_policy = resolve_policy(conf.global_conf)
+        self._compute_dtype = self.dtype_policy.jnp_compute
         self._loss_dtype = (
-            jnp.float64 if conf.global_conf.dtype == "float64" else jnp.float32
+            jnp.float64
+            if self.dtype_policy.resolved_param_dtype == "float64"
+            else jnp.float32
         )
+        self._output_dtype = self.dtype_policy.jnp_output
         self._jit_cache: Dict[Any, Any] = {}
 
 
@@ -146,14 +161,24 @@ class MultiLayerNetwork:
 
     def init(self, params: Optional[Dict[str, Dict[str, jnp.ndarray]]] = None) -> "MultiLayerNetwork":
         g = self.conf.global_conf
+        pol = self.dtype_policy
         root = jax.random.PRNGKey(g.seed)
-        pdt = jnp.float64 if g.dtype == "float64" else jnp.float32
+        # Low-precision param policies still INITIALIZE at f32 — the f32
+        # draw is the master copy, params are its cast. State (BN running
+        # stats) always stays at the master precision.
+        pdt = jnp.float32 if pol.low_precision_params else pol.jnp_param
         keys = jax.random.split(root, max(len(self.layers), 1))
+        master = None
         if params is None:
             params = {
                 lk: params_mod.init_layer_params(layer, keys[i], dtype=pdt)
                 for i, (lk, layer) in enumerate(zip(self.layer_keys, self.layers))
             }
+            if pol.low_precision_params:
+                master = params
+                params = _cast_floating(params, pol.jnp_param)
+        elif pol.low_precision_params:
+            master = _cast_floating(params, jnp.float32)
         self.params_tree = params
         self.state = {
             lk: params_mod.init_layer_state(layer, dtype=pdt)
@@ -180,10 +205,20 @@ class MultiLayerNetwork:
             )
             for layer in self.layers
         ]
+        base = master if master is not None else self.params_tree
         self.opt_state = {
-            lk: self._updaters[i].init(self.params_tree[lk])
+            lk: self._updaters[i].init(base[lk])
             for i, lk in enumerate(self.layer_keys)
         }
+        # Reserved opt_state keys (never layer keys): the f32 master params
+        # and the on-device loss-scale carry ride INSIDE opt_state so jit
+        # signatures, donation, the superstep scan carry, and checkpoint
+        # trees all pick them up without any shape change.
+        if master is not None:
+            self.opt_state["_master"] = master
+        if pol.uses_loss_scaling:
+            self.opt_state["_ls"] = (
+                jnp.float32(pol.initial_loss_scale), jnp.float32(0.0))
         self._train_rng = jax.random.PRNGKey(g.seed ^ 0x5EED)
         self._clock = None
         self._initialized = True
@@ -241,8 +276,9 @@ class MultiLayerNetwork:
                 aux["center_loss_input"] = x
                 aux["centers"] = state.get(lk, {}).get("centers")
             lrng = jax.random.fold_in(rng, i) if rng is not None else None
-            lparams = jax.tree_util.tree_map(lambda a: a.astype(cdt) if jnp.issubdtype(a.dtype, jnp.floating) else a,
-                                             params.get(lk, {}))
+            # Params stored at param_dtype, cast (or dequantized) to the
+            # policy's compute dtype at use (nn/params.py).
+            lparams = params_mod.prep_layer_params(params.get(lk, {}), cdt)
             lstate = state.get(lk, {})
             x, lstate_new, mask = get_impl(layer)(
                 layer, lparams, lstate, x, rng=lrng, train=train, mask=mask
@@ -326,7 +362,7 @@ class MultiLayerNetwork:
                 final, new_state, _, _ = self._forward_fn(
                     params, state, x, rng, train, fmask, keep_rnn_state=keep_rnn_state
                 )
-                out = self._output_activation(final.astype(self._loss_dtype))
+                out = self._output_activation(final.astype(self._output_dtype))
                 return out, new_state
             return jax.jit(output_fn)
         if kind == "score":
@@ -556,6 +592,10 @@ class MultiLayerNetwork:
 
     def _train_step(self, params, state, opt_state, x, y, fmask, lmask, step, rng,
                     carry_rnn=False, eb=None, collect_stats=False):
+        pol = self.dtype_policy
+        scaling = pol.uses_loss_scaling
+        lowp = pol.low_precision_params
+
         def loss_fn(p):
             preout, new_state, _, aux = self._forward_fn(
                 p, state, x, rng, True, fmask, keep_rnn_state=carry_rnn
@@ -565,10 +605,73 @@ class MultiLayerNetwork:
                 new_state.setdefault(lk, {}).update(s)
             return loss, new_state
 
-        (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        if scaling:
+            # Dynamic loss scaling (f16-class compute): backward runs on the
+            # SCALED loss so small grads survive the f16 representable range;
+            # grads unscale in f32 afterwards. The (scale, good_count) pair is
+            # part of opt_state — device-resident, so a fused superstep scan
+            # carries it with zero host round-trips.
+            scale, good = opt_state["_ls"]
 
-        new_params, new_opt, stats = self._apply_updates(
-            params, grads, opt_state, step, collect_stats=collect_stats)
+            def scaled_loss_fn(p):
+                loss, new_state = loss_fn(p)
+                return loss * scale.astype(loss.dtype), (loss, new_state)
+
+            (_, (loss, new_state)), grads = jax.value_and_grad(
+                scaled_loss_fn, has_aux=True)(params)
+            grads = jax.tree_util.tree_map(
+                lambda a: a.astype(jnp.float32) / scale, grads)
+            finite = jnp.bool_(True)
+            for leaf in jax.tree_util.tree_leaves(grads):
+                finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(leaf)))
+        else:
+            (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            if lowp:
+                grads = _cast_floating(grads, jnp.float32)
+
+        # Low-precision params: updates apply to the f32 MASTER copy (and
+        # f32 updater state); stored params are its cast, so tiny updates
+        # never underflow bf16/f16 quantization.
+        base = opt_state["_master"] if lowp else params
+        new_base, new_opt, stats = self._apply_updates(
+            base, grads, opt_state, step, collect_stats=collect_stats)
+
+        if scaling:
+            # Skip-step on non-finite scaled grads: every updated leaf
+            # selects its OLD value (params, updater state, batch stats),
+            # then the scale backs off; after `growth_interval` consecutive
+            # finite steps it grows. All `jnp.where` on device — no host
+            # sync, superstep-safe.
+            def sel(n, o):
+                return jnp.where(finite, n, o)
+
+            new_base = jax.tree_util.tree_map(sel, new_base, base)
+            new_opt = jax.tree_util.tree_map(
+                sel, new_opt, {lk: opt_state[lk] for lk in new_opt})
+            new_state = {
+                lk: {k: (sel(v, state[lk][k])
+                         if lk in state and k in state[lk] else v)
+                     for k, v in s.items()}
+                for lk, s in new_state.items()
+            }
+            new_good = jnp.where(finite, good + 1.0, jnp.float32(0.0))
+            grow = new_good >= jnp.float32(pol.loss_scale_growth_interval)
+            new_scale = jnp.where(
+                finite,
+                jnp.where(grow,
+                          scale * jnp.float32(pol.loss_scale_growth_factor),
+                          scale),
+                scale * jnp.float32(pol.loss_scale_backoff_factor))
+            new_good = jnp.where(grow, jnp.float32(0.0), new_good)
+
+        if lowp:
+            new_params = _cast_floating(new_base, pol.jnp_param)
+            new_opt["_master"] = new_base
+        else:
+            new_params = new_base
+        if scaling:
+            new_opt["_ls"] = (new_scale, new_good)
+
         # Merge persistent-state updates (BN stats / rnn carries) over old state.
         merged_state = dict(state)
         for lk, s in new_state.items():
@@ -686,6 +789,9 @@ class MultiLayerNetwork:
         path (plain / tBPTT / solver / superstep, local or sharded) stages
         batches through here, and `StepProfiler` patches this method on the
         instance."""
+        tdt = getattr(self.dtype_policy, "transfer_dtype", None)
+        if tdt is not None:
+            ds = transfer_cast(ds, tdt)
         h2d = _obs.host_nbytes(ds.features, ds.labels,
                                ds.features_mask, ds.labels_mask)
         _M_H2D.inc(h2d)
@@ -750,17 +856,29 @@ class MultiLayerNetwork:
             return 0
         return k
 
+    def _check_sgd_only_policy(self, what: str) -> None:
+        pol = self.dtype_policy
+        if pol.low_precision_params or pol.uses_loss_scaling:
+            raise ValueError(
+                f"{what} does not support dtype policy {pol.name!r}: "
+                "low-precision param storage (f32 master copies) and "
+                "dynamic loss scaling are SGD-train-step features; use a "
+                "float32 / float64 / mixed_bfloat16 policy here")
+
     def _superstep_wrap(self, iterator, k: int):
         """Wrap `iterator` in a `SuperbatchIterator`, caching the wrapper on
         the base iterator so a device-cached epoch restacks once, not per
-        `fit()` call."""
+        `fit()` call. The policy's `transfer_dtype` rides along so staged
+        superbatches ship at the reduced dtype (halved H2D bytes)."""
+        tdt = self.dtype_policy.transfer_dtype
         if isinstance(iterator, SuperbatchIterator):
             return iterator
         wrapper = getattr(iterator, "_superbatch_wrapper", None)
         if (isinstance(wrapper, SuperbatchIterator)
-                and wrapper.base is iterator and wrapper.k == k):
+                and wrapper.base is iterator and wrapper.k == k
+                and getattr(wrapper, "transfer_dtype", None) == tdt):
             return wrapper
-        wrapper = SuperbatchIterator(iterator, k)
+        wrapper = SuperbatchIterator(iterator, k, transfer_dtype=tdt)
         try:
             iterator._superbatch_wrapper = wrapper
         except (AttributeError, TypeError):
@@ -801,6 +919,7 @@ class MultiLayerNetwork:
         `iterations`-step solver loop is one jitted XLA computation
         (`optimize/solvers.py`). Deterministic forward (no dropout, BN
         running stats) so the line search sees a stable objective."""
+        self._check_sgd_only_policy("solver optimizers (LBFGS/CG/line search)")
         g = self.conf.global_conf
         fn = self._get_jit("solver_step", algo=str(algo))
         self.params_tree, loss = fn(
@@ -830,6 +949,7 @@ class MultiLayerNetwork:
         pretrainable layer, optimize that layer's unsupervised loss)."""
         from deeplearning4j_tpu.nn.layers import PRETRAIN_LOSSES
 
+        self._check_sgd_only_policy("layerwise pretraining")
         if not self._initialized:
             self.init()
         if isinstance(iterator, DataSet):
@@ -1118,6 +1238,11 @@ class MultiLayerNetwork:
         self.params_tree = params_mod.unflatten_params(
             np.asarray(flat), self.params_tree, self.layer_keys, self._param_orders()
         )
+        if (self.dtype_policy.low_precision_params and self.opt_state
+                and "_master" in self.opt_state):
+            # Keep the f32 master in lockstep with an externally-set view.
+            self.opt_state["_master"] = _cast_floating(
+                self.params_tree, jnp.float32)
 
     def updater_state_flat(self) -> np.ndarray:
         leaves = jax.tree_util.tree_leaves(self.opt_state)

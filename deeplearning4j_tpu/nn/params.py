@@ -95,6 +95,39 @@ def init_layer_params(conf: Layer, rng: jax.Array, dtype=jnp.float32) -> Dict[st
     return params
 
 
+def cast_floating(tree, dtype):
+    """Cast every floating leaf of a pytree, leaving integer/bool leaves
+    (embedding ids, quantized tensors) untouched."""
+    return jax.tree_util.tree_map(
+        lambda a: a.astype(dtype)
+        if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
+
+
+def prep_layer_params(lparams: Dict[str, jnp.ndarray], compute_dtype):
+    """Per-use param prep shared by both engines' `_forward_fn` (traced):
+    floating leaves cast to the policy's compute dtype, int8 leaves with a
+    `<name>__scale` companion (post-training quantization —
+    `checkpoint/quantize.py`) dequantize as `q * scale` AT the compute
+    dtype, so XLA fuses the dequant into the consuming matmul/conv and the
+    f32 weights never materialize in HBM. Default-policy nets trace the
+    exact same cast as the old inline `tree_map`."""
+    out: Dict[str, jnp.ndarray] = {}
+    for k, a in lparams.items():
+        if k.endswith("__scale"):
+            continue  # consumed alongside its quantized tensor
+        if isinstance(a, dict):  # nested sub-tree (defensive): recurse
+            out[k] = prep_layer_params(a, compute_dtype)
+            continue
+        scale = lparams.get(k + "__scale")
+        if scale is not None and jnp.issubdtype(a.dtype, jnp.integer):
+            out[k] = a.astype(compute_dtype) * scale.astype(compute_dtype)
+        elif jnp.issubdtype(a.dtype, jnp.floating):
+            out[k] = a.astype(compute_dtype)
+        else:
+            out[k] = a
+    return out
+
+
 def init_layer_state(conf: Layer, dtype=jnp.float32) -> Dict[str, jnp.ndarray]:
     state = {}
     for name, shape in conf.state_shapes().items():

@@ -26,6 +26,7 @@ from deeplearning4j_tpu.datasets.iterators import (
     Superbatch,
     batch_signature,
     maybe_reset,
+    transfer_cast,
 )
 from deeplearning4j_tpu.parallel import mesh as mesh_mod
 from deeplearning4j_tpu.parallel.context import parallel_context
@@ -150,13 +151,20 @@ class ParallelWrapper:
         )
 
     def _prepare(self, ds, is_graph: bool):
-        """Pad one host batch to a mesh-size-multiple batch dim."""
+        """Pad one host batch to a mesh-size-multiple batch dim, then apply
+        the net's DtypePolicy `transfer_dtype` cast host-side so every
+        per-device shard crosses the link in the reduced representation
+        (same knob as the local SuperbatchIterator staging path)."""
         if is_graph:
             mds = MultiDataSet.from_dataset(ds) if isinstance(ds, DataSet) else ds
-            return self._pad_mds(mds)
-        if isinstance(ds, MultiDataSet):
-            raise TypeError("MultiDataSet input requires a ComputationGraph net")
-        return self._pad_dataset(ds)
+            padded = self._pad_mds(mds)
+        else:
+            if isinstance(ds, MultiDataSet):
+                raise TypeError("MultiDataSet input requires a ComputationGraph net")
+            padded = self._pad_dataset(ds)
+        pol = getattr(self.net, "dtype_policy", None)
+        tdt = getattr(pol, "transfer_dtype", None)
+        return padded if tdt is None else transfer_cast(padded, tdt)
 
     def _shard_batch(self, padded, is_graph: bool):
         """device_put one padded batch with the batch dim over the mesh."""

@@ -63,6 +63,66 @@ def estimate_checkpoint_bytes(path) -> int:
         return 0
 
 
+def model_dtype(net=None, path=None) -> str:
+    """The serving dtype of a model: "int8" when the weights are
+    post-training-quantized (any integer leaf with a `__scale` companion),
+    else the first floating param leaf's dtype. For a non-resident entry
+    the answer comes from the checkpoint's meta.json ("quantization" /
+    "dtype_policy") without reading any array data."""
+    import numpy as np
+
+    if net is not None:
+        first_float = None
+        for lp in (getattr(net, "params_tree", None) or {}).values():
+            if not isinstance(lp, dict):
+                continue
+            for k, a in lp.items():
+                dt = getattr(a, "dtype", None)
+                if dt is None:
+                    continue
+                if (np.issubdtype(dt, np.integer)
+                        and k + "__scale" in lp):
+                    return "int8"
+                if first_float is None and jnp_floating(dt):
+                    first_float = str(dt)
+        return first_float or "float32"
+    if path is not None:
+        try:
+            from deeplearning4j_tpu.checkpoint import store
+            from deeplearning4j_tpu.checkpoint.manager import CheckpointManager
+
+            path = str(path)
+            if os.path.isdir(path) and not store.is_sharded_checkpoint(path):
+                path = CheckpointManager(path).latest_path() or path
+            meta = store.read_meta(path)
+            if meta.get("quantization"):
+                return "int8"
+            pol = meta.get("dtype_policy")
+            if pol:
+                from deeplearning4j_tpu.nn.conf.dtype_policy import DtypePolicy
+
+                return DtypePolicy.of(pol).resolved_param_dtype
+        except Exception:
+            pass
+    return "float32"
+
+
+def jnp_floating(dt) -> bool:
+    import numpy as np
+
+    try:
+        import ml_dtypes
+
+        if np.dtype(dt) in (np.dtype(ml_dtypes.bfloat16),):
+            return True
+    except Exception:
+        pass
+    try:
+        return np.issubdtype(dt, np.floating)
+    except Exception:
+        return False
+
+
 def _measure_hbm(model: "ServedModel") -> None:
     """Firm the footprint up from the leaf-nbytes estimate to measured
     device bytes (live jax.Array nbytes + the largest recorded program's
@@ -102,6 +162,7 @@ class ServedModel:
         self.hbm_bytes = (estimate_hbm_bytes(net) if net is not None
                           else estimate_checkpoint_bytes(path)
                           if path is not None else 0)
+        self.dtype = model_dtype(net=net, path=path)
 
     @property
     def resident(self) -> bool:
@@ -144,6 +205,7 @@ class ModelHost:
             if model.net is not None:
                 _measure_hbm(model)
             _m.MODEL_HBM_BYTES.labels(model=name).set(model.hbm_bytes)
+            _m.MODEL_DTYPE.labels(model=name, dtype=model.dtype).set(1)
             if model.net is not None and self.on_load is not None:
                 self.on_load(model)
             self._enforce_budget(keep=model)
@@ -179,7 +241,10 @@ class ModelHost:
             model.net = net
             model.hbm_bytes = estimate_hbm_bytes(net)
             _measure_hbm(model)
+            model.dtype = model_dtype(net=net)
             _m.MODEL_HBM_BYTES.labels(model=model.name).set(model.hbm_bytes)
+            _m.MODEL_DTYPE.labels(model=model.name,
+                                  dtype=model.dtype).set(1)
             if self.on_load is not None:
                 self.on_load(model)
             self._enforce_budget(keep=model)
@@ -239,6 +304,7 @@ class ModelHost:
                 "pinned": m.pinned,
                 "hbm_bytes": int(m.hbm_bytes),
                 "hbm_source": m.hbm_source,
+                "dtype": m.dtype,
                 "path": m.path,
                 "lm": m.scheduler is not None,
             } for m in self._models.values()]

@@ -64,6 +64,13 @@ MODEL_HBM_BYTES = _obs.metrics.gauge(
     "Estimated device-resident bytes per hosted model (params + state; "
     "checkpoint manifest size before load)",
     label_names=("model",))
+MODEL_DTYPE = _obs.metrics.gauge(
+    "dl4j_serving_model_dtype",
+    "Info gauge (value 1): the serving dtype of each hosted model — "
+    "'int8' for post-training-quantized weights, else the param dtype "
+    "(float32/bfloat16/...). Join on {model} with "
+    "dl4j_serving_model_hbm_bytes to attribute HBM by precision",
+    label_names=("model", "dtype"))
 MODELS_RESIDENT = _obs.metrics.gauge(
     "dl4j_serving_models_resident",
     "Hosted models currently resident (loaded) in this process")

@@ -1,0 +1,462 @@
+"""DtypePolicy: bf16/f16 mixed-precision training + int8 PTQ serving.
+
+Acceptance coverage for the dtype-policy PR:
+
+- the DEFAULT policy is bit-identical to the pre-policy engines — an
+  explicit "float32" policy and an unset one produce the same trained
+  trees, opt_state grows no reserved keys, and conf JSON / AOT compile
+  fingerprints are unchanged;
+- mixed_bfloat16 and pure-bfloat16 training are float-close to f32 (the
+  latter via f32 master copies at `opt_state["_master"]`);
+- dynamic loss scaling (f16): a non-finite-grad step is SKIPPED (params
+  bitwise unchanged) and the scale halves; consecutive finite steps grow
+  it back — all carried on-device, so the fused superstep scan stays
+  bit-identical to the per-batch loop under the same policy;
+- the `transfer_dtype` staging knob halves H2D bytes (counter-verified);
+- checkpoints round-trip the policy; a low-precision checkpoint restored
+  onto a default-policy net is a clear error, not silent corruption;
+- int8 post-training quantization: quantized nets/checkpoints predict
+  within tolerance, shrink HBM below 0.55x, serve over HTTP, and report
+  dtype via `/v1/models` + `dl4j_serving_model_dtype`;
+- tpulint JX009 flags hardcoded compute dtypes in layer forward paths.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import ml_dtypes
+
+from deeplearning4j_tpu import (MultiLayerNetwork, NeuralNetConfiguration,
+                                observability as obs)
+from deeplearning4j_tpu.checkpoint import (
+    CheckpointError,
+    quantize_checkpoint,
+    quantize_net,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from deeplearning4j_tpu.checkpoint import quantize as quantize_mod
+from deeplearning4j_tpu.checkpoint import store as ckpt_store
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import (
+    SuperbatchIterator,
+    stage_to_device,
+    transfer_cast,
+)
+from deeplearning4j_tpu.nn.conf.dtype_policy import DtypePolicy, resolve_policy
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+
+from conftest import make_classification_data
+
+N_IN, N_OUT = 4, 3
+BF16 = np.dtype(ml_dtypes.bfloat16)
+
+
+def mlp_conf(policy=None, updater="adam", superstep_k=0, seed=7):
+    b = (NeuralNetConfiguration.builder()
+         .seed(seed).learning_rate(0.05).updater(updater)
+         .weight_init("xavier").superstep_k(superstep_k))
+    if policy is not None:
+        b = b.dtype_policy(policy)
+    return (b.list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=N_OUT, activation="softmax",
+                               loss_function="mcxent"))
+            .set_input_type(InputType.feed_forward(N_IN))
+            .build())
+
+
+def make_batches(rng, n_batches=4, batch=6):
+    out = []
+    for _ in range(n_batches):
+        X, Y = make_classification_data(rng, n=batch, n_features=N_IN,
+                                        n_classes=N_OUT, dtype="float32")
+        out.append(DataSet(X, Y))
+    return out
+
+
+def train(policy=None, batches=None, rng=None, **kw):
+    net = MultiLayerNetwork(mlp_conf(policy=policy, **kw)).init()
+    for ds in batches if batches is not None else make_batches(rng):
+        net.fit(ds)
+    return net
+
+
+def assert_trees_identical(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def layer_param_keys(opt_state):
+    return {k for k in opt_state if not k.startswith("_")}
+
+
+def counter_total(name, **match):
+    fam = obs.metrics.get_family(name)
+    if fam is None:
+        return 0.0
+    total = 0.0
+    for child in fam.children():
+        if all(child.labels.get(k) == v for k, v in match.items()):
+            total += child.get()
+    return total
+
+
+# ---------------------------------------------------------------- policy
+
+
+class TestPolicyObject:
+    def test_presets(self):
+        p = DtypePolicy.of("mixed_bfloat16")
+        assert (p.resolved_param_dtype, p.resolved_compute_dtype,
+                p.resolved_output_dtype) == ("float32", "bfloat16", "float32")
+        assert not p.uses_loss_scaling and not p.low_precision_params
+        p = DtypePolicy.of("mixed_float16")
+        assert p.uses_loss_scaling and not p.low_precision_params
+        p = DtypePolicy.of("bfloat16")
+        assert p.low_precision_params and not p.uses_loss_scaling
+        assert DtypePolicy.of("f16").uses_loss_scaling
+
+    def test_of_coercions_and_roundtrip(self):
+        assert DtypePolicy.of(None).is_default
+        d = {"name": "mixed_bfloat16", "transfer_dtype": "bfloat16"}
+        p = DtypePolicy.of(d)
+        assert p.transfer_dtype == "bfloat16"
+        assert DtypePolicy.of(p.to_dict()) == p
+        with pytest.raises(ValueError, match="unknown dtype policy"):
+            DtypePolicy.of("int7")
+        with pytest.raises(TypeError):
+            DtypePolicy.of(42)
+
+    def test_legacy_dtype_string_maps_to_preset(self):
+        conf = mlp_conf()
+        conf.global_conf.dtype = "bfloat16"
+        assert resolve_policy(conf.global_conf).name == "mixed_bfloat16"
+        conf.global_conf.dtype = "float64"
+        assert resolve_policy(conf.global_conf).name == "float64"
+        conf.global_conf.dtype_policy = "bfloat16"  # explicit policy wins
+        assert resolve_policy(conf.global_conf).name == "bfloat16"
+
+
+# ------------------------------------------------------- default identity
+
+
+class TestDefaultBitIdentity:
+    def test_explicit_f32_policy_is_bitwise_default(self, rng):
+        batches = make_batches(rng)
+        a = train(policy=None, batches=batches)
+        b = train(policy="float32", batches=batches)
+        assert_trees_identical(a.params_tree, b.params_tree)
+        assert_trees_identical(a.opt_state, b.opt_state)
+
+    def test_default_opt_state_has_no_reserved_keys(self, rng):
+        net = train(policy=None, rng=rng)
+        assert "_master" not in net.opt_state
+        assert "_ls" not in net.opt_state
+
+    def test_default_conf_json_omits_policy(self):
+        assert "dtype_policy" not in mlp_conf().to_json()
+        assert "dtype_policy" in mlp_conf(policy="mixed_bfloat16").to_json()
+
+
+# -------------------------------------------------------- mixed precision
+
+
+class TestMixedPrecisionTraining:
+    def test_mixed_bfloat16_float_close_to_f32(self, rng):
+        batches = make_batches(rng)
+        f32 = train(policy=None, batches=batches)
+        bf = train(policy="mixed_bfloat16", batches=batches)
+        # Params stay f32 masters-by-construction (no _master needed).
+        assert "_master" not in bf.opt_state
+        for lp in bf.params_tree.values():
+            for a in lp.values():
+                assert a.dtype == jnp.float32
+        for x, y in zip(jax.tree_util.tree_leaves(f32.params_tree),
+                        jax.tree_util.tree_leaves(bf.params_tree)):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       atol=0.1, rtol=0.1)
+        X = np.asarray(batches[0].features)
+        assert np.asarray(bf.output(X)).dtype == np.float32
+
+    def test_pure_bfloat16_keeps_f32_masters(self, rng):
+        batches = make_batches(rng)
+        f32 = train(policy=None, batches=batches)
+        bf = train(policy="bfloat16", batches=batches)
+        assert "_master" in bf.opt_state
+        for lp in bf.params_tree.values():
+            for a in lp.values():
+                assert a.dtype == BF16
+        for lp in bf.opt_state["_master"].values():
+            for a in lp.values():
+                assert a.dtype == jnp.float32
+        for x, y in zip(jax.tree_util.tree_leaves(f32.params_tree),
+                        jax.tree_util.tree_leaves(bf.opt_state["_master"])):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       atol=0.1, rtol=0.1)
+        X = np.asarray(batches[0].features)
+        assert np.asarray(bf.output(X)).dtype == BF16
+
+
+# ----------------------------------------------------------- loss scaling
+
+
+class TestDynamicLossScaling:
+    def test_scale_initialized_on_device(self, rng):
+        net = train(policy="mixed_float16", batches=make_batches(rng, 1))
+        scale, good = net.opt_state["_ls"]
+        assert isinstance(scale, jax.Array)
+        assert float(scale) in (2.0 ** 15, 2.0 ** 14)
+
+    def test_nonfinite_step_skipped_and_scale_halved(self, rng):
+        net = train(policy="mixed_float16", batches=make_batches(rng, 2))
+        before = jax.tree_util.tree_map(np.asarray, net.params_tree)
+        scale0 = float(net.opt_state["_ls"][0])
+        X, Y = make_classification_data(rng, n=6, n_features=N_IN,
+                                        n_classes=N_OUT, dtype="float32")
+        X[0, 0] = np.inf
+        net.fit(DataSet(X, Y))
+        assert_trees_identical(before, net.params_tree)
+        assert float(net.opt_state["_ls"][0]) == scale0 * 0.5
+
+    def test_scale_grows_after_finite_interval(self, rng):
+        pol = {"name": "mixed_float16", "initial_loss_scale": 8.0,
+               "loss_scale_growth_interval": 2}
+        net = train(policy=pol, batches=make_batches(rng, 4))
+        # 4 finite steps with interval 2 -> two doublings: 8 -> 32.
+        assert float(net.opt_state["_ls"][0]) == 32.0
+
+    def test_superstep_scan_bit_identical_under_scaling(self, rng):
+        batches = make_batches(rng, n_batches=4)
+        pol = {"name": "mixed_float16", "initial_loss_scale": 8.0,
+               "loss_scale_growth_interval": 2}
+        seq = train(policy=pol, batches=batches, superstep_k=0)
+        fused = MultiLayerNetwork(
+            mlp_conf(policy=pol, superstep_k=4)).init()
+        fused.fit(batches)
+        assert_trees_identical(seq.params_tree, fused.params_tree)
+        assert_trees_identical(seq.opt_state, fused.opt_state)
+
+    def test_solver_and_pretrain_reject_scaling_policies(self, rng):
+        net = train(policy="mixed_float16", batches=make_batches(rng, 1))
+        with pytest.raises(ValueError, match="dtype policy"):
+            net._check_sgd_only_policy("solver optimizers")
+        net = train(policy="bfloat16", batches=make_batches(rng, 1))
+        with pytest.raises(ValueError, match="dtype policy"):
+            net._check_sgd_only_policy("layerwise pretraining")
+
+
+# ------------------------------------------------------- transfer staging
+
+
+class TestTransferStaging:
+    def test_transfer_cast_halves_host_bytes(self, rng):
+        ds = make_batches(rng, 1, batch=8)[0]
+        cast = transfer_cast(ds, "bfloat16")
+        assert cast.features.dtype == BF16
+        assert cast.features.nbytes * 2 == ds.features.nbytes
+        assert cast.labels.dtype == BF16
+        # None / ints / masks pass through untouched.
+        assert transfer_cast(ds, None) is ds
+        ids = DataSet(np.arange(12, dtype=np.int32).reshape(3, 4),
+                      ds.labels[:3], labels_mask=np.ones(3, np.float32))
+        cast = transfer_cast(ids, "bfloat16")
+        assert cast.features.dtype == np.int32
+        assert cast.labels_mask.dtype == np.float32
+
+    def test_stage_and_superbatch_ship_reduced(self, rng):
+        ds = make_batches(rng, 1, batch=8)[0]
+        staged = stage_to_device(ds, transfer_dtype="bfloat16")
+        assert staged.features.dtype == BF16
+        blocks = list(SuperbatchIterator(make_batches(rng, 4), k=4,
+                                         stage=False,
+                                         transfer_dtype="bfloat16"))
+        assert blocks[0].features.dtype == BF16
+
+    def test_h2d_counter_confirms_halved_transfer(self, rng):
+        batches = make_batches(rng, 2, batch=16)
+
+        def shipped(policy):
+            net = MultiLayerNetwork(mlp_conf(policy=policy)).init()
+            before = counter_total("dl4j_host_to_device_bytes_total",
+                                   engine="mln")
+            for ds in batches:
+                net.fit(ds)
+            return counter_total("dl4j_host_to_device_bytes_total",
+                                 engine="mln") - before
+
+        full = shipped(None)
+        half = shipped({"name": "mixed_bfloat16",
+                        "transfer_dtype": "bfloat16"})
+        assert full > 0
+        assert half == pytest.approx(full / 2)
+
+
+# ------------------------------------------------------------ checkpoints
+
+
+class TestCheckpointPolicy:
+    def test_default_meta_has_no_policy_and_roundtrips(self, rng, tmp_path):
+        net = train(policy=None, rng=rng)
+        path = save_checkpoint(net, str(tmp_path / "ckpt"))
+        assert "dtype_policy" not in ckpt_store.read_meta(path)
+        back = restore_checkpoint(path)
+        assert_trees_identical(net.params_tree, back.params_tree)
+
+    def test_policy_roundtrips_through_meta(self, rng, tmp_path):
+        net = train(policy="bfloat16", rng=rng)
+        path = save_checkpoint(net, str(tmp_path / "ckpt"))
+        meta = ckpt_store.read_meta(path)
+        assert DtypePolicy.of(meta["dtype_policy"]).name == "bfloat16"
+        back = restore_checkpoint(path)
+        assert back.dtype_policy.name == "bfloat16"
+        for lp in back.params_tree.values():
+            for a in lp.values():
+                assert a.dtype == BF16
+        assert "_master" in back.opt_state
+
+    def test_low_precision_onto_default_net_is_clear_error(self, rng,
+                                                           tmp_path):
+        path = save_checkpoint(train(policy="bfloat16", rng=rng),
+                               str(tmp_path / "ckpt"))
+        target = MultiLayerNetwork(mlp_conf()).init()
+        with pytest.raises(CheckpointError, match="dtype policy"):
+            restore_checkpoint(path, net=target)
+
+    def test_quantized_checkpoint_roundtrip_and_cli(self, rng, tmp_path):
+        net = train(policy=None, rng=rng)
+        src = save_checkpoint(net, str(tmp_path / "f32"))
+        assert quantize_mod.main([src, str(tmp_path / "int8")]) == 0
+        meta = ckpt_store.read_meta(str(tmp_path / "int8"))
+        assert meta["quantization"]["scheme"] == "int8_per_channel_symmetric"
+        qnet = restore_checkpoint(str(tmp_path / "int8"))
+        W = qnet.params_tree["layer_0"]["W"]
+        assert W.dtype == jnp.int8
+        assert "W__scale" in qnet.params_tree["layer_0"]
+        X = np.random.RandomState(0).rand(8, N_IN).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(qnet.output(X)),
+                                   np.asarray(net.output(X)), atol=0.05)
+        # Re-quantizing an already-quantized checkpoint is refused.
+        with pytest.raises(CheckpointError, match="already"):
+            quantize_checkpoint(str(tmp_path / "int8"),
+                                str(tmp_path / "int8b"))
+
+
+# ------------------------------------------------------- AOT fingerprints
+
+
+class TestAOTFingerprint:
+    def _fp(self, net):
+        from deeplearning4j_tpu.compilation import store as store_mod
+        from deeplearning4j_tpu.compilation import warmup as warmup_mod
+
+        r = np.random.RandomState(0)
+        ds = DataSet(r.rand(8, N_IN).astype("float32"),
+                     np.eye(N_OUT, dtype="float32")[r.randint(0, N_OUT, 8)])
+        args = warmup_mod._mln_args(net, ds, "train_step")
+        return store_mod.fingerprint(
+            store_mod.build_fingerprint_doc(net, "train_step", {}, args))
+
+    def test_policy_only_change_invalidates(self):
+        default = MultiLayerNetwork(mlp_conf()).init()
+        policied = MultiLayerNetwork(
+            mlp_conf(policy="mixed_bfloat16")).init()
+        rebuilt = MultiLayerNetwork(mlp_conf()).init()
+        assert self._fp(default) == self._fp(rebuilt)
+        assert self._fp(default) != self._fp(policied)
+
+
+# ----------------------------------------------------------------- JX009
+
+
+class TestJX009:
+    LAYER_PATH = "deeplearning4j_tpu/nn/layers/fake.py"
+
+    def _findings(self, src, path=None):
+        from deeplearning4j_tpu.analysis.linter import lint_source
+
+        return lint_source(src, path or self.LAYER_PATH, rules=["JX009"])
+
+    def test_flags_hardcoded_compute_dtype(self):
+        src = ("import jax.numpy as jnp\n"
+               "def forward(x, w):\n"
+               "    x = x.astype(jnp.float32)\n"
+               "    return jnp.dot(x, w, preferred_element_type=None)"
+               ".astype(jnp.float16)\n")
+        assert len(self._findings(src)) == 2
+
+    def test_promote_types_widening_is_exempt(self):
+        src = ("import jax.numpy as jnp\n"
+               "def forward(x):\n"
+               "    acc = jnp.promote_types(x.dtype, jnp.float32)\n"
+               "    return x.astype(acc)\n")
+        assert self._findings(src) == []
+
+    def test_only_layer_forward_paths_are_scoped(self):
+        src = ("import jax.numpy as jnp\n"
+               "def helper(x):\n"
+               "    return x.astype(jnp.float32)\n")
+        assert self._findings(
+            src, path="deeplearning4j_tpu/datasets/iterators.py") == []
+
+    def test_dtype_string_keyword_flagged(self):
+        src = ("import jax.numpy as jnp\n"
+               "def forward(x):\n"
+               "    return jnp.zeros((2, 2), dtype='float32') + x\n")
+        assert len(self._findings(src)) == 1
+
+
+# ---------------------------------------------------------- int8 serving
+
+
+class TestInt8Serving:
+    def test_quantized_model_serves_over_http(self, rng, tmp_path):
+        from deeplearning4j_tpu.serving import InferenceServer
+        from deeplearning4j_tpu.serving.host import estimate_hbm_bytes
+
+        net = train(policy=None, rng=rng)
+        f32_bytes = estimate_hbm_bytes(net)
+        f32_out = np.asarray(net.output(
+            np.random.RandomState(1).rand(4, N_IN).astype(np.float32)))
+
+        path = save_checkpoint(net, str(tmp_path / "f32"))
+        quantize_checkpoint(path, str(tmp_path / "int8"))
+        qnet = restore_checkpoint(str(tmp_path / "int8"))
+        assert estimate_hbm_bytes(qnet) <= 0.55 * f32_bytes
+
+        server = InferenceServer(qnet, port=0, default_model="q",
+                                 max_batch_size=8, max_delay_ms=1.0).start()
+        try:
+            x = np.random.RandomState(1).rand(4, N_IN).astype(np.float32)
+            got = np.asarray(server.predict(x))
+            np.testing.assert_allclose(got, f32_out, atol=0.05)
+            with urllib.request.urlopen(server.url + "/v1/models",
+                                        timeout=10) as r:
+                rows = {m["name"]: m for m in json.loads(r.read())["models"]}
+            assert rows["q"]["dtype"] == "int8"
+            with urllib.request.urlopen(server.url + "/metrics",
+                                        timeout=10) as r:
+                scrape = r.read().decode()
+            assert ('dl4j_serving_model_dtype{dtype="int8",model="q"} 1'
+                    in scrape
+                    or 'dl4j_serving_model_dtype{model="q",dtype="int8"} 1'
+                    in scrape)
+        finally:
+            server.stop()
+
+    def test_quantize_net_in_place(self, rng):
+        net = train(policy=None, rng=rng)
+        X = np.random.RandomState(2).rand(6, N_IN).astype(np.float32)
+        want = np.asarray(net.output(X))
+        quantize_net(net)
+        assert net.params_tree["layer_0"]["W"].dtype == jnp.int8
+        np.testing.assert_allclose(np.asarray(net.output(X)), want,
+                                   atol=0.05)
