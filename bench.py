@@ -1044,6 +1044,105 @@ def bench_lm_int8_serving(steps, warmup):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+_ELASTIC_WORKER = """
+import json, os, sys
+wid = sys.argv[1]; addr = sys.argv[2]; root = sys.argv[3]; out = sys.argv[4]
+is_host = sys.argv[5] == "host"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.neural_net import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel.elastic import ElasticTrainer
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+conf = (NeuralNetConfiguration.builder()
+        .seed(7).learning_rate(0.05).updater("sgd")
+        .list()
+        .layer(DenseLayer(n_out=64, activation="tanh"))
+        .layer(OutputLayer(n_out=8, activation="softmax",
+                           loss_function="mcxent"))
+        .set_input_type(InputType.feed_forward(32))
+        .build())
+
+def shard_fn(step, rank, world):
+    rng = np.random.RandomState(1000 + step)
+    X = rng.randn(64, 32).astype(np.float32)
+    Y = np.eye(8, dtype=np.float32)[rng.randint(0, 8, 64)]
+    n = X.shape[0] // world
+    return DataSet(X[rank*n:(rank+1)*n], Y[rank*n:(rank+1)*n])
+
+net = MultiLayerNetwork(conf).init()
+trainer = ElasticTrainer(
+    ParallelWrapper(net, workers=1),
+    coordinator_address=addr, worker_id=wid, expected_world=2,
+    checkpoint_root=os.path.join(root, "ckpt"), save_every=2,
+    host_coordinator=is_host, heartbeat_s=0.25, join_grace_s=60.0,
+    collective_timeout_s=20.0, lost_after_s=1.0)
+result = trainer.run(shard_fn, steps=int(sys.argv[6]))
+with open(out, "w") as f:
+    json.dump({"status": result.status, "step": result.step,
+               "restarts": result.restarts,
+               "recoveries_s": list(result.recoveries_s)}, f)
+"""
+
+
+def bench_elastic_recovery(steps, warmup):
+    """Time-to-recover on a 2-process CPU cluster (parallel/elastic.py):
+    worker b is killed mid-run by a deterministic fault plan; the metric
+    is the survivor's fault-detected -> training-resumed latency (the
+    same quantity `dl4j_elastic_recovery_seconds` observes). Includes
+    heartbeat-lease expiry (lost_after_s=1.0 here), eviction, re-join,
+    checkpoint restore and the first post-restart step."""
+    import socket
+    import subprocess
+    import tempfile
+
+    kill_at = max(3, min(6, steps // 2))
+    total = kill_at + 4
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    addr = f"127.0.0.1:{port}"
+    tmp = tempfile.mkdtemp(prefix="bench-elastic-")
+    script = os.path.join(tmp, "worker.py")
+    with open(script, "w") as f:
+        f.write(_ELASTIC_WORKER)
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    # `worker` is the coordinator RANK: the peer ("b", second joiner) is 1.
+    env["DL4J_TPU_FAULT_PLAN"] = json.dumps(
+        [{"kind": "kill", "step": kill_at, "worker": 1}])
+    env["PYTHONPATH"] = _HERE + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [sys.executable, script, wid, addr, tmp,
+         os.path.join(tmp, f"out-{wid}.json"), role, str(total)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT, env=env)
+        for wid, role in (("a", "host"), ("b", "peer"))]
+    try:
+        for p in procs:
+            p.wait(timeout=300)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    with open(os.path.join(tmp, "out-a.json")) as f:
+        survivor = json.load(f)
+    recoveries = survivor.get("recoveries_s") or []
+    if survivor.get("status") != "finished" or not recoveries:
+        return _entry("elastic_recovery_seconds", 0.0, "seconds",
+                      note=f"recovery did not complete: {survivor}")
+    return _entry(
+        "elastic_recovery_seconds", float(recoveries[0]), "seconds",
+        note=(f"2-process CPU cluster, worker killed at step {kill_at}; "
+              "detection (1.0s heartbeat lease) + evict + re-join + "
+              "restore + first step. Lower is better; vs_baseline < 1 "
+              "is an improvement."))
+
+
 def main():
     # Compile-time accounting for the self-attribution snapshot in _emit():
     # every XLA compile during the run lands in dl4j_xla_compile_* counters.
@@ -1056,7 +1155,7 @@ def main():
         "BENCH_CONFIGS",
         "resnet50,resnet50_bf16,lenet,char_rnn,lenet_step,lenet_superstep,"
         "lenet_cold_warm,word2vec,vgg16,flash_attn,flash_tri,transformer,"
-        "serving_slo,lm_int8_serving,obs_overhead"
+        "serving_slo,lm_int8_serving,obs_overhead,elastic_recovery"
     ).split(",")
 
     head, extra = None, {}
@@ -1111,6 +1210,9 @@ def main():
         extra[e["metric"]] = e
     if "obs_overhead" in configs:
         e = bench_obs_overhead(steps, warmup)
+        extra[e["metric"]] = e
+    if "elastic_recovery" in configs:
+        e = bench_elastic_recovery(steps, warmup)
         extra[e["metric"]] = e
     if head is None:  # resnet50 excluded: promote the first extra metric
         if not extra:

@@ -31,9 +31,14 @@ import shutil
 import threading
 from typing import List, Optional
 
+import warnings
+
 from deeplearning4j_tpu.checkpoint import store
-from deeplearning4j_tpu.checkpoint.array_store import CheckpointError
+from deeplearning4j_tpu.checkpoint.array_store import (
+    CheckpointCorruptError, CheckpointError)
 from deeplearning4j_tpu import observability as _obs
+from deeplearning4j_tpu.observability import elastic as _elastic
+from deeplearning4j_tpu.util.retry import with_retries
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
 
@@ -63,11 +68,17 @@ def _snap_nbytes(snap) -> int:
 class CheckpointManager:
     def __init__(self, directory: str, keep_last: int = 3,
                  keep_every: int = 0, async_save: bool = True,
-                 mesh=None, model_axis: Optional[str] = None, context=None):
+                 mesh=None, model_axis: Optional[str] = None, context=None,
+                 save_every: int = 0):
         self.directory = str(directory)
         self.keep_last = int(keep_last)
         self.keep_every = int(keep_every)
         self.async_save = bool(async_save)
+        # Cadence for `maybe_save`: a checkpoint every `save_every` steps
+        # (0 = cadence disabled, every `maybe_save` is a no-op). The
+        # elastic supervisor drives this from its step loop so recovery
+        # loses at most `save_every` steps of work.
+        self.save_every = int(save_every)
         self.mesh = mesh
         self.model_axis = model_axis
         self.context = context
@@ -104,6 +115,18 @@ class CheckpointManager:
         step = self.latest()
         return None if step is None else self.step_path(step)
 
+    def candidate_steps(self) -> List[int]:
+        """Every step-named directory, descending, WITHOUT the validation
+        filter of `all_steps()` — the restore-fallback walk wants to *see*
+        a corrupt newest step (to warn and count it) rather than have
+        discovery silently hide it."""
+        steps = []
+        for name in os.listdir(self.directory):
+            m = _STEP_RE.match(name)
+            if m:
+                steps.append(int(m.group(1)))
+        return sorted(steps, reverse=True)
+
     # ---------------------------------------------------------------- save
 
     def save(self, net, step: Optional[int] = None) -> str:
@@ -119,14 +142,22 @@ class CheckpointManager:
         nbytes = _snap_nbytes(snap)
         path = self.step_path(step)
 
+        def write_committed():
+            # Transient storage blips (NFS/GCS) must not kill training:
+            # retried with backoff; `write_snapshot` clears its stale
+            # `.tmp` on entry so a retry restarts from a clean slate.
+            with _obs.tracer.span("checkpoint.write", cat="checkpoint",
+                                  step=step, bytes=nbytes):
+                with_retries(lambda: store.write_snapshot(snap, path),
+                             retry_on=(OSError,),
+                             describe=f"checkpoint write step {step}")
+            _M_BYTES_W.inc(nbytes)
+            _M_SAVES.inc()
+            self._apply_retention()
+
         def work():
             try:
-                with _obs.tracer.span("checkpoint.write", cat="checkpoint",
-                                      step=step, bytes=nbytes):
-                    store.write_snapshot(snap, path)
-                _M_BYTES_W.inc(nbytes)
-                _M_SAVES.inc()
-                self._apply_retention()
+                write_committed()
             except BaseException as e:  # surfaced on next save()/flush()
                 self._error = e
             finally:
@@ -137,13 +168,16 @@ class CheckpointManager:
             self._inflight = threading.Thread(target=work, daemon=True)
             self._inflight.start()
         else:
-            with _obs.tracer.span("checkpoint.write", cat="checkpoint",
-                                  step=step, bytes=nbytes):
-                store.write_snapshot(snap, path)
-            _M_BYTES_W.inc(nbytes)
-            _M_SAVES.inc()
-            self._apply_retention()
+            write_committed()
         return path
+
+    def maybe_save(self, net, step: Optional[int] = None) -> Optional[str]:
+        """Cadence hook: checkpoint iff `save_every > 0` and the step
+        lands on the cadence. Step 0 never saves (nothing learned yet)."""
+        step = int(net.iteration if step is None else step)
+        if self.save_every <= 0 or step <= 0 or step % self.save_every:
+            return None
+        return self.save(net, step)
 
     def flush(self) -> None:
         """Wait for the in-flight save; re-raise any background failure."""
@@ -169,16 +203,48 @@ class CheckpointManager:
 
     def restore(self, step: Optional[int] = None, net=None,
                 load_updater: bool = True):
-        """Restore `step` (default: latest committed) onto the manager's
-        mesh/context — the ELASTIC path: the mesh here may be any shape,
-        not the one that saved."""
+        """Restore `step` (default: newest, WITH corruption fallback) onto
+        the manager's mesh/context — the ELASTIC path: the mesh here may
+        be any shape, not the one that saved.
+
+        When `step` is None the walk starts from the newest step-named
+        directory and falls back past every step whose chunks fail the
+        corruption checks (truncated chunk, missing COMMIT, torn write) —
+        warning and counting `dl4j_elastic_events_total{event=
+        restore_fallback}` per damaged step, so "restore quietly served
+        yesterday's checkpoint" is visible, not silent. An explicitly
+        named bad step still raises `CheckpointCorruptError`: the caller
+        asked for THAT step."""
         self.flush()
-        if step is None:
-            step = self.latest()
-            if step is None:
-                raise CheckpointError(
-                    f"no committed checkpoint under {self.directory}")
+        if step is not None:
+            return self._restore_one(int(step), net, load_updater)
+        candidates = self.candidate_steps()
+        if not candidates:
+            raise CheckpointError(
+                f"no committed checkpoint under {self.directory}")
+        last_err: Optional[BaseException] = None
+        for i, cand in enumerate(candidates):
+            try:
+                return self._restore_one(cand, net, load_updater)
+            except CheckpointCorruptError as e:
+                last_err = e
+                warnings.warn(
+                    f"checkpoint step {cand} failed corruption checks "
+                    f"({e}); falling back to previous committed step",
+                    RuntimeWarning, stacklevel=2)
+                _elastic.record_event(
+                    "restore_fallback", step=int(cand),
+                    error=f"{type(e).__name__}: {e}")
+        raise CheckpointCorruptError(
+            f"all {len(candidates)} checkpoint steps under "
+            f"{self.directory} failed corruption checks") from last_err
+
+    def _restore_one(self, step: int, net, load_updater: bool):
         path = self.step_path(step)
+        # Verify BEFORE loading: a truncated chunk must surface as the
+        # clean CheckpointCorruptError the fallback walk routes around,
+        # not as a mid-load unpickling crash with device arrays half-set.
+        manifest = store.verify_checkpoint(path)
         with _obs.tracer.span("checkpoint.restore", cat="checkpoint",
                               step=int(step)):
             result = store.restore_checkpoint(
@@ -186,7 +252,6 @@ class CheckpointManager:
                 model_axis=self.model_axis, context=self.context,
                 load_updater=load_updater)
         try:
-            manifest = store.verify_checkpoint(path)
             _M_BYTES_R.inc(sum(manifest["files"].values()))
         except Exception:
             pass

@@ -289,9 +289,17 @@ def _make_leaf(base: str, entry: dict, like, sharding, key: str = "?"):
     """One restored leaf, placed in the target sharding. With a sharding,
     each device's region is read straight from the overlapping chunks;
     without one, the leaf is assembled on host and handed to the default
-    device. Dtype coercion is policed by `_check_leaf_dtype`."""
+    device. Dtype coercion is policed by `_check_leaf_dtype`.
+
+    Every path ends in `own_on_device`: the placement primitives may
+    zero-copy the transient restore scratch arrays, and a restored param
+    that still aliases freed host memory after the train step donates it
+    reads back as garbage one allocation burst later (the CPU-CI
+    elastic-resume corruption — see `parallel/mesh.py:own_on_device`)."""
     import jax
     import jax.numpy as jnp
+
+    from deeplearning4j_tpu.parallel.mesh import own_on_device
 
     shape = tuple(entry["shape"])
     if tuple(np.shape(like)) != shape:
@@ -300,14 +308,14 @@ def _make_leaf(base: str, entry: dict, like, sharding, key: str = "?"):
             f"{tuple(np.shape(like))} — config/topology differs")
     dtype = _check_leaf_dtype(key, entry, like)
     if sharding is not None and shape:
-        return jax.make_array_from_callback(
+        return own_on_device(jax.make_array_from_callback(
             shape, sharding,
             lambda idx: np.ascontiguousarray(
-                read_region(base, entry, idx).astype(dtype)))
+                read_region(base, entry, idx).astype(dtype))))
     arr = read_full(base, entry).astype(dtype)
     if sharding is not None:
-        return jax.device_put(arr, sharding)
-    return jnp.asarray(arr)
+        return own_on_device(jax.device_put(arr, sharding))
+    return own_on_device(jnp.asarray(arr))
 
 
 def _restore_tree(tree, prefix: str, index: dict, base: str, shardings):
@@ -341,6 +349,8 @@ def _assemble_params_from_index(index: dict, base: str):
     `nn/params.prep_layer_params` dequantizes at use)."""
     import jax.numpy as jnp
 
+    from deeplearning4j_tpu.parallel.mesh import own_on_device
+
     params: Dict[str, Any] = {}
     for key, entry in index["leaves"].items():
         if not key.startswith(_PARAMS + "/"):
@@ -350,8 +360,8 @@ def _assemble_params_from_index(index: dict, base: str):
         for p in parts[:-1]:
             node = node.setdefault(p, {})
         arr = read_full(base, entry)
-        node[parts[-1]] = jnp.asarray(
-            np.asarray(arr, dtype=resolve_dtype(str(entry["dtype"]))))
+        node[parts[-1]] = own_on_device(jnp.asarray(
+            np.asarray(arr, dtype=resolve_dtype(str(entry["dtype"])))))
     return params
 
 

@@ -60,6 +60,30 @@ def maybe_reset(iterator) -> bool:
         return False
 
 
+def fast_forward(iterator, n: int):
+    """Reset `iterator` (when resettable) and skip its first `n` batches,
+    returning an iterator positioned at batch `n` — the elastic-recovery
+    data path: after restoring a checkpoint at step N, the restarted
+    worker must see the SAME batch stream a never-interrupted run would
+    see at step N, so recovery reproduces the uninterrupted run's
+    numerics instead of re-training on replayed data.
+
+    Skipped batches are drawn and discarded (deterministic iterators
+    re-derive them; there is no general seek), so fast-forwarding a
+    many-epoch stream costs host iteration time but no device work. A
+    stream shorter than `n` yields an exhausted iterator — the caller's
+    step loop then simply finds nothing left to train on.
+    """
+    maybe_reset(iterator)
+    it = iter(iterator)
+    for _ in range(max(0, int(n))):
+        try:
+            next(it)
+        except StopIteration:
+            break
+    return it
+
+
 class DataSetIterator:
     """Iterator protocol (reference: ND4J `DataSetIterator`)."""
 

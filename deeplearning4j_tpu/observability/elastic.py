@@ -1,0 +1,85 @@
+"""Elastic-training instruments on the process-global registry.
+
+The reference stack surfaced cluster health through the Spark UI's
+training-master listeners; here the `ElasticTrainer` (parallel/elastic.py)
+feeds three families plus the flight-recorder ring so a post-mortem
+bundle shows the full preemption timeline next to the per-step records:
+
+- ``dl4j_elastic_events_total{event}`` — the recovery state machine's
+  transitions: ``preempt`` (SIGTERM observed), ``host_lost`` (heartbeat /
+  step-barrier timeout evicted a member), ``restart`` (supervisor
+  re-entered the join loop), ``restore`` (committed checkpoint loaded
+  onto the re-formed mesh), ``restore_fallback`` (newest step failed
+  corruption checks, previous committed step used), ``coordinator_retry``
+  (a coordinator RPC needed a backoff retry).
+- ``dl4j_elastic_recovery_seconds`` — fault detected -> training resumed
+  (WIDE buckets: recoveries sit in the 1s..600s band, not microseconds).
+- ``dl4j_elastic_restarts_total`` — restarts this run (alert threshold:
+  a run burning its `DL4J_TPU_ELASTIC_MAX_RESTARTS` budget is churning).
+
+Families are created ONCE at import (JX008: never in a loop or step
+path); `record_event` is a counter bump + ring append, safe to call from
+signal handlers and the heartbeat thread.
+"""
+
+from __future__ import annotations
+
+import time
+
+from deeplearning4j_tpu import observability as _obs
+
+EVENTS = _obs.metrics.counter(
+    "dl4j_elastic_events_total",
+    "Elastic-training lifecycle events (preempt / host_lost / restart / "
+    "restore / restore_fallback / coordinator_retry)",
+    label_names=("event",))
+RECOVERY_SECONDS = _obs.metrics.histogram(
+    "dl4j_elastic_recovery_seconds",
+    "Time-to-recover: fault detected -> training step resumed",
+    buckets=_obs.WIDE_BUCKETS)
+RESTARTS = _obs.metrics.counter(
+    "dl4j_elastic_restarts_total",
+    "ElasticTrainer supervisor restarts (join-loop re-entries) this run")
+
+
+def record_event(event: str, **fields) -> None:
+    """Count one lifecycle event and mirror it into the flight ring.
+
+    Never raises: this is called from signal handlers and monitor
+    threads where an instrumentation failure must not mask the fault
+    being handled.
+    """
+    try:
+        EVENTS.labels(event=event).inc()
+    except Exception:
+        pass
+    try:
+        # `observability.flight` is re-exported as the recorder INSTANCE.
+        from deeplearning4j_tpu.observability import flight
+
+        flight.record_event(f"elastic:{event}", **fields)
+    except Exception:
+        pass
+
+
+def observe_recovery(seconds: float) -> None:
+    try:
+        RECOVERY_SECONDS.observe(float(seconds))
+    except Exception:
+        pass
+
+
+class RecoveryTimer:
+    """Context helper: ``with RecoveryTimer() as t: ...`` then
+    ``t.seconds``; observes into the histogram on clean exit."""
+
+    def __enter__(self):
+        self.start = time.monotonic()
+        self.seconds = 0.0
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.seconds = time.monotonic() - self.start
+        if exc_type is None:
+            observe_recovery(self.seconds)
+        return False

@@ -104,8 +104,19 @@ class FlightRecorder:
         self._compiles_prev: Optional[float] = None
         self._last_dump_at: Dict[str, float] = {}  # reason -> monotonic
         self._dumps: List[str] = []
+        # Last successfully written bundle's reason, readable by the
+        # elastic preemption path: when the signal handler already dumped
+        # (reason "signal:SIGTERM"), the graceful-exit path must NOT write
+        # a second bundle for the same preemption (satellite contract:
+        # exactly one bundle per process per preemption).
+        self.last_dump_reason: Optional[str] = None
         self._signals_installed = False
         self._prev_handlers: Dict[int, Any] = {}
+        # The installed handler function, exposed so cooperating handlers
+        # (ElasticTrainer's preemption hook) can recognize it by identity:
+        # chaining INTO it is fatal when its own prev is SIG_DFL (it
+        # re-raises to preserve the death-by-signal exit status).
+        self.signal_handler: Any = None
 
     # -------------------------------------------------------------- feeding
 
@@ -242,6 +253,7 @@ class FlightRecorder:
                 signal.signal(signum, signal.SIG_DFL)
                 signal.raise_signal(signum)
 
+        self.signal_handler = handler
         for sig in (signal.SIGTERM, signal.SIGINT):
             try:
                 self._prev_handlers[sig] = signal.getsignal(sig)
@@ -332,6 +344,7 @@ class FlightRecorder:
             pass
         with self._lock:
             self._dumps.append(bundle_dir)
+            self.last_dump_reason = reason
         return bundle_dir
 
     def _manifest(self, reason, exc, n_records) -> Dict[str, Any]:

@@ -1,0 +1,494 @@
+"""Host-side cluster coordinator for elastic training.
+
+The transport half of `parallel/elastic.py` — the role the reference
+stack splits between the Spark driver (membership, averaging barriers;
+`ParameterAveragingTrainingMaster.java`) and the Aeron parameter server
+(parameter shipping). One small TCP service, JSON-line protocol, two
+jobs:
+
+1. **Membership, by generation.** Workers `join`; the live set at any
+   moment is a *generation* (monotonic int). Heartbeats refresh a
+   member's lease; a reaper evicts members whose lease lapsed
+   (`lost_after`) and bumps the generation. Every blocked collective
+   call observes the bump and returns ``regen`` — so a lost host turns
+   into a clean, observable "cluster changed, re-form" signal on every
+   survivor within one lease, never a hang.
+2. **Step collectives.** `allreduce` (mean of equally-weighted host
+   arrays — parameter averaging; accumulated in float64) and `barrier`,
+   keyed by (generation, step, name). Results are cached per key, so a
+   worker whose response packet was lost retries idempotently and gets
+   the SAME mean (no double-counting: a re-contribution from the same
+   worker replaces, never adds).
+
+Why host-side TCP and not XLA collectives: the elastic path must keep
+working while the device cluster is broken — that is its whole job — and
+on CPU CI there is no cross-process XLA backend at all. The SPMD
+transport (`DistributedTrainer`) remains the fast path on real pods;
+`ElasticTrainer(sync="auto")` picks per platform.
+
+Fault-injection hooks: `inject_hang(seconds)` makes the server accept
+connections but delay every response until the hang elapses — clients
+must survive via timeout + backoff-retry (`util/retry.py`), and the
+reaper treats the hang window as leased time so the coordinator's own
+outage never *causes* evictions.
+
+Wire format: one JSON object per line, one request per connection.
+Arrays travel as ``{shape, dtype, b64}`` (raw little-endian bytes,
+base64) — fine for the parameter sizes this averaging tier targets;
+giant models use the SPMD path where weights never leave the devices.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import socket
+import socketserver
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.observability import elastic as _ev
+from deeplearning4j_tpu.util.retry import Backoff, RetryError
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+HEARTBEAT_S = _env_float("DL4J_TPU_ELASTIC_HEARTBEAT_S", 5.0)
+LOST_AFTER_S = _env_float("DL4J_TPU_ELASTIC_LOST_AFTER_S", 3 * HEARTBEAT_S)
+RPC_TIMEOUT_S = _env_float("DL4J_TPU_ELASTIC_RPC_TIMEOUT_S", 10.0)
+BARRIER_TIMEOUT_S = _env_float("DL4J_TPU_ELASTIC_BARRIER_TIMEOUT_S", 60.0)
+JOIN_GRACE_S = _env_float("DL4J_TPU_ELASTIC_JOIN_GRACE_S", 30.0)
+
+
+class ClusterChanged(Exception):
+    """Membership changed under a blocked collective — re-join and
+    recover (the elastic supervisor's restart trigger)."""
+
+
+# ------------------------------------------------------------- wire codecs
+
+def encode_tree(tree: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    out = {}
+    for k, a in tree.items():
+        a = np.ascontiguousarray(a)
+        out[k] = {"shape": list(a.shape), "dtype": a.dtype.str,
+                  "b64": base64.b64encode(a.tobytes()).decode("ascii")}
+    return out
+
+
+def decode_tree(doc: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    out = {}
+    for k, d in doc.items():
+        a = np.frombuffer(base64.b64decode(d["b64"]), dtype=np.dtype(d["dtype"]))
+        out[k] = a.reshape(d["shape"]).copy()
+    return out
+
+
+# ---------------------------------------------------------------- server
+
+
+class Coordinator:
+    """The in-process coordinator service. Start with `start()`; workers
+    connect to `address`. All mutable state lives behind `_cond` (one
+    Condition doubles as the lock and the wakeup channel for blocked
+    collectives)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 lost_after_s: float = LOST_AFTER_S):
+        self._cond = threading.Condition()
+        self._members: Dict[str, float] = {}  # worker_id -> last_seen
+        self._generation = 0
+        self._hang_until = 0.0
+        self._contribs: Dict[tuple, Dict[str, Dict[str, np.ndarray]]] = {}
+        self._results: Dict[tuple, Dict[str, Any]] = {}
+        self._barriers: Dict[tuple, set] = {}
+        self._closed = False
+        self.lost_after_s = float(lost_after_s)
+        outer = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                try:
+                    line = self.rfile.readline()
+                    if not line:
+                        return
+                    req = json.loads(line.decode("utf-8"))
+                    resp = outer._dispatch(req)
+                    self.wfile.write(
+                        (json.dumps(resp) + "\n").encode("utf-8"))
+                except (OSError, ValueError):
+                    pass  # client went away / torn request: it will retry
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((host, port), Handler)
+        self.address = "%s:%d" % self._server.server_address[:2]
+        self._threads: List[threading.Thread] = []
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "Coordinator":
+        t = threading.Thread(target=self._server.serve_forever,
+                             name="dl4j-coordinator", daemon=True)
+        t.start()
+        r = threading.Thread(target=self._reap_loop,
+                             name="dl4j-coordinator-reaper", daemon=True)
+        r.start()
+        self._threads = [t, r]
+        return self
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._server.shutdown()
+        self._server.server_close()
+
+    # ------------------------------------------------------------- faults
+
+    def inject_hang(self, seconds: float) -> None:
+        """Stop responding for `seconds` (connections accept, responses
+        stall). The reaper credits the hang window to every member's
+        lease — a coordinator outage must not masquerade as host loss."""
+        with self._cond:
+            self._hang_until = max(self._hang_until,
+                                   time.monotonic() + float(seconds))
+
+    # ------------------------------------------------------------ internals
+
+    def _reap_loop(self) -> None:
+        while True:
+            with self._cond:
+                if self._closed:
+                    return
+                now = time.monotonic()
+                floor = self._hang_until  # hang time counts as leased
+                dead = [w for w, seen in self._members.items()
+                        if now - max(seen, floor) > self.lost_after_s]
+                if dead:
+                    for w in dead:
+                        del self._members[w]
+                    self._generation += 1
+                    self._cond.notify_all()
+            for w in dead:
+                _ev.record_event("host_lost", worker=w,
+                                 lost_after_s=self.lost_after_s)
+            time.sleep(min(0.1, self.lost_after_s / 4))
+
+    def _ranked(self) -> List[str]:
+        return sorted(self._members)
+
+    def _member_doc(self) -> Dict[str, Any]:
+        return {"gen": self._generation, "members": self._ranked(),
+                "world": len(self._members)}
+
+    def _dispatch(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        # The hang gate: every op (status included — a hung coordinator
+        # answers nothing) stalls until the injected outage elapses.
+        while True:
+            with self._cond:
+                remaining = self._hang_until - time.monotonic()
+            if remaining <= 0:
+                break
+            time.sleep(min(remaining, 0.05))
+        op = req.get("op")
+        fn = getattr(self, "_op_" + str(op), None)
+        if fn is None:
+            return {"ok": False, "error": f"unknown op {op!r}"}
+        try:
+            return fn(req)
+        except Exception as e:  # surface, don't kill the handler thread
+            return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+    # ------------------------------------------------------------- ops
+
+    def _op_join(self, req) -> Dict[str, Any]:
+        """Add the worker; when `expected` is given, block until that many
+        members are present (or `grace_s` runs out — the cluster then
+        forms on whoever showed up, elastically)."""
+        worker = str(req["worker"])
+        expected = req.get("expected")
+        grace = float(req.get("grace_s", JOIN_GRACE_S))
+        deadline = time.monotonic() + grace
+        with self._cond:
+            if worker not in self._members:
+                self._members[worker] = time.monotonic()
+                self._generation += 1
+                self._cond.notify_all()
+            else:
+                self._members[worker] = time.monotonic()
+            if expected:
+                while (len(self._members) < int(expected)
+                       and not self._closed):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(min(remaining, 0.25))
+            doc = self._member_doc()
+        doc.update(ok=True, rank=doc["members"].index(worker))
+        return doc
+
+    def _op_heartbeat(self, req) -> Dict[str, Any]:
+        worker = str(req["worker"])
+        with self._cond:
+            known = worker in self._members
+            if known:
+                self._members[worker] = time.monotonic()
+            doc = self._member_doc()
+        doc.update(ok=True, known=known,
+                   regen=int(req.get("gen", -1)) != doc["gen"])
+        return doc
+
+    def _op_leave(self, req) -> Dict[str, Any]:
+        worker = str(req["worker"])
+        with self._cond:
+            if worker in self._members:
+                del self._members[worker]
+                self._generation += 1
+                self._cond.notify_all()
+            doc = self._member_doc()
+        doc.update(ok=True)
+        return doc
+
+    def _op_status(self, req) -> Dict[str, Any]:
+        with self._cond:
+            doc = self._member_doc()
+        doc.update(ok=True)
+        return doc
+
+    def _op_barrier(self, req) -> Dict[str, Any]:
+        worker, gen = str(req["worker"]), int(req["gen"])
+        key = (gen, int(req.get("step", -1)), str(req.get("name", "")))
+        timeout = float(req.get("timeout_s", BARRIER_TIMEOUT_S))
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            if gen != self._generation:
+                return {"ok": False, "regen": True, "gen": self._generation}
+            self._barriers.setdefault(key, set()).add(worker)
+            self._cond.notify_all()
+            while True:
+                if self._generation != gen:
+                    return {"ok": False, "regen": True,
+                            "gen": self._generation}
+                if self._barriers.get(key, set()) >= set(self._ranked()):
+                    return {"ok": True, "gen": gen}
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._closed:
+                    return {"ok": False, "timeout": True}
+                self._cond.wait(min(remaining, 0.25))
+
+    def _op_allreduce(self, req) -> Dict[str, Any]:
+        """Mean over one contribution per CURRENT member. Blocks until the
+        key's contributor set covers the generation's member set; any
+        membership change unblocks everyone with `regen`."""
+        worker, gen = str(req["worker"]), int(req["gen"])
+        key = (gen, int(req.get("step", -1)), str(req.get("name", "")))
+        timeout = float(req.get("timeout_s", BARRIER_TIMEOUT_S))
+        deadline = time.monotonic() + timeout
+        tree = decode_tree(req.get("data", {}))
+        with self._cond:
+            if gen != self._generation:
+                return {"ok": False, "regen": True, "gen": self._generation}
+            done = self._results.get(key)
+            if done is None:
+                # replace-not-add: a retried contribution is idempotent
+                self._contribs.setdefault(key, {})[worker] = tree
+                self._cond.notify_all()
+            while True:
+                done = self._results.get(key)
+                if done is not None:
+                    return {"ok": True, "gen": gen, "data": done}
+                if self._generation != gen:
+                    return {"ok": False, "regen": True,
+                            "gen": self._generation}
+                contribs = self._contribs.get(key, {})
+                if set(contribs) >= set(self._ranked()) and contribs:
+                    self._results[key] = self._mean(contribs)
+                    self._contribs.pop(key, None)
+                    self._trim_results()
+                    self._cond.notify_all()
+                    continue
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._closed:
+                    return {"ok": False, "timeout": True}
+                self._cond.wait(min(remaining, 0.25))
+
+    def _mean(self, contribs: Dict[str, Dict[str, np.ndarray]]
+              ) -> Dict[str, Any]:
+        trees = list(contribs.values())
+        out: Dict[str, np.ndarray] = {}
+        for k in trees[0]:
+            acc = np.zeros(trees[0][k].shape, np.float64)
+            for t in trees:
+                acc += np.asarray(t[k], np.float64)
+            out[k] = (acc / len(trees)).astype(trees[0][k].dtype)
+        return encode_tree(out)
+
+    def _trim_results(self, keep: int = 8) -> None:
+        # Results are only re-read by laggards of the same step; a short
+        # tail bounds memory on long runs.
+        while len(self._results) > keep:
+            self._results.pop(next(iter(self._results)))
+
+
+# ---------------------------------------------------------------- client
+
+
+class CoordinatorClient:
+    """One worker's connection to the coordinator. Every RPC is one
+    short-lived TCP connection retried under `util/retry.py`'s backoff
+    (the coordinator may be hung, restarting, or not yet listening);
+    retries surface as `dl4j_elastic_events_total{event=coordinator_retry}`.
+    """
+
+    def __init__(self, address: str, worker_id: str,
+                 rpc_timeout_s: float = RPC_TIMEOUT_S,
+                 backoff: Optional[Backoff] = None):
+        host, _, port = address.rpartition(":")
+        self.host, self.port = host or "127.0.0.1", int(port)
+        self.worker_id = str(worker_id)
+        self.rpc_timeout_s = float(rpc_timeout_s)
+        self.backoff = backoff or Backoff(base_s=0.05, max_s=2.0, tries=8)
+        self.gen = -1
+        self.rank = 0
+        self.world = 1
+        self._hb_stop = threading.Event()
+        self._hb_regen = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+
+    # --------------------------------------------------------------- rpc
+
+    def _rpc_once(self, doc: Dict[str, Any],
+                  timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        with socket.create_connection(
+                (self.host, self.port),
+                timeout=timeout_s or self.rpc_timeout_s) as s:
+            f = s.makefile("rwb")
+            f.write((json.dumps(doc) + "\n").encode("utf-8"))
+            f.flush()
+            line = f.readline()
+        if not line:
+            raise ConnectionError("coordinator closed the connection")
+        resp = json.loads(line.decode("utf-8"))
+        if resp.get("error"):
+            raise RuntimeError(f"coordinator error: {resp['error']}")
+        return resp
+
+    def _rpc(self, doc: Dict[str, Any], timeout_s: Optional[float] = None,
+             tries: Optional[int] = None) -> Dict[str, Any]:
+        bo = Backoff(base_s=self.backoff.base_s, max_s=self.backoff.max_s,
+                     tries=tries or self.backoff.tries)
+
+        def on_retry(attempt, exc):
+            _ev.record_event("coordinator_retry", op=doc.get("op"),
+                             attempt=attempt, error=type(exc).__name__)
+
+        return bo.run(lambda: self._rpc_once(doc, timeout_s),
+                      retry_on=(OSError, socket.timeout),
+                      on_retry=on_retry,
+                      describe=f"coordinator rpc {doc.get('op')}")
+
+    # --------------------------------------------------------- membership
+
+    def join(self, expected: Optional[int] = None,
+             grace_s: float = JOIN_GRACE_S,
+             deadline_s: Optional[float] = None) -> Dict[str, Any]:
+        """Join (or re-join) the cluster; blocks server-side until the
+        expected world forms or the grace lapses. Clears any pending
+        regen flag — after a successful join we ARE the new generation."""
+        doc = self._rpc({"op": "join", "worker": self.worker_id,
+                         "expected": expected, "grace_s": grace_s},
+                        timeout_s=(deadline_s or grace_s) + self.rpc_timeout_s,
+                        tries=max(self.backoff.tries, 8))
+        self.gen, self.rank = int(doc["gen"]), int(doc["rank"])
+        self.world = int(doc["world"])
+        self._hb_regen.clear()
+        return doc
+
+    def leave(self) -> None:
+        try:
+            self._rpc({"op": "leave", "worker": self.worker_id}, tries=2)
+        except (RetryError, RuntimeError):
+            pass  # leaving best-effort: the reaper will get it anyway
+
+    def heartbeat(self) -> Dict[str, Any]:
+        doc = self._rpc({"op": "heartbeat", "worker": self.worker_id,
+                         "gen": self.gen})
+        if doc.get("regen") or not doc.get("known", True):
+            self._hb_regen.set()
+        return doc
+
+    def start_heartbeats(self, interval_s: float = HEARTBEAT_S) -> None:
+        """Background lease refresh. Sets the regen flag (checked by the
+        trainer between steps) instead of raising into a foreign thread."""
+        if self._hb_thread is not None:
+            return
+        self._hb_stop.clear()
+
+        def loop():
+            while not self._hb_stop.wait(interval_s):
+                try:
+                    self.heartbeat()
+                except (RetryError, RuntimeError, OSError):
+                    self._hb_regen.set()
+
+        self._hb_thread = threading.Thread(
+            target=loop, name=f"dl4j-heartbeat-{self.worker_id}", daemon=True)
+        self._hb_thread.start()
+
+    def stop_heartbeats(self) -> None:
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2.0)
+            self._hb_thread = None
+
+    @property
+    def cluster_changed(self) -> bool:
+        return self._hb_regen.is_set()
+
+    def check(self) -> None:
+        if self._hb_regen.is_set():
+            raise ClusterChanged(
+                f"worker {self.worker_id}: generation moved past {self.gen}")
+
+    # -------------------------------------------------------- collectives
+
+    def _collective(self, doc: Dict[str, Any],
+                    timeout_s: float) -> Dict[str, Any]:
+        doc.update(worker=self.worker_id, gen=self.gen, timeout_s=timeout_s)
+        resp = self._rpc(doc, timeout_s=timeout_s + self.rpc_timeout_s)
+        if resp.get("regen"):
+            self._hb_regen.set()
+            raise ClusterChanged(
+                f"{doc['op']} {doc.get('name')}: cluster re-formed "
+                f"(gen {self.gen} -> {resp.get('gen')})")
+        if resp.get("timeout"):
+            raise ClusterChanged(
+                f"{doc['op']} {doc.get('name')}: collective timed out "
+                f"(lost host not yet evicted?)")
+        return resp
+
+    def barrier(self, name: str, step: int = -1,
+                timeout_s: float = BARRIER_TIMEOUT_S) -> None:
+        self._collective({"op": "barrier", "name": name, "step": int(step)},
+                         timeout_s)
+
+    def allreduce_mean(self, name: str, step: int,
+                       tree: Dict[str, np.ndarray],
+                       timeout_s: float = BARRIER_TIMEOUT_S
+                       ) -> Dict[str, np.ndarray]:
+        resp = self._collective(
+            {"op": "allreduce", "name": name, "step": int(step),
+             "data": encode_tree(tree)}, timeout_s)
+        return decode_tree(resp["data"])

@@ -23,6 +23,8 @@ cross-host copy of the data itself.
 
 from __future__ import annotations
 
+import os
+import re
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -31,20 +33,57 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.parallel import mesh as mesh_mod
 from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+from deeplearning4j_tpu.util.retry import with_retries
 
 
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
                process_id: Optional[int] = None,
+               tries: int = 5,
                **kwargs) -> None:
-    """Join (or form) the multi-process cluster — a thin entry over
-    `jax.distributed.initialize`. With no arguments, cluster-environment
+    """Join (or form) the multi-process cluster — `jax.distributed.
+    initialize` under backoff retries (`util/retry.py`): process 0 binds
+    the coordinator service, every other process dials it, and nothing
+    guarantees who starts first — a dial that beats the bind must retry,
+    not crash the worker. With no arguments, cluster-environment
     autodetection applies (TPU pods populate everything; standalone
     clusters use the JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
     JAX_PROCESS_ID env vars). Call before any jax device use."""
-    jax.distributed.initialize(coordinator_address=coordinator_address,
-                               num_processes=num_processes,
-                               process_id=process_id, **kwargs)
+    with_retries(
+        lambda: jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id, **kwargs),
+        tries=tries, retry_on=(RuntimeError, OSError),
+        describe="jax.distributed.initialize")
+
+
+def multiprocess_spmd_supported(platform: Optional[str] = None) -> bool:
+    """Whether the backend can run CROSS-PROCESS SPMD computations.
+
+    `jax.distributed.initialize` itself succeeds on any platform (the
+    coordinator/KV service is backend-agnostic), but XLA:CPU then rejects
+    the first multi-process collective with "Multiprocess computations
+    aren't implemented on the CPU backend" — so the honest capability gate
+    is the backend platform, not a handshake probe. The two-process tests
+    and `ElasticTrainer(sync="auto")` consult this to pick the host-side
+    coordinator transport (or a clean skip, with this reason) on CPU."""
+    platform = platform or jax.default_backend()
+    return platform not in ("cpu",)
+
+
+def force_host_device_count(n: int) -> None:
+    """Make the CPU backend expose `n` virtual devices — the worker-
+    subprocess analog of conftest's XLA_FLAGS plumbing. MUST run before
+    jax initializes its backends (os.environ edit; an already-initialized
+    backend won't re-read it). Replaces any existing
+    --xla_force_host_platform_device_count flag rather than appending a
+    duplicate (XLA takes the first occurrence)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+\s*", "",
+                   flags).strip()
+    os.environ["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count={int(n)}".strip())
 
 
 def shutdown() -> None:

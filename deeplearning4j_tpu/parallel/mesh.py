@@ -37,6 +37,16 @@ def create_mesh(
     return Mesh(arr, tuple(axis_names))
 
 
+def local_mesh(shape: Optional[Tuple[int, ...]] = None,
+               axis_names: Sequence[str] = ("data",)) -> Mesh:
+    """Mesh over THIS process's addressable devices only — the elastic
+    trainer's per-worker mesh: each surviving worker trains on its local
+    slice and synchronizes through the host-side coordinator, so the mesh
+    never spans processes and a host loss never invalidates it."""
+    return create_mesh(shape, axis_names=axis_names,
+                       devices=jax.local_devices())
+
+
 def data_sharding(mesh: Mesh, ndim: int, axis: str = "data") -> NamedSharding:
     """Shard the leading (batch) axis; replicate the rest."""
     return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
@@ -53,6 +63,29 @@ def superbatch_sharding(mesh: Mesh, ndim: int,
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def own_on_device(x):
+    """An XLA-owned copy of an already-placed array (sharding preserved).
+
+    `device_put` / `make_array_from_callback` zero-copy suitably-aligned
+    host numpy buffers on the CPU backend, so a leaf placed from a
+    TRANSIENT numpy array (a checkpoint-restore scratch buffer, the
+    elastic averaging result) can end up aliasing memory the host
+    allocator reclaims once the numpy object dies. That alias is harmless
+    until the train step DONATES the leaf: XLA then reuses the aliased
+    allocation in place for the updated parameter, and the live training
+    state is sitting in freed host memory — the next unrelated host
+    allocation silently stomps the weights. (Observed on CPU CI as
+    elastic restore -> fit -> params corrupted some reads later; small
+    leaves survived because sub-alignment-threshold arrays are copied,
+    not aliased.) An eager on-device copy's output buffer comes from the
+    XLA pool, decoupling the leaf from whatever host memory placed it.
+    Use at every host->device boundary that feeds donated training state.
+    """
+    import jax.numpy as jnp
+
+    return jnp.copy(x)
 
 
 def batch_shardings(mesh: Mesh, tree, axis: str = "data"):
@@ -114,8 +147,19 @@ def shard_params(net, mesh: Mesh, model_axis: Optional[str] = None,
     With `expert_axis`, every MoELayer's per-expert tables (leading [E]
     axis) shard over that axis — the expert-parallel placement
     `nn/layers/moe.py`'s sharding constraints then keep through the step."""
-    if put is None:
-        put = jax.device_put
+    raw_put = jax.device_put if put is None else put
+
+    def put(a, s):
+        placed = raw_put(a, s)
+        if isinstance(a, np.ndarray):
+            # Host-sourced leaf (elastic averaging write-back, host-side
+            # restores): the placement may zero-copy the caller's numpy
+            # buffer, which the donated train step must never alias — see
+            # `own_on_device`. Device-sourced leaves skip the copy (the
+            # common ctor path re-places arrays XLA already owns).
+            placed = own_on_device(placed)
+        return placed
+
     ps = param_shardings(net.params_tree, mesh, model_axis)
     moe = _moe_layers(net) if expert_axis in mesh.shape else {}
     for lk, layer in moe.items():

@@ -330,6 +330,29 @@ class ParallelWrapper:
                                      batch_size=batch_size,
                                      context=self.context)
 
+    def push_host_state(self, params_tree=None, opt_state=None, state=None):
+        """Install host-side trees (numpy / jnp leaves) into the wrapped
+        net and re-apply THIS wrapper's placement rules — the write-back
+        half of host-mediated parameter averaging (`parallel/elastic.py`
+        averages over the coordinator, then pushes the mean back through
+        the same `shard_params` rules the constructor applied, so the
+        next dispatch sees correctly-placed params, not host arrays).
+        Only the trees passed are replaced; `None` leaves the net's
+        current tree untouched."""
+        net = self.net
+        if params_tree is not None:
+            net.params_tree = params_tree
+        if opt_state is not None:
+            net.opt_state = opt_state
+        if state is not None:
+            net.state = state
+        ctx = getattr(self, "context", None)
+        mesh_mod.shard_params(
+            net, self.mesh,
+            model_axis=None if ctx is None else ctx.model_axis,
+            expert_axis=None if ctx is None else ctx.expert_axis)
+        return net
+
     # ------------------------------------------------------- checkpointing
 
     def checkpoint_manager(self, directory: str, **kwargs):

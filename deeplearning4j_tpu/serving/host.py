@@ -232,12 +232,18 @@ class ModelHost:
 
     def _reload(self, model: ServedModel) -> None:
         from deeplearning4j_tpu.checkpoint.legacy import load_any
+        from deeplearning4j_tpu.util.retry import with_retries
 
         with self._lock:
             if model.resident:
                 return
             model.ready.clear()
-            net = load_any(model.path)
+            # A reload racing an atomic-rename republish can see a
+            # half-moment of ENOENT; retry with backoff instead of
+            # evicting the model over a publisher's rename window.
+            net = with_retries(lambda: load_any(model.path),
+                               retry_on=(OSError,), tries=3,
+                               describe=f"model reload {model.name}")
             model.net = net
             model.hbm_bytes = estimate_hbm_bytes(net)
             _measure_hbm(model)

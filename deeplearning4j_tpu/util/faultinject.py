@@ -1,0 +1,178 @@
+"""Deterministic fault injection for elastic-training chaos tests.
+
+Recovery code that only runs when real hardware dies is recovery code
+that doesn't work. This harness makes every failure mode in the elastic
+design a *scheduled, reproducible* event: a **fault plan** is a list of
+faults keyed by (training step, worker rank), evaluated at fixed
+injection points inside `ElasticTrainer.run` — so "worker 1 dies at step
+7" happens at exactly step 7 on exactly worker 1, every CI run.
+
+Plan format (JSON — inline in ``DL4J_TPU_FAULT_PLAN`` or ``@/path`` to a
+file; `FaultPlan.from_env()` reads it in every worker process):
+
+    [
+      {"kind": "kill",             "step": 7, "worker": 1},
+      {"kind": "preempt",          "step": 4},
+      {"kind": "hang_coordinator", "step": 1, "worker": 0, "seconds": 2.0},
+      {"kind": "truncate_chunk",   "step": 5, "worker": 0},
+      {"kind": "delay_h2d",        "step": 3, "ms": 200}
+    ]
+
+Kinds (each fires at the TOP of its step, before the local fit):
+
+- ``kill``             — ``os._exit(137)``: hard host loss, no checkpoint,
+                         no cleanup; survivors must detect via heartbeat.
+- ``preempt``          — SIGTERM to self: exercises the graceful
+                         preemption path (checkpoint + flight bundle +
+                         coordinated exit).
+- ``hang_coordinator`` — the worker hosting the coordinator stops it
+                         responding for ``seconds``; peers must survive
+                         via backoff-retry, and the membership reaper
+                         must NOT evict anyone for a hang the
+                         coordinator itself caused.
+- ``truncate_chunk``   — truncates the newest committed checkpoint's
+                         largest chunk file: the next restore must detect
+                         corruption and fall back to the previous step.
+- ``delay_h2d``        — sleeps ``ms`` before the step's dispatch
+                         (models a slow host->device link; exercises
+                         step-barrier timeout margins).
+
+``worker`` omitted means "fires on every worker". Each fault fires at
+most once per process (fire-once), so a restarted worker replaying steps
+after recovery does not re-inject its fault — recovery runs are clean by
+construction.
+
+Faults with side effects outside this module (hang, truncate) are
+dispatched through a handler map the trainer registers, keeping the
+harness free of checkpoint/coordinator imports.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+ENV_KNOB = "DL4J_TPU_FAULT_PLAN"
+
+KINDS = ("kill", "preempt", "hang_coordinator", "truncate_chunk",
+         "delay_h2d")
+
+
+@dataclass
+class Fault:
+    kind: str
+    step: int
+    worker: Optional[int] = None  # None -> every worker
+    args: Dict[str, Any] = field(default_factory=dict)
+    fired: bool = False
+
+    def matches(self, step: int, worker: int) -> bool:
+        return (not self.fired and self.step == int(step)
+                and (self.worker is None or self.worker == int(worker)))
+
+
+class FaultPlan:
+    """An ordered list of `Fault`s plus the dispatch logic."""
+
+    def __init__(self, faults: Optional[List[Fault]] = None):
+        self.faults = list(faults or [])
+
+    def __bool__(self) -> bool:
+        return bool(self.faults)
+
+    # ------------------------------------------------------------ parsing
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        data = json.loads(text)
+        if not isinstance(data, list):
+            raise ValueError("fault plan must be a JSON list of faults")
+        faults = []
+        for i, item in enumerate(data):
+            if not isinstance(item, dict) or "kind" not in item \
+                    or "step" not in item:
+                raise ValueError(
+                    f"fault[{i}]: each fault needs 'kind' and 'step'")
+            kind = str(item["kind"])
+            if kind not in KINDS:
+                raise ValueError(
+                    f"fault[{i}]: unknown kind {kind!r} (have {KINDS})")
+            worker = item.get("worker")
+            args = {k: v for k, v in item.items()
+                    if k not in ("kind", "step", "worker")}
+            faults.append(Fault(kind=kind, step=int(item["step"]),
+                                worker=None if worker is None else int(worker),
+                                args=args))
+        return cls(faults)
+
+    @classmethod
+    def from_env(cls) -> "FaultPlan":
+        """Empty plan when the knob is unset — production is a no-op
+        (`maybe_fire` on an empty plan is one list check)."""
+        raw = os.environ.get(ENV_KNOB, "").strip()
+        if not raw:
+            return cls()
+        if raw.startswith("@"):
+            with open(raw[1:]) as f:
+                raw = f.read()
+        return cls.from_json(raw)
+
+    # ----------------------------------------------------------- dispatch
+
+    def maybe_fire(self, step: int, worker: int,
+                   handlers: Optional[Dict[str, Callable[[Fault], None]]]
+                   = None) -> List[Fault]:
+        """Fire every not-yet-fired fault matching (step, worker).
+
+        Built-in actions for ``kill`` / ``preempt`` / ``delay_h2d``;
+        ``hang_coordinator`` and ``truncate_chunk`` require a handler
+        (missing handler -> the fault is skipped, marked fired, and
+        reported in the return value so tests can assert on it).
+        """
+        fired: List[Fault] = []
+        for fault in self.faults:
+            if not fault.matches(step, worker):
+                continue
+            fault.fired = True
+            fired.append(fault)
+            handler = (handlers or {}).get(fault.kind)
+            if handler is not None:
+                handler(fault)
+            elif fault.kind == "kill":
+                # Hard loss: no atexit, no flushes — mirrors a yanked host.
+                os._exit(137)
+            elif fault.kind == "preempt":
+                os.kill(os.getpid(), signal.SIGTERM)
+            elif fault.kind == "delay_h2d":
+                time.sleep(float(fault.args.get("ms", 100)) / 1000.0)
+            # hang_coordinator / truncate_chunk without a handler: recorded
+            # as fired, no action (the injection point lacks the object).
+        return fired
+
+
+def truncate_newest_chunk(step_dir: str, drop_bytes: int = 64) -> Optional[str]:
+    """Corrupt a committed checkpoint the way interrupted storage does:
+    shave ``drop_bytes`` off the END of the largest chunk file, leaving
+    the manifest + COMMIT marker intact (so only the size/integrity check
+    can catch it). Returns the damaged path, or None if nothing to damage.
+
+    Used by the ``truncate_chunk`` handler and directly by tests.
+    """
+    best, best_size = None, -1
+    for name in os.listdir(step_dir):
+        if name.startswith(("manifest", "COMMIT")):
+            continue
+        p = os.path.join(step_dir, name)
+        if os.path.isfile(p):
+            size = os.path.getsize(p)
+            if size > best_size:
+                best, best_size = p, size
+    if best is None or best_size <= 0:
+        return None
+    with open(best, "r+b") as f:
+        f.truncate(max(0, best_size - int(drop_bytes)))
+    return best

@@ -1,0 +1,146 @@
+"""Exponential-backoff retry primitive (reference analog: the Spark
+training master's fault-tolerant RPC layer — `TrainingMaster` retries
+worker RPCs and Aeron re-offers publications until the media driver
+accepts; PAPER.md scale-out layer).
+
+One policy object, three consumers with very different failure textures:
+
+- elastic cluster join / coordinator RPCs (`parallel/coordinator.py`):
+  the coordinator may not be up yet, or mid-reform — retry for tens of
+  seconds with jitter so a restarted 256-host pod doesn't synchronize
+  its reconnect stampede;
+- checkpoint writes (`checkpoint/manager.py`): NFS/GCS blips are
+  transient, a failed write must not kill the training loop;
+- serving model reload (`serving/host.py`): a reload racing an
+  atomic-rename publish sees a half-moment of ENOENT.
+
+Deliberately dependency-free and jax-free: this must be importable from
+signal handlers and worker subprocesses before jax initializes.
+
+Knobs (the backoff envelope, PERF.md §18):
+
+- ``DL4J_TPU_RETRY_BASE_S``  — first sleep (default 0.1s)
+- ``DL4J_TPU_RETRY_MAX_S``   — per-sleep cap (default 5s)
+- ``DL4J_TPU_RETRY_TRIES``   — default attempt budget (default 5)
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Tuple, Type, TypeVar
+
+T = TypeVar("T")
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        return default
+
+
+class RetryError(Exception):
+    """All attempts exhausted. ``last`` carries the final cause."""
+
+    def __init__(self, message: str, last: Optional[BaseException] = None):
+        super().__init__(message)
+        self.last = last
+
+
+@dataclass
+class Backoff:
+    """Exponential backoff with full jitter (AWS-style: sleep is uniform
+    in [0, min(cap, base * 2^attempt)] — full jitter decorrelates retry
+    stampedes better than equal-jitter for thundering-herd joins).
+
+    ``tries`` counts ATTEMPTS, not sleeps: tries=5 means 5 calls with 4
+    sleeps between them. ``deadline_s`` (optional) bounds total elapsed
+    time regardless of remaining tries — the elastic join path uses a
+    deadline so "coordinator is gone" is detected in bounded time.
+    """
+
+    base_s: float = field(
+        default_factory=lambda: _env_float("DL4J_TPU_RETRY_BASE_S", 0.1))
+    max_s: float = field(
+        default_factory=lambda: _env_float("DL4J_TPU_RETRY_MAX_S", 5.0))
+    tries: int = field(
+        default_factory=lambda: _env_int("DL4J_TPU_RETRY_TRIES", 5))
+    deadline_s: Optional[float] = None
+    jitter: bool = True
+    # Injectable for deterministic tests (fault harness pins these).
+    _sleep: Callable[[float], None] = field(default=time.sleep, repr=False)
+    _rand: Callable[[], float] = field(default=random.random, repr=False)
+
+    def sleep_for(self, attempt: int) -> float:
+        """Sleep duration after failed attempt `attempt` (0-based)."""
+        cap = min(self.max_s, self.base_s * (2.0 ** attempt))
+        return cap * self._rand() if self.jitter else cap
+
+    def run(self, fn: Callable[[], T], *,
+            retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+            on_retry: Optional[Callable[[int, BaseException], None]] = None,
+            describe: str = "operation") -> T:
+        """Call ``fn`` until it returns, a non-retryable exception escapes,
+        or the budget (tries and/or deadline) runs out -> `RetryError`.
+
+        ``on_retry(attempt, exc)`` fires before each sleep — the elastic
+        client uses it to bump `dl4j_elastic_events_total` and log.
+        """
+        start = time.monotonic()
+        last: Optional[BaseException] = None
+        for attempt in range(max(1, self.tries)):
+            try:
+                return fn()
+            except retry_on as exc:  # noqa: PERF203 - retry loop
+                last = exc
+                if attempt + 1 >= max(1, self.tries):
+                    break
+                pause = self.sleep_for(attempt)
+                if (self.deadline_s is not None
+                        and time.monotonic() - start + pause > self.deadline_s):
+                    break
+                if on_retry is not None:
+                    on_retry(attempt, exc)
+                self._sleep(pause)
+        raise RetryError(
+            f"{describe} failed after {max(1, self.tries)} attempts "
+            f"({time.monotonic() - start:.1f}s): {last!r}", last)
+
+
+def with_retries(fn: Callable[[], T], *,
+                 tries: Optional[int] = None,
+                 base_s: Optional[float] = None,
+                 max_s: Optional[float] = None,
+                 deadline_s: Optional[float] = None,
+                 retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+                 on_retry: Optional[Callable[[int, BaseException], None]] = None,
+                 describe: str = "operation") -> T:
+    """Functional shorthand: ``with_retries(lambda: client.join(...))``.
+
+    Defaults come from the env knobs via `Backoff`; explicit kwargs win.
+    """
+    bo = Backoff()
+    if tries is not None:
+        bo.tries = tries
+    if base_s is not None:
+        bo.base_s = base_s
+    if max_s is not None:
+        bo.max_s = max_s
+    bo.deadline_s = deadline_s
+    return bo.run(fn, retry_on=retry_on, on_retry=on_retry, describe=describe)

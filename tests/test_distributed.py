@@ -4,9 +4,23 @@ Reference analog: `dl4j-spark`'s `BaseSparkTest.java:90` local-cluster
 pattern and `TestCompareParameterAveragingSparkVsSingleMachine.java` — the
 key equivalence: distributed training must produce the same parameters as
 single-machine training on the same data. Here two REAL OS processes join
-a `jax.distributed` cluster (Gloo-backed CPU collectives), each feeding
-its half of every global batch through `DistributedTrainer`; process 0
-saves the final params, compared against an in-process single-machine run.
+a `jax.distributed` cluster, each feeding its half of every global batch
+through `DistributedTrainer`; process 0 saves the final params, compared
+against an in-process single-machine run.
+
+Platform gate: `jax.distributed.initialize` succeeds everywhere, but
+XLA:CPU rejects the first cross-process collective with "Multiprocess
+computations aren't implemented on the CPU backend" — so on CPU-only
+hosts these tests SKIP with that reason rather than hang/fail
+(`dist.multiprocess_spmd_supported`). The coordinator-transport analog of
+this equivalence runs everywhere in `tests/test_elastic.py`.
+
+Worker bootstrap notes: virtual device fan-out comes from XLA_FLAGS
+(`--xla_force_host_platform_device_count`) set in the worker env BEFORE
+jax initializes its backend — `jax.config.update("jax_num_cpu_devices")`
+does not exist in this jax and crashes the worker. Cluster join rides
+`dist.initialize`'s built-in backoff retries (worker 1 may dial before
+worker 0 binds), with a generous coordinator handshake timeout.
 """
 
 import os
@@ -23,6 +37,14 @@ from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
 from deeplearning4j_tpu.nn.conf.neural_net import NeuralNetConfiguration
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel import distributed as dist
+
+pytestmark = pytest.mark.skipif(
+    not dist.multiprocess_spmd_supported(),
+    reason="XLA:CPU cannot run cross-process SPMD computations "
+           "(jax.distributed joins, but the first collective fails with "
+           "'Multiprocess computations aren't implemented on the CPU "
+           "backend'); needs a TPU/GPU backend")
 
 STEPS = 5
 BATCH = 16  # global batch; each of 2 processes feeds 8 rows
@@ -55,17 +77,21 @@ def _conf_code():
     """)
 
 
-WORKER = """
+# Cluster join: dist.initialize retries the dial under backoff (worker
+# startup order is unordered) and gives the coordinator handshake a
+# generous window before giving up.
+BOOTSTRAP = """
 import os, sys
 pid = int(sys.argv[1]); port = sys.argv[2]; out = sys.argv[3]
 import jax
-jax.config.update("jax_platforms", "cpu")
-import jax.extend
-jax.extend.backend.clear_backends()
-jax.config.update("jax_num_cpu_devices", 2)
 from deeplearning4j_tpu.parallel import distributed as dist
 dist.initialize(coordinator_address="127.0.0.1:" + port,
-                num_processes=2, process_id=pid)
+                num_processes=2, process_id=pid,
+                initialization_timeout=120)
+"""
+
+
+WORKER = BOOTSTRAP + """
 assert dist.process_count() == 2 and jax.device_count() == 4
 
 {conf_code}
@@ -97,13 +123,17 @@ def _free_port():
     return port
 
 
-def test_two_process_training_matches_single_machine(tmp_path):
+def _run_two_workers(tmp_path, script_text, devices_per_proc=2):
     port = _free_port()
     script = tmp_path / "worker.py"
-    script.write_text(WORKER.format(conf_code=_conf_code(), steps=STEPS))
+    script.write_text(script_text)
     out = tmp_path / "params.npz"
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    # Virtual CPU device fan-out must be in place before the worker's jax
+    # backend initializes — env, not in-process config.
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={devices_per_proc}")
     env["JAX_PLATFORMS"] = "cpu"
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
@@ -112,13 +142,19 @@ def test_two_process_training_matches_single_machine(tmp_path):
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, text=True)
         for pid in (0, 1)]
     try:
-        outputs = [p.communicate(timeout=240)[0] for p in procs]
+        outputs = [p.communicate(timeout=300)[0] for p in procs]
         for p, text in zip(procs, outputs):
             assert p.returncode == 0, f"worker failed:\n{text[-3000:]}"
     finally:
-        for p in procs:  # no orphaned workers stuck in a Gloo barrier
+        for p in procs:  # no orphaned workers stuck in a collective barrier
             if p.poll() is None:
                 p.kill()
+    return out, port
+
+
+def test_two_process_training_matches_single_machine(tmp_path):
+    script = WORKER.format(conf_code=_conf_code(), steps=STEPS)
+    out, _ = _run_two_workers(tmp_path, script)
 
     # Single-machine run on the SAME data stream.
     ns = {}
@@ -165,17 +201,7 @@ GRAPH_CONF = textwrap.dedent("""
 """)
 
 
-GRAPH_WORKER = """
-import os, sys
-pid = int(sys.argv[1]); port = sys.argv[2]; out = sys.argv[3]
-import jax
-jax.config.update("jax_platforms", "cpu")
-import jax.extend
-jax.extend.backend.clear_backends()
-jax.config.update("jax_num_cpu_devices", 2)
-from deeplearning4j_tpu.parallel import distributed as dist
-dist.initialize(coordinator_address="127.0.0.1:" + port,
-                num_processes=2, process_id=pid)
+GRAPH_WORKER = BOOTSTRAP + """
 assert dist.process_count() == 2 and jax.device_count() == 4
 
 {conf_code}
@@ -198,17 +224,7 @@ if pid == 0:
 print("worker", pid, "done", flush=True)
 """
 
-MLN_TP_WORKER = """
-import os, sys
-pid = int(sys.argv[1]); port = sys.argv[2]; out = sys.argv[3]
-import jax
-jax.config.update("jax_platforms", "cpu")
-import jax.extend
-jax.extend.backend.clear_backends()
-jax.config.update("jax_num_cpu_devices", 2)
-from deeplearning4j_tpu.parallel import distributed as dist
-dist.initialize(coordinator_address="127.0.0.1:" + port,
-                num_processes=2, process_id=pid)
+MLN_TP_WORKER = BOOTSTRAP + """
 assert dist.process_count() == 2 and jax.device_count() == 4
 
 {conf_code}
@@ -232,31 +248,6 @@ if pid == 0:
     np.savez(out, **flat)
 print("worker", pid, "done", flush=True)
 """
-
-
-def _run_two_workers(tmp_path, script_text):
-    port = _free_port()
-    script = tmp_path / "worker.py"
-    script.write_text(script_text)
-    out = tmp_path / "params.npz"
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
-    env["JAX_PLATFORMS"] = "cpu"
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
-    procs = [subprocess.Popen(
-        [sys.executable, str(script), str(pid), str(port), str(out)],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, text=True)
-        for pid in (0, 1)]
-    try:
-        outputs = [p.communicate(timeout=240)[0] for p in procs]
-        for p, text in zip(procs, outputs):
-            assert p.returncode == 0, f"worker failed:\n{text[-3000:]}"
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    return out, port
 
 
 def test_two_process_graph_training_matches_single_machine(tmp_path):
@@ -307,17 +298,7 @@ def test_two_process_dp_tp_mesh_matches_single_machine(tmp_path):
                 err_msg=f"param {lk}/{pk} diverged (dp x tp)")
 
 
-CORPUS_WORKER = """
-import os, sys
-pid = int(sys.argv[1]); port = sys.argv[2]; out = sys.argv[3]
-import jax
-jax.config.update("jax_platforms", "cpu")
-import jax.extend
-jax.extend.backend.clear_backends()
-jax.config.update("jax_num_cpu_devices", 2)
-from deeplearning4j_tpu.parallel import distributed as dist
-dist.initialize(coordinator_address="127.0.0.1:" + port,
-                num_processes=2, process_id=pid)
+CORPUS_WORKER = BOOTSTRAP + """
 assert dist.process_count() == 2
 
 import numpy as np
