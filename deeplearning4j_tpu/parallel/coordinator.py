@@ -74,6 +74,24 @@ class ClusterChanged(Exception):
     recover (the elastic supervisor's restart trigger)."""
 
 
+class CoordinatorError(RuntimeError):
+    """The coordinator answered with an error document (an exception
+    caught server-side in `_dispatch`). Usually transient — membership
+    shifted under the op — so the elastic supervisor treats it as
+    recoverable, same as `ClusterChanged`."""
+
+
+def parse_address(address: str,
+                  default_host: str = "127.0.0.1") -> tuple:
+    """``host:port`` -> ``(host, port)``. A bare ``host`` (no colon)
+    means port 0 — ephemeral when binding; when connecting, the socket
+    layer reports it instead of a parse-time ValueError."""
+    host, sep, port = address.rpartition(":")
+    if not sep:
+        return address or default_host, 0
+    return host or default_host, int(port or 0)
+
+
 # ------------------------------------------------------------- wire codecs
 
 def encode_tree(tree: Dict[str, np.ndarray]) -> Dict[str, Any]:
@@ -179,12 +197,24 @@ class Coordinator:
                 if dead:
                     for w in dead:
                         del self._members[w]
-                    self._generation += 1
-                    self._cond.notify_all()
+                    self._bump_generation()
             for w in dead:
                 _ev.record_event("host_lost", worker=w,
                                  lost_after_s=self.lost_after_s)
             time.sleep(min(0.1, self.lost_after_s / 4))
+
+    def _bump_generation(self) -> None:
+        """Advance the generation and purge collective state keyed to
+        superseded generations — every waiter on those keys unblocks with
+        ``regen`` and nobody will ever complete them, so keeping their
+        contribution trees (parameter-sized!) and barrier sets would leak
+        unboundedly across a long elastic run. Call with `_cond` held."""
+        self._generation += 1
+        gen = self._generation
+        for d in (self._contribs, self._barriers):
+            for key in [k for k in d if k[0] != gen]:
+                d.pop(key)
+        self._cond.notify_all()
 
     def _ranked(self) -> List[str]:
         return sorted(self._members)
@@ -224,8 +254,7 @@ class Coordinator:
         with self._cond:
             if worker not in self._members:
                 self._members[worker] = time.monotonic()
-                self._generation += 1
-                self._cond.notify_all()
+                self._bump_generation()
             else:
                 self._members[worker] = time.monotonic()
             if expected:
@@ -235,6 +264,16 @@ class Coordinator:
                     if remaining <= 0:
                         break
                     self._cond.wait(min(remaining, 0.25))
+                    # The joiner heartbeats only AFTER join returns, so
+                    # its lease must stay fresh while IT is the one
+                    # blocked here — with JOIN_GRACE_S > LOST_AFTER_S the
+                    # reaper would otherwise evict the waiting worker and
+                    # the rank lookup below would blow up.
+                    if worker not in self._members:
+                        self._members[worker] = time.monotonic()
+                        self._bump_generation()
+                    else:
+                        self._members[worker] = time.monotonic()
             doc = self._member_doc()
         doc.update(ok=True, rank=doc["members"].index(worker))
         return doc
@@ -255,8 +294,7 @@ class Coordinator:
         with self._cond:
             if worker in self._members:
                 del self._members[worker]
-                self._generation += 1
-                self._cond.notify_all()
+                self._bump_generation()
             doc = self._member_doc()
         doc.update(ok=True)
         return doc
@@ -276,6 +314,7 @@ class Coordinator:
             if gen != self._generation:
                 return {"ok": False, "regen": True, "gen": self._generation}
             self._barriers.setdefault(key, set()).add(worker)
+            self._trim_barriers()
             self._cond.notify_all()
             while True:
                 if self._generation != gen:
@@ -341,6 +380,14 @@ class Coordinator:
         while len(self._results) > keep:
             self._results.pop(next(iter(self._results)))
 
+    def _trim_barriers(self, keep: int = 8) -> None:
+        # Completed barrier sets are never popped by the waiters (each
+        # blocked peer still needs to observe completeness), so bound
+        # them the same way: drop oldest-inserted first — live keys are
+        # the newest, and a per-step run has at most one or two in flight.
+        while len(self._barriers) > keep:
+            self._barriers.pop(next(iter(self._barriers)))
+
 
 # ---------------------------------------------------------------- client
 
@@ -355,8 +402,7 @@ class CoordinatorClient:
     def __init__(self, address: str, worker_id: str,
                  rpc_timeout_s: float = RPC_TIMEOUT_S,
                  backoff: Optional[Backoff] = None):
-        host, _, port = address.rpartition(":")
-        self.host, self.port = host or "127.0.0.1", int(port)
+        self.host, self.port = parse_address(address)
         self.worker_id = str(worker_id)
         self.rpc_timeout_s = float(rpc_timeout_s)
         self.backoff = backoff or Backoff(base_s=0.05, max_s=2.0, tries=8)
@@ -382,7 +428,7 @@ class CoordinatorClient:
             raise ConnectionError("coordinator closed the connection")
         resp = json.loads(line.decode("utf-8"))
         if resp.get("error"):
-            raise RuntimeError(f"coordinator error: {resp['error']}")
+            raise CoordinatorError(f"coordinator error: {resp['error']}")
         return resp
 
     def _rpc(self, doc: Dict[str, Any], timeout_s: Optional[float] = None,

@@ -67,11 +67,11 @@ import numpy as np
 
 from deeplearning4j_tpu.checkpoint.array_store import (
     CheckpointCorruptError, CheckpointError)
-from deeplearning4j_tpu.datasets.iterators import fast_forward
+from deeplearning4j_tpu.datasets.iterators import maybe_reset
 from deeplearning4j_tpu.observability import elastic as _ev
 from deeplearning4j_tpu.parallel.coordinator import (
     BARRIER_TIMEOUT_S, HEARTBEAT_S, JOIN_GRACE_S, ClusterChanged,
-    Coordinator, CoordinatorClient)
+    Coordinator, CoordinatorClient, CoordinatorError, parse_address)
 from deeplearning4j_tpu.util.faultinject import (
     Fault, FaultPlan, truncate_newest_chunk)
 from deeplearning4j_tpu.util.retry import RetryError
@@ -138,10 +138,9 @@ class ElasticTrainer:
         self.coordinator: Optional[Coordinator] = None
         self.client: Optional[CoordinatorClient] = None
         if host_coordinator:
-            host, _, port = (coordinator_address or "127.0.0.1:0"
-                             ).rpartition(":")
+            host, port = parse_address(coordinator_address or "127.0.0.1:0")
             self.coordinator = Coordinator(
-                host or "127.0.0.1", int(port or 0),
+                host, port,
                 lost_after_s=(lost_after_s if lost_after_s is not None
                               else 3 * self.heartbeat_s)).start()
             coordinator_address = self.coordinator.address
@@ -163,6 +162,7 @@ class ElasticTrainer:
         self._preempted = threading.Event()
         self._prev_sigterm: Any = None
         self._recovery_t0: Optional[float] = None
+        self._stream_pos = 0  # batches drawn from an iterator `data`
 
     # ------------------------------------------------------------- signals
 
@@ -287,6 +287,35 @@ class ElasticTrainer:
             return int(net.iteration)
         return None
 
+    def _position_stream(self, data, target: int):
+        """Position a shared iterator `data` at batch `target`. A
+        resettable iterator replays from scratch (the fast-forward
+        contract: same batch stream as an uninterrupted run). A
+        non-resettable one cannot rewind — and on a restart it is
+        already `_stream_pos` batches in, so skip only the delta to the
+        target instead of discarding `target` MORE batches from the
+        current position (which would silently lose training data on
+        every recovery). When the stream is already past the target the
+        gap is unreplayable: warn rather than drop data silently."""
+        if maybe_reset(data):
+            self._stream_pos = 0
+        elif self._stream_pos > target:
+            warnings.warn(
+                f"elastic restart: data iterator is not resettable and is "
+                f"already {self._stream_pos - target} batches past restored "
+                f"step {target}; continuing from the live stream position. "
+                f"Use a resettable iterator or a data_fn(step, rank, world) "
+                f"callable for replay-exact recovery.",
+                RuntimeWarning, stacklevel=2)
+        it = iter(data)
+        while self._stream_pos < target:
+            try:
+                next(it)
+            except StopIteration:
+                break
+            self._stream_pos += 1
+        return it
+
     # ------------------------------------------------------------ training
 
     def _average(self, step: int) -> None:
@@ -361,7 +390,7 @@ class ElasticTrainer:
         world = self.client.world if self.client is not None else 1
         stream = None
         if not callable(data):
-            stream = fast_forward(data, net.iteration)
+            stream = self._position_stream(data, int(net.iteration))
         while net.iteration < int(steps):
             step = int(net.iteration)
             if self.client is not None:
@@ -374,6 +403,8 @@ class ElasticTrainer:
                 ds = data(step, rank, world)
             else:
                 ds = next(stream, None)
+                if ds is not None:
+                    self._stream_pos += 1
             if ds is None:
                 break
             self.wrapper.fit(ds)
@@ -420,7 +451,7 @@ class ElasticTrainer:
                     if status == "finished":
                         self._leave()
                     return result
-                except (ClusterChanged, RetryError) as e:
+                except (ClusterChanged, CoordinatorError, RetryError) as e:
                     self._recovery_t0 = time.monotonic()
                     restarts += 1
                     _ev.RESTARTS.inc()

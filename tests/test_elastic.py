@@ -35,7 +35,8 @@ from deeplearning4j_tpu.nn.conf.neural_net import NeuralNetConfiguration
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.observability.elastic import EVENTS
 from deeplearning4j_tpu.parallel.coordinator import (
-    ClusterChanged, Coordinator, CoordinatorClient)
+    ClusterChanged, Coordinator, CoordinatorClient, CoordinatorError,
+    parse_address)
 from deeplearning4j_tpu.parallel.elastic import ElasticTrainer
 from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
 from deeplearning4j_tpu.util.faultinject import (
@@ -396,6 +397,55 @@ def test_coordinator_evicts_lost_host_and_unblocks_collective():
         coord.close()
 
 
+def test_join_wait_survives_lease_shorter_than_grace():
+    """A joiner blocked waiting for the expected world heartbeats only
+    AFTER join returns — the coordinator must keep its lease fresh while
+    it waits, or the reaper evicts the very worker sitting in the join
+    (join grace > lease is the default configuration)."""
+    coord = Coordinator(lost_after_s=0.3).start()
+    try:
+        c = CoordinatorClient(coord.address, "slowpoke", rpc_timeout_s=10.0)
+        # The second worker never shows: the join blocks through several
+        # full lease periods, then forms the cluster on whoever is there.
+        doc = c.join(expected=2, grace_s=1.2)
+        assert doc["ok"] and doc["members"] == ["slowpoke"]
+        assert doc["rank"] == 0 and doc["world"] == 1
+    finally:
+        coord.close()
+
+
+def test_coordinator_purges_stale_collective_state():
+    coord = Coordinator(lost_after_s=30.0).start()
+    try:
+        a = CoordinatorClient(coord.address, "a", rpc_timeout_s=5.0)
+        a.join(expected=1, grace_s=5.0)
+        # Completed barriers are kept only as a bounded tail.
+        for s in range(20):
+            a.barrier("b", step=s, timeout_s=5.0)
+        with coord._cond:
+            assert 0 < len(coord._barriers) <= 8
+        # Plant an abandoned old-gen contribution (a worker that died
+        # mid-allreduce); any generation bump must purge it and every
+        # old-gen barrier set.
+        with coord._cond:
+            coord._contribs[(a.gen, 99, "orphan")] = {"a": {}}
+        b = CoordinatorClient(coord.address, "b", rpc_timeout_s=5.0)
+        b.join(expected=None, grace_s=5.0)  # gen bump
+        with coord._cond:
+            assert not coord._contribs
+            assert not coord._barriers
+    finally:
+        coord.close()
+
+
+def test_parse_address_portless():
+    assert parse_address("myhost:1234") == ("myhost", 1234)
+    assert parse_address(":1234") == ("127.0.0.1", 1234)
+    assert parse_address("myhost") == ("myhost", 0)  # no ValueError
+    c = CoordinatorClient("myhost", "w")  # parse-time must not raise
+    assert (c.host, c.port) == ("myhost", 0)
+
+
 # --------------------------------------------- ElasticTrainer, in-process
 
 def test_elastic_single_process_train_and_resume(tmp_path):
@@ -438,6 +488,57 @@ def test_elastic_iterator_data_fast_forwards_on_resume(tmp_path):
     assert res.step == 8  # restored 4, fast-forwarded, trained 4..7
     assert_params_close(flat_params(net2), reference_params(8),
                         rtol=1e-6, atol=1e-9)
+
+
+def test_position_stream_non_resettable_skips_only_delta():
+    """On an in-run restart the shared iterator is already partially
+    consumed; a non-resettable stream must skip only the delta to the
+    restored step — not `restored_step` MORE batches from the current
+    position (silent training-data loss on every recovery)."""
+    net = MultiLayerNetwork(make_conf()).init()
+    tr = ElasticTrainer(ParallelWrapper(net, workers=1),
+                        fault_plan=FaultPlan())
+    gen = iter([full_batch(s) for s in range(8)])  # no reset()
+
+    stream = tr._position_stream(gen, 2)  # fresh start restored at step 2
+    np.testing.assert_array_equal(next(stream).features,
+                                  full_batch(2).features)
+    tr._stream_pos += 1  # the train loop accounts for each draw
+
+    # Restart restored at step 3 == current position: skip NOTHING.
+    stream = tr._position_stream(gen, 3)
+    np.testing.assert_array_equal(next(stream).features,
+                                  full_batch(3).features)
+    tr._stream_pos += 1
+
+    # Restored step behind the live position: unreplayable -> warn,
+    # continue from where the stream actually is.
+    with pytest.warns(RuntimeWarning, match="not resettable"):
+        stream = tr._position_stream(gen, 1)
+    np.testing.assert_array_equal(next(stream).features,
+                                  full_batch(4).features)
+
+
+def test_coordinator_error_is_recoverable(monkeypatch):
+    """An error document from the coordinator (e.g. a transient
+    membership-shape failure) must consume the restart budget, not kill
+    the run outright."""
+    net = MultiLayerNetwork(make_conf()).init()
+    tr = ElasticTrainer(ParallelWrapper(net, workers=1),
+                        fault_plan=FaultPlan(), max_restarts=2)
+    real = tr._train
+    calls = {"n": 0}
+
+    def flaky(data, steps, result):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise CoordinatorError("coordinator error: ValueError: boom")
+        return real(data, steps, result)
+
+    monkeypatch.setattr(tr, "_train", flaky)
+    res = tr.run(shard_fn, steps=3)
+    assert res.status == "finished" and res.step == 3
+    assert res.restarts == 1
 
 
 def test_elastic_sigterm_preempt_checkpoints_and_exits(tmp_path, monkeypatch):
