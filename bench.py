@@ -474,6 +474,147 @@ def bench_char_rnn(steps, warmup):
     return e
 
 
+def _kernel_env(**vars):
+    """Set kernel-registry env knobs for one bench leg and drop the
+    resolution memo so the leg re-resolves under them; returns a restore
+    callable. Value None deletes the var."""
+    from deeplearning4j_tpu.kernels import registry
+
+    saved = {k: os.environ.get(k) for k in vars}
+    for k, v in vars.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    registry.clear_cache()
+
+    def restore():
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        registry.clear_cache()
+
+    return restore
+
+
+def _dispatch_counts(kernel):
+    """Current dl4j_kernel_dispatch_total values for one kernel, by impl."""
+    from deeplearning4j_tpu import observability as obs
+
+    fam = obs.metrics.to_json().get("dl4j_kernel_dispatch_total")
+    out = {}
+    for s in (fam or {"series": []})["series"]:
+        if s["labels"]["kernel"] == kernel:
+            out[s["labels"]["impl"]] = out.get(s["labels"]["impl"], 0) \
+                + s["value"]
+    return out
+
+
+def _impl_delta(before, after):
+    """The impl the bench leg actually dispatched (largest count delta)."""
+    deltas = {k: after.get(k, 0) - before.get(k, 0)
+              for k in set(after) | set(before)}
+    return max(deltas, key=deltas.get) if deltas else "none"
+
+
+def bench_char_rnn_fused_lstm(steps, warmup):
+    """Kernel-registry tentpole (PERF.md §19): char-RNN with the fused
+    Pallas LSTM cell (`auto`: picks Pallas on TPU, hidden=256 is
+    lane-aligned) against `DL4J_TPU_KERNELS=xla` (the bit-stable pre-
+    registry scan body) on the SAME device-cached data in the SAME run —
+    the ratio is the cell fusion, not transport variance. Off-TPU both
+    legs resolve the XLA fallback and the ratio reads ~1.0; the entry
+    records which impl actually dispatched."""
+    from deeplearning4j_tpu.models import zoo
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    batch = int(os.environ.get("BENCH_BATCH_CHAR_RNN", "32"))
+    vocab, hidden, t = 77, 256, 100
+
+    def mk(rng, b):
+        idx = rng.randint(0, vocab, (b, t))
+        x = np.eye(vocab, dtype="float32")[idx]
+        y = np.eye(vocab, dtype="float32")[np.roll(idx, -1, axis=1)]
+        return x, y
+
+    restore = _kernel_env(DL4J_TPU_KERNELS="xla", DL4J_TPU_KERNEL_LSTM_CELL=None)
+    try:
+        xla_net = MultiLayerNetwork(zoo.char_rnn(vocab_size=vocab,
+                                                 hidden=hidden)).init()
+        xla_sps, _ = _timed_fit(xla_net, mk, batch, steps, warmup,
+                                cached=True)
+    finally:
+        restore()
+
+    restore = _kernel_env(DL4J_TPU_KERNELS=None, DL4J_TPU_KERNEL_LSTM_CELL=None)
+    try:
+        before = _dispatch_counts("lstm_cell")
+        fused_net = MultiLayerNetwork(zoo.char_rnn(vocab_size=vocab,
+                                                   hidden=hidden)).init()
+        fused_sps, _ = _timed_fit(fused_net, mk, batch, steps, warmup,
+                                  cached=True)
+        impl = _impl_delta(before, _dispatch_counts("lstm_cell"))
+    finally:
+        restore()
+
+    head = _entry("char_rnn_fused_lstm_samples_per_sec", fused_sps,
+                  "samples/sec",
+                  note=f"auto-resolved lstm_cell impl: {impl}; hidden=256")
+    head["xla_fallback_same_run"] = round(xla_sps, 1)
+    ratio = _entry("char_rnn_fused_lstm_vs_xla_ratio",
+                   fused_sps / max(xla_sps, 1e-9), "x (same-run)")
+    return head, ratio
+
+
+def bench_fused_update_superstep(steps, warmup):
+    """Fused optimizer update through the superstep carry (PERF.md §19):
+    device-cached LeNet (nesterovs) at superstep k=8 with the fused
+    flat-vector update kernel (`auto`) vs the per-leaf tree_map fallback
+    (`DL4J_TPU_KERNEL_FUSED_UPDATE=xla`), same run, same cached data."""
+    from deeplearning4j_tpu.models import zoo
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    batch = int(os.environ.get("BENCH_BATCH_LENET", "512"))
+    k = int(os.environ.get("BENCH_SUPERSTEP_K", "8"))
+    distinct = 2 * k  # >= 2 full K-blocks per epoch (see lenet_superstep)
+
+    def mk(rng, b):
+        return (rng.rand(b, 28, 28, 1).astype("float32"),
+                np.eye(10, dtype="float32")[rng.randint(0, 10, b)])
+
+    def run():
+        conf = zoo.lenet_mnist()
+        conf.global_conf.superstep_k = k
+        net = MultiLayerNetwork(conf).init()
+        return _timed_fit(net, mk, batch, steps, warmup,
+                          distinct=distinct, cached=True)[0]
+
+    restore = _kernel_env(DL4J_TPU_KERNEL_FUSED_UPDATE="xla")
+    try:
+        xla_sps = run()
+    finally:
+        restore()
+
+    restore = _kernel_env(DL4J_TPU_KERNEL_FUSED_UPDATE=None)
+    try:
+        before = _dispatch_counts("fused_update")
+        fused_sps = run()
+        impl = _impl_delta(before, _dispatch_counts("fused_update"))
+    finally:
+        restore()
+
+    head = _entry(f"fused_update_superstep_k{k}_samples_per_sec", fused_sps,
+                  "samples/sec",
+                  note=f"auto-resolved fused_update impl: {impl}; "
+                       "nesterovs through the superstep carry")
+    head["xla_fallback_same_run"] = round(xla_sps, 1)
+    ratio = _entry("fused_update_superstep_vs_xla_ratio",
+                   fused_sps / max(xla_sps, 1e-9), "x (same-run)")
+    return head, ratio
+
+
 def bench_word2vec(steps, warmup):
     """BASELINE.md config 4: Word2Vec skip-gram-HS on a synthetic
     text8-scale corpus (Zipf unigram distribution), words/sec through the
@@ -1153,7 +1294,8 @@ def main():
     warmup = int(os.environ.get("BENCH_WARMUP", "5"))
     configs = os.environ.get(
         "BENCH_CONFIGS",
-        "resnet50,resnet50_bf16,lenet,char_rnn,lenet_step,lenet_superstep,"
+        "resnet50,resnet50_bf16,lenet,char_rnn,char_rnn_fused_lstm,"
+        "lenet_step,lenet_superstep,fused_update_superstep,"
         "lenet_cold_warm,word2vec,vgg16,flash_attn,flash_tri,transformer,"
         "serving_slo,lm_int8_serving,obs_overhead,elastic_recovery"
     ).split(",")
@@ -1180,6 +1322,13 @@ def main():
         # Same >=200-step floor as the other lenet configs: the compared
         # loops must both dwarf the tail sync RTT (PERF.md §4).
         for e in bench_lenet_superstep(max(200, steps), warmup):
+            extra[e["metric"]] = e
+    if "char_rnn_fused_lstm" in configs:
+        # Same >=80-batch floor as char_rnn (tail sync RTT, PERF.md §4).
+        for e in bench_char_rnn_fused_lstm(max(80, steps), warmup):
+            extra[e["metric"]] = e
+    if "fused_update_superstep" in configs:
+        for e in bench_fused_update_superstep(max(200, steps), warmup):
             extra[e["metric"]] = e
     if "lenet_cold_warm" in configs:
         e = bench_lenet_cold_vs_warm(steps, warmup)

@@ -5,12 +5,13 @@ The reference DL4J stack validated configuration on the JVM side
 This package is the JAX port's equivalent, split in two:
 
 - **Static** (`linter.py`, `rules.py`): an AST pass over every module in
-  the package with framework-aware rules (JX001-JX008) for the failure
+  the package with framework-aware rules (JX001-JX010) for the failure
   modes that are *silent* on TPU — host syncs inside traced code, Python
   side effects baked in at trace time, retrace storms, accidental
   float64, unlocked cross-thread mutation, dtype-sniffing on user input,
   AOT machinery outside `compilation/`, metrics family creation in hot
-  paths.
+  paths, hardcoded compute dtypes in layer kernels, and Pallas
+  imports outside the kernel registry (`kernels/`, JX010).
   Run it with ``python -m deeplearning4j_tpu.analysis`` (or the
   ``tpulint`` console script); findings are suppressible inline
   (``# tpulint: disable=JX001``) or grandfathered in a checked-in

@@ -675,3 +675,55 @@ class HardcodedComputeDtypeRule(Rule):
                             f"dtype={kw.value.value!r} string literal in a "
                             "layer kernel defeats the DtypePolicy compute "
                             "dtype — derive it from the incoming arrays")
+
+
+@register_rule
+class PallasOutsideKernelsRule(Rule):
+    """JX010: direct Pallas import/use outside `kernels/`.
+
+    Mirror of JX007 for the accelerated-kernel layer: a `pallas_call`
+    scattered outside `deeplearning4j_tpu/kernels/` bypasses the kernel
+    registry — no `DL4J_TPU_KERNELS` fallback policy, no per-jit-
+    signature availability probe, no `dl4j_kernel_dispatch_total`
+    accounting, no parity-test enforcement, and the jit-cache/AOT
+    fingerprints don't know the program's kernel selection. The one
+    sanctioned home for `jax.experimental.pallas` is the `kernels/`
+    package; everything else dispatches through `kernels.registry`.
+    """
+
+    id = "JX010"
+    description = ("direct pallas import / pl.pallas_call outside "
+                   "kernels/ (bypasses the kernel registry)")
+
+    def check(self, ctx):
+        rel = ctx.rel.replace("\\", "/")
+        if ("/kernels/" in rel or rel.startswith("kernels/")
+                or "/analysis/" in rel):
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if "pallas" in a.name.split("."):
+                        yield self.finding(
+                            ctx, node,
+                            f"`import {a.name}` outside kernels/: Pallas "
+                            "kernels live behind the registry "
+                            "(kernels/registry.py) so they carry a "
+                            "fallback policy and parity tests")
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                names = [a.name for a in node.names]
+                if ("pallas" in mod.split(".")
+                        or "pallas" in names):
+                    yield self.finding(
+                        ctx, node,
+                        "pallas import outside kernels/: add the kernel "
+                        "under kernels/ with an XLA fallback and resolve "
+                        "it through kernels.registry")
+            elif (isinstance(node, ast.Attribute)
+                  and node.attr == "pallas_call"):
+                yield self.finding(
+                    ctx, node,
+                    "`.pallas_call` outside kernels/: raw kernel "
+                    "invocations bypass the registry's availability "
+                    "probe, mode knobs, and dispatch metric")

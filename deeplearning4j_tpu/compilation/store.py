@@ -117,6 +117,7 @@ def build_fingerprint_doc(net, kind: str, static: Dict[str, Any],
     import jax
     import jaxlib
 
+    from deeplearning4j_tpu.kernels import registry as _kernels_registry
     from deeplearning4j_tpu.parallel.context import context_cache_key
 
     dev = jax.devices()
@@ -128,6 +129,10 @@ def build_fingerprint_doc(net, kind: str, static: Dict[str, Any],
         "static": sorted((str(k), repr(v)) for k, v in static.items()),
         "signature": tree_signature(args),
         "context": _context_desc(context_cache_key()),
+        # Kernel-registry selection (kernels/registry.py): a knob flip
+        # resolves different kernel impls inside the traced program, so a
+        # cached executable from another config must not be served.
+        "kernels": _kernels_registry.config_fingerprint(),
         "jax": jax.__version__,
         "jaxlib": jaxlib.__version__,
         "backend": str(dev[0].platform) if dev else "none",

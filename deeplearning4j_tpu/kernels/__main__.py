@@ -1,0 +1,47 @@
+"""``python -m deeplearning4j_tpu.kernels`` — kernel resolution report.
+
+Prints, for every registered kernel, the active mode (and which env knob
+set it), the implementation that resolves on this process's backend at a
+generic signature, and the availability reason. ``--json`` emits the
+same rows as a JSON list.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m deeplearning4j_tpu.kernels",
+        description="List kernel-registry resolutions (and why).")
+    ap.add_argument("--json", action="store_true", help="emit JSON rows")
+    ap.add_argument("--backend", default=None,
+                    help="probe as this backend (default: the process's "
+                         "jax.default_backend())")
+    args = ap.parse_args(argv)
+
+    from deeplearning4j_tpu.kernels import registry
+
+    rows = registry.describe(backend=args.backend)
+    if args.json:
+        print(json.dumps(rows, indent=2))
+        return 0
+    import jax
+
+    backend = args.backend or jax.default_backend()
+    print(f"kernel registry on backend={backend} "
+          f"(DL4J_TPU_KERNELS + per-kernel DL4J_TPU_KERNEL_<NAME>):")
+    w = max(len(r["kernel"]) for r in rows)
+    for r in rows:
+        mode = r["mode"] if r["mode_source"] == "default" else (
+            f"{r['mode']} [{r['mode_source']}]")
+        print(f"  {r['kernel']:<{w}}  mode={mode:<10} -> {r['impl']:<6} "
+              f"{r['reason']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,36 @@
+"""Autodiff seam for forward-only Pallas kernels.
+
+`pl.pallas_call` carries no JVP/VJP rule, so a Pallas kernel sitting in a
+layer's forward pass would fail the engines' `jax.value_and_grad` trace.
+The flash-attention kernel hand-writes its backward; the simpler fused
+kernels (LSTM cell, norm+activation) instead pair the Pallas FORWARD with
+the VJP of their XLA reference: residuals are the primal inputs, and the
+backward recomputes the reference forward to transpose it (standard
+rematerialization — the backward math is exactly the fallback's, so
+gradients are float-close to the XLA path by construction while the
+forward value comes from the fused kernel).
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def pallas_fwd_ref_bwd(pallas_fn, ref_fn):
+    """`pallas_fn` and `ref_fn` share one signature (pytree args allowed,
+    None for absent operands). Returns a differentiable callable running
+    `pallas_fn` forward and `ref_fn`'s VJP backward."""
+
+    @jax.custom_vjp
+    def f(*args):
+        return pallas_fn(*args)
+
+    def fwd(*args):
+        return pallas_fn(*args), args
+
+    def bwd(args, g):
+        _, vjp = jax.vjp(ref_fn, *args)
+        return vjp(g)
+
+    f.defvjp(fwd, bwd)
+    return f

@@ -1,0 +1,180 @@
+"""Fused normalize + affine + activation for BatchNorm / LayerNorm.
+
+`nn/layers/normalization.py` computes batch statistics (a reduction XLA
+already does well, and whose single-pass form is part of the bit-
+exactness contract) and then runs an elementwise chain — normalize,
+scale/shift, activation — that re-reads the activation tensor from HBM
+between fusion boundaries. The Pallas path runs that chain in one VMEM
+pass over the `[rows, features]` view: BatchNorm takes the (XLA-computed)
+mean/var as operands; LayerNorm computes its per-row stats in-kernel.
+
+The XLA fallbacks are the LITERAL pre-registry expressions moved here
+verbatim — same ops, same order — so `DL4J_TPU_KERNELS=xla` (and auto
+off-TPU) produces bit-identical jaxprs to the pre-PR layers.
+
+Availability (auto): TPU backend, float32, activation in the in-kernel
+set, feature dim a lane (128) multiple and row count a sublane (8)
+multiple. Forced `pallas` keeps the structural constraints and runs
+interpret mode off-TPU (the CPU parity tests' path).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.kernels import registry
+
+_ACTS = {
+    "identity": lambda x: x,
+    "relu": lambda x: jnp.maximum(x, 0.0),
+    "tanh": jnp.tanh,
+    "sigmoid": jax.nn.sigmoid,
+}
+
+
+def _pallas_available(backend, shapes, dtypes, meta=(), forced=False):
+    m = dict(meta)
+    act = m.get("act")
+    if act is not None and act not in _ACTS:
+        return False, f"activation {act!r} not expressible in-kernel"
+    if dtypes and any(d != "float32" for d in set(dtypes)):
+        return False, f"dtype {sorted(set(dtypes))} != float32"
+    if forced and backend != "tpu":
+        return True, "forced (interpret mode off-TPU)"
+    if backend != "tpu":
+        return False, (f"Pallas norm+act needs the TPU backend, have "
+                       f"{backend} (DL4J_TPU_KERNEL_NORM_ACT=pallas forces "
+                       "interpret mode)")
+    if not shapes:
+        return True, "TPU backend (shapes unknown: assumed tile-aligned)"
+    rows, feats = shapes
+    if feats % 128 or rows % 8:
+        return False, (f"rows={rows}, features={feats} not tile-aligned "
+                       "(need features % 128 == 0 and rows % 8 == 0)")
+    return True, ("forced (TPU, tile-aligned)" if forced
+                  else "TPU fused normalize+affine+activation")
+
+
+def _xla_available(backend, shapes, dtypes, meta=(), forced=False):
+    return True, "XLA elementwise chain (bit-identical to the pre-registry layers)"
+
+
+registry.register("norm_act", [
+    registry.KernelImpl("pallas", _pallas_available),
+    registry.KernelImpl("xla", _xla_available),
+])
+
+
+# ------------------------------------------------------- XLA fallbacks
+# Moved VERBATIM from nn/layers/normalization.py (bit-exactness contract).
+
+
+def batchnorm_xla(x, mean, var, gamma, beta, eps, activation):
+    from deeplearning4j_tpu.nn import activations
+
+    xhat = (x - mean) / jnp.sqrt(var + eps)
+    out = gamma * xhat + beta
+    return activations.resolve(activation)(out)
+
+
+def layernorm_xla(x, gamma, beta, eps, activation):
+    from deeplearning4j_tpu.nn import activations
+
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean((x - mu) ** 2, axis=-1, keepdims=True)
+    out = (x - mu) * jax.lax.rsqrt(var + eps)
+    out = out * gamma + beta
+    return activations.resolve(activation)(out)
+
+
+# -------------------------------------------------------- Pallas path
+
+
+def _bn_kernel(eps, act_name, x_ref, mu_ref, var_ref, g_ref, b_ref, o_ref):
+    xhat = (x_ref[...] - mu_ref[...]) / jnp.sqrt(var_ref[...] + eps)
+    o_ref[...] = _ACTS[act_name](g_ref[...] * xhat + b_ref[...])
+
+
+def _ln_kernel(eps, act_name, x_ref, g_ref, b_ref, o_ref):
+    x = x_ref[...]
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean((x - mu) ** 2, axis=-1, keepdims=True)
+    out = (x - mu) * jax.lax.rsqrt(var + eps)
+    o_ref[...] = _ACTS[act_name](out * g_ref[...] + b_ref[...])
+
+
+@functools.lru_cache(maxsize=64)
+def _norm_call(op: str, rows: int, feats: int, eps: float, act_name: str,
+               interpret: bool):
+    from jax.experimental import pallas as pl
+
+    body = functools.partial(
+        _bn_kernel if op == "batchnorm" else _ln_kernel, eps, act_name)
+    return pl.pallas_call(
+        body, out_shape=jax.ShapeDtypeStruct((rows, feats), jnp.float32),
+        interpret=interpret)
+
+
+def _row_view(a):
+    """Feature-last tensors of any rank as [rows, features]."""
+    return a.reshape(-1, a.shape[-1])
+
+
+def _vec(v, feats, dtype):
+    """gamma/beta/mean/var as a broadcastable [1, features] row — scalars
+    (the `lock_gamma_beta` constants) are materialized."""
+    return jnp.broadcast_to(jnp.asarray(v, dtype), (feats,)).reshape(1, feats)
+
+
+def _signature(op, x, activation):
+    rows = 1
+    for d in x.shape[:-1]:
+        rows *= int(d)
+    return dict(shapes=(rows, int(x.shape[-1])), dtypes=(str(x.dtype),),
+                meta=(("op", op), ("act", str(activation))))
+
+
+def batchnorm_norm_act(x, mean, var, gamma, beta, eps, activation):
+    """`nn/layers/normalization.py::batchnorm_apply`'s seam: normalize
+    with the given (already-reduced) statistics, apply scale/shift, then
+    the conf activation."""
+    res = registry.resolve("norm_act", **_signature("batchnorm", x, activation))
+    if res.impl != "pallas":
+        return batchnorm_xla(x, mean, var, gamma, beta, eps, activation)
+    from deeplearning4j_tpu.kernels import _diff
+
+    feats = x.shape[-1]
+    call = _norm_call("batchnorm", _row_view(x).shape[0], int(feats),
+                      float(eps), str(activation),
+                      interpret=jax.default_backend() != "tpu")
+    # Pallas forward, XLA-reference backward: the seam sits inside the
+    # engines' value_and_grad (kernels/_diff.py).
+    f = _diff.pallas_fwd_ref_bwd(
+        call, lambda xv, mu, vr, g, b: batchnorm_xla(xv, mu, vr, g, b,
+                                                     eps, activation))
+    out = f(_row_view(x), _vec(mean, feats, x.dtype),
+            _vec(var, feats, x.dtype), _vec(gamma, feats, x.dtype),
+            _vec(beta, feats, x.dtype))
+    return out.reshape(x.shape)
+
+
+def layernorm_norm_act(x, gamma, beta, eps, activation):
+    """`nn/layers/normalization.py::layernorm_apply`'s seam: per-row stats
+    + normalize + affine + activation."""
+    res = registry.resolve("norm_act", **_signature("layernorm", x, activation))
+    if res.impl != "pallas":
+        return layernorm_xla(x, gamma, beta, eps, activation)
+    from deeplearning4j_tpu.kernels import _diff
+
+    feats = x.shape[-1]
+    call = _norm_call("layernorm", _row_view(x).shape[0], int(feats),
+                      float(eps), str(activation),
+                      interpret=jax.default_backend() != "tpu")
+    f = _diff.pallas_fwd_ref_bwd(
+        call, lambda xv, g, b: layernorm_xla(xv, g, b, eps, activation))
+    out = f(_row_view(x), _vec(gamma, feats, x.dtype),
+            _vec(beta, feats, x.dtype))
+    return out.reshape(x.shape)

@@ -1,0 +1,235 @@
+"""Kernel registry: one dispatch seam for accelerated (Pallas) kernels.
+
+The reference framework discovers per-backend "helper" implementations
+(`ConvolutionHelper`/`LSTMHelper`, PAPER.md layer 1) with a portable
+fallback when no accelerated helper applies. This module is the JAX
+port's equivalent: each kernel name maps to an ORDERED list of candidate
+implementations, each declaring `is_available(backend, shapes, dtypes)`,
+and `resolve()` picks the first available one — memoized per
+(kernel, mode, backend, signature) so the probe runs once per distinct
+jit signature, not once per dispatch (the superstep block-restack path
+calls into the seam for every block; a memo hit must not re-probe).
+
+Selection is part of the PROGRAM IDENTITY: `nn/jit_cache.py` folds
+`config_key()` into every cache key and `compilation/store.py` folds
+`config_fingerprint()` into the AOT fingerprint document, so flipping a
+kernel knob can never serve a stale cached program or executable.
+
+Env knobs (read at resolve time, so tests can monkeypatch):
+
+- ``DL4J_TPU_KERNELS=auto|xla|pallas`` — global mode. ``auto`` (default)
+  picks the first candidate whose availability probe passes — Pallas on
+  TPU when the shape/dtype/activation constraints hold, the bit-stable
+  XLA fallback otherwise. ``xla`` forces the fallback everywhere (the CI
+  default contract: bit-identical to the pre-registry inline code).
+  ``pallas`` forces the Pallas candidate where structurally possible
+  (interpret mode off-TPU — numerics float-close, speed irrelevant;
+  parity tests run this way on the CPU mesh).
+- ``DL4J_TPU_KERNEL_<NAME>`` (e.g. ``DL4J_TPU_KERNEL_LSTM_CELL``) —
+  per-kernel override, same values, wins over the global mode.
+
+``python -m deeplearning4j_tpu.kernels`` prints what resolves and why.
+
+Registration is lazy: kernel modules self-register at import, and
+`resolve()`/`describe()` import them on demand, so importing the
+registry (which every jit-cache key construction does) stays cheap.
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import threading
+from typing import Callable, NamedTuple, Optional, Sequence, Tuple
+
+from deeplearning4j_tpu import observability as _obs
+
+MODES = ("auto", "xla", "pallas")
+
+# Kernel name -> module that registers its candidates at import.
+KERNEL_MODULES = {
+    "lstm_cell": "deeplearning4j_tpu.kernels.lstm_cell",
+    "fused_update": "deeplearning4j_tpu.kernels.fused_update",
+    "norm_act": "deeplearning4j_tpu.kernels.norm_act",
+    "flash_attention": "deeplearning4j_tpu.kernels.flash_attention",
+}
+
+
+class KernelImpl(NamedTuple):
+    """One candidate implementation of a kernel.
+
+    `is_available(backend, shapes, dtypes, meta=(), forced=False)`
+    returns `(ok, reason)`. `forced` relaxes backend/tiling requirements
+    that Pallas interpret mode does not need (a forced impl must still
+    refuse structurally impossible cases, e.g. an activation the kernel
+    cannot express — resolution then falls back with the reason in the
+    `Resolution`)."""
+
+    name: str
+    is_available: Callable[..., Tuple[bool, str]]
+
+
+class Resolution(NamedTuple):
+    kernel: str
+    impl: str
+    reason: str
+
+
+_REGISTRY: dict = {}
+_MEMO: dict = {}
+_LOCK = threading.Lock()
+_PROBES = 0  # is_available invocations, for the hoisting counter assertion
+
+# Per-JX008 convention: family at import, children cached, `.inc()` in the
+# (trace-time) dispatch path.
+_M_DISPATCH = _obs.metrics.counter(
+    "dl4j_kernel_dispatch_total",
+    "kernel dispatch-seam resolutions by kernel name and resolved impl",
+    label_names=("kernel", "impl"))
+_DISPATCH_CHILDREN: dict = {}
+
+
+def register(kernel: str, impls: Sequence[KernelImpl]) -> None:
+    """Register the ordered candidate list for `kernel` (first available
+    wins in `auto` mode). Re-registration replaces — module reload safe."""
+    _REGISTRY[kernel] = tuple(impls)
+
+
+def _ensure(kernel: str) -> None:
+    if kernel not in _REGISTRY:
+        mod = KERNEL_MODULES.get(kernel)
+        if mod is None:
+            raise KeyError(f"unknown kernel {kernel!r}; known: "
+                           f"{sorted(KERNEL_MODULES)}")
+        importlib.import_module(mod)  # self-registers
+
+
+def kernel_names() -> Tuple[str, ...]:
+    return tuple(sorted(KERNEL_MODULES))
+
+
+def mode_for(kernel: str) -> Tuple[str, str]:
+    """(mode, source) for one kernel: the per-kernel env override if set,
+    else the global `DL4J_TPU_KERNELS`, else `auto`."""
+    per = os.environ.get("DL4J_TPU_KERNEL_" + kernel.upper())
+    if per:
+        if per not in MODES:
+            raise ValueError(
+                f"DL4J_TPU_KERNEL_{kernel.upper()}={per!r}: want one of {MODES}")
+        return per, "DL4J_TPU_KERNEL_" + kernel.upper()
+    glob = os.environ.get("DL4J_TPU_KERNELS")
+    if glob:
+        if glob not in MODES:
+            raise ValueError(
+                f"DL4J_TPU_KERNELS={glob!r}: want one of {MODES}")
+        return glob, "DL4J_TPU_KERNELS"
+    return "auto", "default"
+
+
+def config_key() -> Tuple:
+    """The kernel-selection identity of the process env: folded into every
+    jit-cache key (`nn/jit_cache.py`) so a knob flip can never reuse a
+    program traced under a different selection."""
+    return tuple((k, mode_for(k)[0]) for k in kernel_names())
+
+
+def config_fingerprint() -> dict:
+    """JSON-able form of `config_key()` for the AOT fingerprint document
+    (`compilation/store.py::build_fingerprint_doc`)."""
+    return {k: mode_for(k)[0] for k in kernel_names()}
+
+
+def probe_count() -> int:
+    """Total `is_available` probe invocations this process — the hoisting
+    contract (tests): repeated same-signature blocks add ZERO probes."""
+    return _PROBES
+
+
+def clear_cache() -> None:
+    """Drop the resolution memo (tests flip env knobs between asserts)."""
+    with _LOCK:
+        _MEMO.clear()
+
+
+def _probe(impl: KernelImpl, backend, shapes, dtypes, meta, forced) -> Tuple[bool, str]:
+    global _PROBES
+    _PROBES += 1
+    return impl.is_available(backend, shapes, dtypes, meta=meta, forced=forced)
+
+
+def _count_dispatch(kernel: str, impl: str) -> None:
+    child = _DISPATCH_CHILDREN.get((kernel, impl))
+    if child is None:
+        child = _DISPATCH_CHILDREN.setdefault(
+            (kernel, impl), _M_DISPATCH.labels(kernel=kernel, impl=impl))
+    child.inc()
+
+
+def _default_backend() -> str:
+    import jax
+
+    return jax.default_backend()
+
+
+def resolve(kernel: str, *, backend: Optional[str] = None,
+            shapes: Tuple = (), dtypes: Tuple = (), meta: Tuple = ()) -> Resolution:
+    """Pick the implementation for `kernel` under the current env mode.
+
+    `shapes`/`dtypes`/`meta` are hashable tuples describing the call
+    signature (layer dims, leaf dtypes, activation names, ...); they key
+    the memo together with (kernel, mode, backend), so resolution — and
+    its `is_available` probes — runs once per distinct jit signature.
+    Called at trace time only; the result feeds static Python dispatch,
+    never a traced value."""
+    if backend is None:
+        backend = _default_backend()
+    _ensure(kernel)
+    mode, source = mode_for(kernel)
+    key = (kernel, mode, backend, shapes, dtypes, meta)
+    with _LOCK:
+        res = _MEMO.get(key)
+    if res is None:
+        res = _resolve_uncached(kernel, mode, source, backend, shapes,
+                                dtypes, meta)
+        with _LOCK:
+            res = _MEMO.setdefault(key, res)
+    _count_dispatch(kernel, res.impl)
+    return res
+
+
+def _resolve_uncached(kernel, mode, source, backend, shapes, dtypes,
+                      meta) -> Resolution:
+    candidates = _REGISTRY[kernel]
+    note = ""
+    if mode != "auto":
+        forced = next((c for c in candidates if c.name == mode), None)
+        if forced is not None:
+            ok, reason = _probe(forced, backend, shapes, dtypes, meta,
+                                forced=True)
+            if ok:
+                return Resolution(kernel, mode,
+                                  f"forced via {source}: {reason}")
+            note = f"{mode} forced via {source} but unavailable ({reason}); "
+        else:
+            note = f"{mode} forced via {source} but not a candidate; "
+    last = None
+    for c in candidates:
+        ok, reason = _probe(c, backend, shapes, dtypes, meta, forced=False)
+        last = Resolution(kernel, c.name, note + reason)
+        if ok:
+            return last
+    # No candidate available (should not happen: every kernel registers an
+    # unconditional XLA fallback) — surface the last probe's reason.
+    return last
+
+
+def describe(backend: Optional[str] = None):
+    """Resolution table for every registered kernel at a generic (shapeless)
+    signature — the CLI's payload and the smoke tests' hook."""
+    rows = []
+    for name in kernel_names():
+        mode, source = mode_for(name)
+        res = resolve(name, backend=backend)
+        rows.append({"kernel": name, "mode": mode, "mode_source": source,
+                     "impl": res.impl, "reason": res.reason})
+    return rows

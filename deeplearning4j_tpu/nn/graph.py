@@ -338,7 +338,7 @@ class ComputationGraph:
 
     def _build_jit(self, kind: str, train=False, keep_rnn_state=False,
                    advance=False, collect=False, algo=None, k=None,
-                   scan=True):
+                   scan=True, kernels=None):
         # `k`/`scan` select the superstep program shape (`nn/superstep.py`,
         # see MultiLayerNetwork._build_jit): distinct block lengths register
         # as distinct cached programs so StepProfiler attributes a tail
@@ -907,7 +907,8 @@ class ComputationGraph:
                 else [None if m is None else m[0] for m in sb.labels_masks],
             ))
         step_fn = self._get_jit("train_superstep", k=k,
-                                scan=_superstep.use_scan())
+                                scan=_superstep.use_scan(),
+                                kernels=_superstep.kernel_config())
         (self.params_tree, self.state, self.opt_state, losses,
          self._clock) = step_fn(
             self.params_tree, self.state, self.opt_state,

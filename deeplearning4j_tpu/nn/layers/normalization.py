@@ -11,10 +11,9 @@ compiles to its own fused XLA program (no in-graph branching).
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 
-from deeplearning4j_tpu.nn import activations
+from deeplearning4j_tpu.kernels import norm_act as _norm_kernel
 
 
 def batchnorm_apply(conf, params, state, x, *, rng=None, train=False, mask=None):
@@ -34,12 +33,15 @@ def batchnorm_apply(conf, params, state, x, *, rng=None, train=False, mask=None)
         mean = state["mean"]
         var = state["var"]
         new_state = state
-    xhat = (x - mean) / jnp.sqrt(var + conf.eps)
+    # Normalize + affine + activation through the kernel dispatch seam
+    # (kernels/norm_act.py): the XLA fallback is the literal pre-registry
+    # expression; the Pallas path fuses the chain into one VMEM pass.
     if conf.lock_gamma_beta or not params:
-        out = conf.gamma * xhat + conf.beta
+        gamma, beta = conf.gamma, conf.beta
     else:
-        out = params["gamma"] * xhat + params["beta"]
-    out = activations.resolve(conf.activation)(out)
+        gamma, beta = params["gamma"], params["beta"]
+    out = _norm_kernel.batchnorm_norm_act(x, mean, var, gamma, beta,
+                                          conf.eps, conf.activation)
     return out, new_state, mask
 
 
@@ -51,10 +53,8 @@ def layernorm_apply(conf, params, state, x, *, rng=None, train=False,
     from deeplearning4j_tpu.nn.layers.common import layer_input_dropout
 
     x = layer_input_dropout(conf, x, rng, train)
-    mu = jnp.mean(x, axis=-1, keepdims=True)
-    var = jnp.mean((x - mu) ** 2, axis=-1, keepdims=True)
-    out = (x - mu) * jax.lax.rsqrt(var + conf.eps)
-    out = out * params["gamma"] + params["beta"]
-    from deeplearning4j_tpu.nn import activations
-
-    return activations.resolve(conf.activation)(out), state, mask
+    # Stats + normalize + affine + activation through the kernel dispatch
+    # seam (kernels/norm_act.py; XLA fallback is the pre-registry code).
+    out = _norm_kernel.layernorm_norm_act(x, params["gamma"], params["beta"],
+                                          conf.eps, conf.activation)
+    return out, state, mask

@@ -25,6 +25,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from deeplearning4j_tpu.kernels import lstm_cell as _lstm_kernel
 from deeplearning4j_tpu.nn import activations
 from deeplearning4j_tpu.nn.layers.common import (
     inverted_dropout,
@@ -44,34 +45,25 @@ def _lstm_scan(conf, params, x, mask, h0, c0, peephole: bool, reverse: bool = Fa
     cell_act = activations.resolve(conf.activation)
     if peephole:
         pW = params["pW" + suffix]
-        p_i, p_f, p_o = pW[:n_out], pW[n_out:2 * n_out], pW[2 * n_out:]
+        pw = (pW[:n_out], pW[n_out:2 * n_out], pW[2 * n_out:])
+    else:
+        pw = None
 
     # Precompute input projections for all timesteps in one big MXU matmul.
     xw = x @ W + b  # [b, t, 4*n_out]
 
+    # Dispatch seam (kernels/lstm_cell.py): resolved ONCE per signature
+    # before the scan body exists — the Pallas fused cell on TPU when the
+    # registry picks it, else the bit-identical XLA body.
+    cell = _lstm_kernel.resolve_cell(
+        batch=x.shape[0], n_out=n_out, dtype=x.dtype, peephole=peephole,
+        masked=mask is not None, gate_activation=conf.gate_activation,
+        activation=conf.activation, gate_act=gate_act, cell_act=cell_act)
+
     def step(carry, inp):
         h_prev, c_prev = carry
         xw_t, m_t = inp
-        z = xw_t + h_prev @ RW
-        zi, zf, zo, zg = jnp.split(z, 4, axis=-1)
-        if peephole:
-            zi = zi + c_prev * p_i
-            zf = zf + c_prev * p_f
-        i = gate_act(zi)
-        f = gate_act(zf)
-        g = cell_act(zg)
-        c = f * c_prev + i * g
-        if peephole:
-            zo = zo + c * p_o
-        o = gate_act(zo)
-        h = o * cell_act(c)
-        if m_t is not None:
-            m = m_t[:, None]
-            h = m * h + (1.0 - m) * h_prev
-            c = m * c + (1.0 - m) * c_prev
-            out = m * h
-        else:
-            out = h
+        h, c, out = cell(xw_t, h_prev, c_prev, RW, pw, m_t)
         return (h, c), out
 
     xs = jnp.swapaxes(xw, 0, 1)  # [t, b, 4n]

@@ -327,12 +327,14 @@ class MultiLayerNetwork:
 
     def _build_jit(self, kind: str, train=False, keep_rnn_state=False,
                    advance=False, collect=False, algo=None, k=None,
-                   scan=True):
+                   scan=True, kernels=None):
         # `k`/`scan` select the superstep program shape (`nn/superstep.py`)
         # and are part of the `_get_jit` cache key: each distinct block
         # length registers as its own cached program, so StepProfiler's
         # jit-cache-growth heuristic classifies a tail block's first call as
-        # compile, not steady-state execute.
+        # compile, not steady-state execute. `kernels` is pure program
+        # identity (the kernel-registry selection the trace resolves under,
+        # `nn/superstep.py::kernel_config`) — never read here.
         if kind == "solver_step":
             from jax.flatten_util import ravel_pytree
 
@@ -898,7 +900,8 @@ class MultiLayerNetwork:
                                          None if sb.features_mask is None else sb.features_mask[0],
                                          None if sb.labels_mask is None else sb.labels_mask[0]))
         step_fn = self._get_jit("train_superstep", k=k,
-                                scan=_superstep.use_scan())
+                                scan=_superstep.use_scan(),
+                                kernels=_superstep.kernel_config())
         (self.params_tree, self.state, self.opt_state, losses,
          self._clock) = step_fn(
             self.params_tree, self.state, self.opt_state,

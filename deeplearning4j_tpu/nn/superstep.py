@@ -16,7 +16,14 @@ RNG/clock threading, ONE device dispatch either way:
   float-close, not bit-identical, to the per-batch loop. Hence opt-in.
 
 The choice is a STATIC part of the program (it changes the lowered HLO), so
-the engines pass it into the `_get_jit` cache key alongside `k`.
+the engines pass it into the `_get_jit` cache key alongside `k` — and
+alongside `kernel_config()`, the kernel-registry selection under which the
+superstep body (LSTM cells, norm+act, the fused optimizer update carried
+through `(params, state, opt_state, clock)`) traces its dispatch seams.
+Resolution is hoisted to SIGNATURE level: a restacked block with an
+already-seen `(k, scan, kernels, shapes)` identity is a jit-cache hit, so
+`kernels.registry` never re-runs its `is_available` probes per block
+(`registry.probe_count()` holds the line in tests/test_kernels.py).
 """
 
 from __future__ import annotations
@@ -25,6 +32,17 @@ import os
 
 import jax
 import jax.numpy as jnp
+
+
+def kernel_config():
+    """The kernel-registry selection this superstep program traces under
+    — passed by both engines as a `_get_jit` static so the fused-vs-
+    fallback choice is explicit program identity (also folded in globally
+    by `nn/jit_cache.py`; here it additionally lands in the AOT
+    fingerprint's `static` list and the StepProfiler's program key)."""
+    from deeplearning4j_tpu.kernels import registry
+
+    return registry.config_key()
 
 
 def use_scan() -> bool:

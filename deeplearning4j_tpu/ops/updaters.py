@@ -23,6 +23,7 @@ from typing import Any, Callable, NamedTuple
 import jax
 import jax.numpy as jnp
 
+from deeplearning4j_tpu.kernels import fused_update as _fused
 from deeplearning4j_tpu.nn.conf.enums import Updater
 
 
@@ -57,20 +58,20 @@ def none_updater() -> GradientUpdater:
 
 
 def nesterovs(momentum: float = 0.9) -> GradientUpdater:
-    """Nesterov momentum (reference: ND4J Nesterovs, default momentum 0.9)."""
+    """Nesterov momentum (reference: ND4J Nesterovs, default momentum 0.9).
+
+    The update body lives behind the fused-update dispatch seam
+    (`kernels/fused_update.py`): the XLA fallback there is this updater's
+    pre-registry tree_map code verbatim (ND4J semantics: applied update =
+    -(mu*vPrev) + (1+mu)*v, negated because the caller subtracts deltas);
+    on TPU the registry may fuse all leaves into one elementwise kernel."""
 
     def init(params):
         return {"v": _zeros_like_tree(params)}
 
     def update(state, grads, lr, step):
-        v_prev = state["v"]
-        v = jax.tree_util.tree_map(lambda v0, g: momentum * v0 - lr * g, v_prev, grads)
-        # ND4J semantics: applied update = -(mu*vPrev) + (1+mu)*v, negated here
-        # because the caller subtracts deltas.
-        deltas = jax.tree_util.tree_map(
-            lambda v0, v1: momentum * v0 - (1.0 + momentum) * v1, v_prev, v
-        )
-        return {"v": v}, deltas
+        return _fused.dispatch("nesterovs", state, grads, lr, step,
+                               (momentum,))
 
     return GradientUpdater("nesterovs", init, update)
 
@@ -80,15 +81,10 @@ def adam(beta1: float = 0.9, beta2: float = 0.999, eps: float = 1e-8) -> Gradien
         return {"m": _zeros_like_tree(params), "v": _zeros_like_tree(params)}
 
     def update(state, grads, lr, step):
-        t = step + 1
-        m = jax.tree_util.tree_map(lambda m0, g: beta1 * m0 + (1 - beta1) * g, state["m"], grads)
-        v = jax.tree_util.tree_map(lambda v0, g: beta2 * v0 + (1 - beta2) * g * g, state["v"], grads)
-        bc1 = 1.0 - beta1 ** t.astype(jnp.float32) if hasattr(t, "astype") else 1.0 - beta1 ** t
-        bc2 = 1.0 - beta2 ** t.astype(jnp.float32) if hasattr(t, "astype") else 1.0 - beta2 ** t
-        deltas = jax.tree_util.tree_map(
-            lambda m1, v1: lr * (m1 / bc1) / (jnp.sqrt(v1 / bc2) + eps), m, v
-        )
-        return {"m": m, "v": v}, deltas
+        # Fused-update dispatch seam (kernels/fused_update.py); the XLA
+        # fallback is the pre-registry per-leaf code verbatim.
+        return _fused.dispatch("adam", state, grads, lr, step,
+                               (beta1, beta2, eps))
 
     return GradientUpdater("adam", init, update)
 
@@ -142,9 +138,10 @@ def rmsprop(decay: float = 0.95, eps: float = 1e-8) -> GradientUpdater:
         return {"g2": _zeros_like_tree(params)}
 
     def update(state, grads, lr, step):
-        g2 = jax.tree_util.tree_map(lambda a, g: decay * a + (1 - decay) * g * g, state["g2"], grads)
-        deltas = jax.tree_util.tree_map(lambda a, g: lr * g / jnp.sqrt(a + eps), g2, grads)
-        return {"g2": g2}, deltas
+        # Fused-update dispatch seam (kernels/fused_update.py); the XLA
+        # fallback is the pre-registry per-leaf code verbatim.
+        return _fused.dispatch("rmsprop", state, grads, lr, step,
+                               (decay, eps))
 
     return GradientUpdater("rmsprop", init, update)
 

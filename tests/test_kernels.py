@@ -1,0 +1,572 @@
+"""Kernel registry tests: env-knob override matrix, CPU graceful fallback,
+per-signature memoized resolution (the hoisting counter contract), AOT
+fingerprint invalidation on knob flips, CLI smoke, Pallas-vs-XLA parity for
+every registered kernel, and the acceptance bit-identity contract
+(`DL4J_TPU_KERNELS=xla` trains bit-identically to `auto` on CPU through
+both engines, per-batch and k=4 superstep). PERF.md §19."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu import observability as obs
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import DeviceCacheDataSetIterator
+from deeplearning4j_tpu.kernels import fused_update, lstm_cell, norm_act, registry
+from deeplearning4j_tpu.kernels import flash_attention as kflash
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    BatchNormalization,
+    DenseLayer,
+    DropoutLayer,
+    GravesLSTM,
+    OutputLayer,
+    RnnOutputLayer,
+)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+from conftest import make_classification_data
+
+N_IN, N_OUT = 4, 3
+
+_ENV_VARS = ["DL4J_TPU_KERNELS"] + [
+    "DL4J_TPU_KERNEL_" + k.upper() for k in registry.kernel_names()]
+
+
+@pytest.fixture(autouse=True)
+def _clean_kernel_env(monkeypatch):
+    """Every test starts from the default (auto) config with an empty
+    resolution memo, and leaves no memo entries keyed by its env behind."""
+    for var in _ENV_VARS:
+        monkeypatch.delenv(var, raising=False)
+    registry.clear_cache()
+    yield
+    registry.clear_cache()
+
+
+def _mlp_conf(superstep_k=0, updater="adam"):
+    return (NeuralNetConfiguration.builder()
+            .seed(7).learning_rate(0.05).updater(updater)
+            .weight_init("xavier").superstep_k(superstep_k)
+            .list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(BatchNormalization())
+            .layer(DropoutLayer(dropout=0.5))
+            .layer(OutputLayer(n_out=N_OUT, activation="softmax",
+                               loss_function="mcxent"))
+            .set_input_type(InputType.feed_forward(N_IN)).build())
+
+
+def _graph_conf(superstep_k=0):
+    return (NeuralNetConfiguration.builder()
+            .seed(7).learning_rate(0.05).updater("adam").weight_init("xavier")
+            .superstep_k(superstep_k)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d", DenseLayer(n_out=8, activation="relu"), "in")
+            .add_layer("out", OutputLayer(n_out=N_OUT, activation="softmax",
+                                          loss_function="mcxent"), "d")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(N_IN))
+            .build())
+
+
+def _lstm_conf(updater="adam"):
+    return (NeuralNetConfiguration.builder()
+            .seed(7).learning_rate(0.05).updater(updater).weight_init("xavier")
+            .list()
+            .layer(GravesLSTM(n_out=6, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=N_OUT, activation="softmax",
+                                  loss_function="mcxent"))
+            .set_input_type(InputType.recurrent(N_IN))
+            .build())
+
+
+def _make_batches(seed, n_batches=7, batch=6):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n_batches):
+        X, Y = make_classification_data(rng, n=batch, n_features=N_IN,
+                                        n_classes=N_OUT, dtype="float32")
+        out.append(DataSet(X, Y))
+    return out
+
+
+def _assert_trees_identical(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# --------------------------------------------------------------------------
+# Env-knob matrix
+
+
+class TestModeKnobs:
+    def test_default_is_auto(self):
+        for k in registry.kernel_names():
+            assert registry.mode_for(k) == ("auto", "default")
+
+    def test_global_knob(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_KERNELS", "xla")
+        for k in registry.kernel_names():
+            assert registry.mode_for(k) == ("xla", "DL4J_TPU_KERNELS")
+
+    def test_per_kernel_override_wins(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_KERNELS", "xla")
+        monkeypatch.setenv("DL4J_TPU_KERNEL_LSTM_CELL", "pallas")
+        assert registry.mode_for("lstm_cell") == (
+            "pallas", "DL4J_TPU_KERNEL_LSTM_CELL")
+        assert registry.mode_for("norm_act") == ("xla", "DL4J_TPU_KERNELS")
+
+    @pytest.mark.parametrize("var", ["DL4J_TPU_KERNELS",
+                                     "DL4J_TPU_KERNEL_NORM_ACT"])
+    def test_invalid_value_raises(self, monkeypatch, var):
+        monkeypatch.setenv(var, "cuda")
+        with pytest.raises(ValueError, match="cuda"):
+            registry.mode_for("norm_act")
+
+    def test_config_key_tracks_env(self, monkeypatch):
+        base = registry.config_key()
+        assert base == tuple((k, "auto") for k in registry.kernel_names())
+        monkeypatch.setenv("DL4J_TPU_KERNELS", "xla")
+        flipped = registry.config_key()
+        assert flipped != base
+        assert dict(flipped) == {k: "xla" for k in registry.kernel_names()}
+        fp = registry.config_fingerprint()
+        assert fp == dict(flipped)
+        json.dumps(fp)  # must stay JSON-able for the AOT sidecar
+
+
+# --------------------------------------------------------------------------
+# Resolution: CPU graceful fallback, forced modes, memoization
+
+
+class TestResolution:
+    def test_unknown_kernel_raises(self):
+        with pytest.raises(KeyError, match="unknown kernel"):
+            registry.resolve("conv3d", backend="cpu")
+
+    def test_auto_on_cpu_falls_back_to_xla(self):
+        for name in ("lstm_cell", "fused_update", "norm_act"):
+            res = registry.resolve(name, backend="cpu")
+            assert res.impl == "xla", res
+        # flash_attention's Pallas kernel historically interprets off-TPU
+        # (its pre-registry behavior) — auto keeps that.
+        assert registry.resolve("flash_attention", backend="cpu").impl == "pallas"
+
+    def test_forced_pallas_interprets_off_tpu(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_KERNELS", "pallas")
+        res = registry.resolve(
+            "lstm_cell", backend="cpu", shapes=(6, 6), dtypes=("float32",),
+            meta=(("gate", "sigmoid"), ("act", "tanh"),
+                  ("peephole", True), ("masked", False)))
+        assert res.impl == "pallas"
+        assert "forced" in res.reason
+
+    def test_forced_pallas_structural_refusal_falls_back(self, monkeypatch):
+        # A gate activation the kernel cannot express: even forced mode
+        # must fall back (with the probe's reason surfaced), not crash.
+        monkeypatch.setenv("DL4J_TPU_KERNEL_LSTM_CELL", "pallas")
+        res = registry.resolve(
+            "lstm_cell", backend="cpu", shapes=(6, 6), dtypes=("float32",),
+            meta=(("gate", "hardtanh"), ("act", "tanh"),
+                  ("peephole", False), ("masked", False)))
+        assert res.impl == "xla"
+        assert "unavailable" in res.reason
+        assert "hardtanh" in res.reason
+
+    def test_forced_xla_everywhere(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_KERNELS", "xla")
+        for name in registry.kernel_names():
+            res = registry.resolve(name, backend="cpu")
+            assert res.impl == "xla"
+            assert "forced via DL4J_TPU_KERNELS" in res.reason
+
+    def test_fused_update_cpu_reasons(self):
+        res = registry.resolve(
+            "fused_update", backend="cpu", shapes=((8, 3),),
+            dtypes=("float32",), meta=(("kind", "adam"),
+                                       ("hyper", (0.9, 0.999, 1e-8))))
+        assert res.impl == "xla"
+        # Unfused updaters never get the Pallas path even on TPU.
+        res = registry.resolve(
+            "fused_update", backend="tpu", shapes=((8, 3),),
+            dtypes=("float32",), meta=(("kind", "adagrad"), ("hyper", (1e-6,))))
+        assert res.impl == "xla"
+        ok, reason = fused_update._pallas_available(
+            "tpu", ((8, 3),), ("float32",), meta=(("kind", "adagrad"),))
+        assert not ok and "no fused kernel" in reason
+
+    def test_resolution_memoized_per_signature(self):
+        registry.clear_cache()
+        sig = dict(backend="cpu", shapes=(8, 128), dtypes=("float32",),
+                   meta=(("gate", "sigmoid"), ("act", "tanh"),
+                         ("peephole", False), ("masked", False)))
+        registry.resolve("lstm_cell", **sig)
+        probes = registry.probe_count()
+        for _ in range(5):
+            registry.resolve("lstm_cell", **sig)
+        assert registry.probe_count() == probes  # memo hit: zero new probes
+        registry.resolve("lstm_cell", **dict(sig, shapes=(16, 128)))
+        assert registry.probe_count() > probes  # new signature re-probes
+
+    def test_clear_cache_reprobes(self):
+        registry.resolve("norm_act", backend="cpu")
+        probes = registry.probe_count()
+        registry.clear_cache()
+        registry.resolve("norm_act", backend="cpu")
+        assert registry.probe_count() > probes
+
+    def test_describe_covers_all_kernels(self):
+        rows = registry.describe(backend="cpu")
+        assert [r["kernel"] for r in rows] == sorted(registry.kernel_names())
+        for r in rows:
+            assert r["mode"] == "auto" and r["impl"] and r["reason"]
+
+
+# --------------------------------------------------------------------------
+# Program identity: jit-cache keys and the AOT fingerprint
+
+
+class TestProgramIdentity:
+    def test_fingerprint_doc_invalidates_on_knob_flip(self, monkeypatch):
+        from deeplearning4j_tpu.compilation.store import (
+            build_fingerprint_doc, fingerprint)
+
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        X = jnp.zeros((6, N_IN), jnp.float32)
+        Y = jnp.zeros((6, N_OUT), jnp.float32)
+        doc_auto = build_fingerprint_doc(net, "train_step", {}, (X, Y))
+        assert doc_auto["kernels"] == {k: "auto"
+                                       for k in registry.kernel_names()}
+        monkeypatch.setenv("DL4J_TPU_KERNELS", "xla")
+        doc_xla = build_fingerprint_doc(net, "train_step", {}, (X, Y))
+        assert doc_xla["kernels"]["lstm_cell"] == "xla"
+        assert fingerprint(doc_auto) != fingerprint(doc_xla)
+
+    def test_jit_cache_key_includes_kernel_config(self, monkeypatch):
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        ds = _make_batches(9, n_batches=1)[0]
+        net.fit(ds)
+        n_auto = len(net._jit_cache)
+        net.fit(ds)
+        assert len(net._jit_cache) == n_auto  # same env: cache hit
+        monkeypatch.setenv("DL4J_TPU_KERNELS", "xla")
+        registry.clear_cache()
+        net.fit(ds)
+        assert len(net._jit_cache) > n_auto  # knob flip: distinct program
+        keys = {k[-1] for k in net._jit_cache}
+        assert len(keys) == 2  # one kernel config per env
+
+
+# --------------------------------------------------------------------------
+# Hoisting: repeated same-signature blocks never re-run probes
+
+
+class TestProbeHoisting:
+    def test_superstep_restack_adds_zero_probes(self):
+        net = MultiLayerNetwork(_mlp_conf(superstep_k=4)).init()
+        batches = _make_batches(0, n_batches=8)
+        net.fit(batches)  # traces k=4 blocks: probes run here
+        probes = registry.probe_count()
+        net.fit(batches)  # restacked same-shape blocks: memo hits only
+        net.fit(batches)
+        assert registry.probe_count() == probes
+
+    def test_device_cache_epochs_add_zero_probes(self):
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        it = DeviceCacheDataSetIterator(_make_batches(0, n_batches=4))
+        net.fit(it)
+        probes = registry.probe_count()
+        for _ in range(3):
+            net.fit(it)
+        assert registry.probe_count() == probes
+
+
+# --------------------------------------------------------------------------
+# CLI smoke
+
+
+class TestCLI:
+    _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def _run(self, *argv):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        for var in _ENV_VARS:
+            env.pop(var, None)
+        return subprocess.run(
+            [sys.executable, "-m", "deeplearning4j_tpu.kernels", *argv],
+            cwd=self._REPO, env=env, capture_output=True, text=True,
+            timeout=120)
+
+    def test_table_lists_all_kernels(self):
+        proc = self._run()
+        assert proc.returncode == 0, proc.stderr
+        for name in registry.kernel_names():
+            assert name in proc.stdout
+
+    def test_json_output(self):
+        proc = self._run("--json")
+        assert proc.returncode == 0, proc.stderr
+        rows = json.loads(proc.stdout)
+        assert {r["kernel"] for r in rows} == set(registry.kernel_names())
+        for r in rows:
+            assert set(r) >= {"kernel", "mode", "mode_source", "impl",
+                              "reason"}
+
+
+# --------------------------------------------------------------------------
+# Dispatch metric
+
+
+class TestDispatchMetric:
+    def test_resolve_increments_counter(self):
+        registry.resolve("norm_act", backend="cpu")
+        fam = obs.metrics.to_json()["dl4j_kernel_dispatch_total"]
+        series = {(s["labels"]["kernel"], s["labels"]["impl"]): s["value"]
+                  for s in fam["series"]}
+        before = series[("norm_act", "xla")]
+        registry.resolve("norm_act", backend="cpu")  # memo hit still counts
+        fam = obs.metrics.to_json()["dl4j_kernel_dispatch_total"]
+        series = {(s["labels"]["kernel"], s["labels"]["impl"]): s["value"]
+                  for s in fam["series"]}
+        assert series[("norm_act", "xla")] == before + 1
+
+
+# --------------------------------------------------------------------------
+# Parity: every kernel's Pallas path (interpret on CPU) vs its XLA fallback
+
+# The gate below fails when a kernel is added to the registry without a
+# parity test here (or, for flash_attention, in test_flash_attention.py).
+PARITY_COVERED = {"lstm_cell", "fused_update", "norm_act", "flash_attention"}
+
+
+def test_every_kernel_has_parity_coverage():
+    assert set(registry.kernel_names()) == PARITY_COVERED
+
+
+class TestParity:
+    @pytest.mark.parametrize("peephole,masked", [
+        (False, False), (True, False), (False, True), (True, True)])
+    def test_lstm_cell(self, monkeypatch, peephole, masked):
+        rng = np.random.RandomState(3)
+        b, n = 5, 7
+        xw = jnp.asarray(rng.randn(b, 4 * n), jnp.float32)
+        h0 = jnp.asarray(rng.randn(b, n), jnp.float32)
+        c0 = jnp.asarray(rng.randn(b, n), jnp.float32)
+        RW = jnp.asarray(rng.randn(n, 4 * n) * 0.1, jnp.float32)
+        pw = tuple(jnp.asarray(rng.randn(n) * 0.1, jnp.float32)
+                   for _ in range(3)) if peephole else None
+        m = (jnp.asarray(rng.rand(b) < 0.6, jnp.float32) if masked else None)
+
+        def cell_for(mode):
+            monkeypatch.setenv("DL4J_TPU_KERNEL_LSTM_CELL", mode)
+            registry.clear_cache()
+            return lstm_cell.resolve_cell(
+                batch=b, n_out=n, dtype="float32", peephole=peephole,
+                masked=masked, gate_activation="sigmoid", activation="tanh",
+                gate_act=jax.nn.sigmoid, cell_act=jnp.tanh)
+
+        ref = cell_for("xla")(xw, h0, c0, RW, pw, m)
+        got = cell_for("pallas")(xw, h0, c0, RW, pw, m)
+        for r, g in zip(ref, got):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                       rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("kind,fields,hyper", [
+        ("adam", ("m", "v"), (0.9, 0.999, 1e-8)),
+        ("nesterovs", ("v",), (0.9,)),
+        ("rmsprop", ("g2",), (0.95, 1e-8)),
+    ])
+    def test_fused_update(self, monkeypatch, kind, fields, hyper):
+        rng = np.random.RandomState(4)
+        tree = lambda: {"W": jnp.asarray(rng.randn(9, 5), jnp.float32),
+                        "b": jnp.asarray(rng.randn(5), jnp.float32)}
+        grads = tree()
+        state = {f: tree() for f in fields}
+
+        def run(mode):
+            monkeypatch.setenv("DL4J_TPU_KERNEL_FUSED_UPDATE", mode)
+            registry.clear_cache()
+            return fused_update.dispatch(kind, state, grads,
+                                         jnp.float32(0.05), jnp.int32(2),
+                                         hyper)
+
+        ref_state, ref_deltas = run("xla")
+        got_state, got_deltas = run("pallas")
+        for r, g in zip(jax.tree_util.tree_leaves((ref_state, ref_deltas)),
+                        jax.tree_util.tree_leaves((got_state, got_deltas))):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                       rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.parametrize("op,act", [("batchnorm", "relu"),
+                                        ("layernorm", "tanh"),
+                                        ("batchnorm", "identity")])
+    def test_norm_act(self, monkeypatch, op, act):
+        rng = np.random.RandomState(5)
+        x = jnp.asarray(rng.randn(6, 10), jnp.float32)
+        gamma = jnp.asarray(rng.rand(10) + 0.5, jnp.float32)
+        beta = jnp.asarray(rng.randn(10), jnp.float32)
+        mean = jnp.asarray(rng.randn(10), jnp.float32)
+        var = jnp.asarray(rng.rand(10) + 0.1, jnp.float32)
+
+        def run(mode):
+            monkeypatch.setenv("DL4J_TPU_KERNEL_NORM_ACT", mode)
+            registry.clear_cache()
+            if op == "batchnorm":
+                return norm_act.batchnorm_norm_act(x, mean, var, gamma, beta,
+                                                   1e-5, act)
+            return norm_act.layernorm_norm_act(x, gamma, beta, 1e-5, act)
+
+        np.testing.assert_allclose(np.asarray(run("pallas")),
+                                   np.asarray(run("xla")),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_lstm_cell_grad(self, monkeypatch):
+        # pallas_call has no autodiff rule; the cell must still sit inside
+        # the engines' value_and_grad (kernels/_diff.py pairs the Pallas
+        # forward with the XLA reference's VJP).
+        rng = np.random.RandomState(7)
+        b, n = 4, 6
+        xw = jnp.asarray(rng.randn(b, 4 * n), jnp.float32)
+        h0 = jnp.asarray(rng.randn(b, n), jnp.float32)
+        c0 = jnp.asarray(rng.randn(b, n), jnp.float32)
+        RW = jnp.asarray(rng.randn(n, 4 * n) * 0.1, jnp.float32)
+
+        def loss_with(mode):
+            monkeypatch.setenv("DL4J_TPU_KERNEL_LSTM_CELL", mode)
+            registry.clear_cache()
+            cell = lstm_cell.resolve_cell(
+                batch=b, n_out=n, dtype="float32", peephole=False,
+                masked=False, gate_activation="sigmoid", activation="tanh",
+                gate_act=jax.nn.sigmoid, cell_act=jnp.tanh)
+
+            def loss(rw):
+                h, c, out = cell(xw, h0, c0, rw, None, None)
+                return jnp.sum(out ** 2) + jnp.sum(c)
+
+            return jax.grad(loss)(RW)
+
+        np.testing.assert_allclose(np.asarray(loss_with("pallas")),
+                                   np.asarray(loss_with("xla")),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_norm_act_grad(self, monkeypatch):
+        rng = np.random.RandomState(8)
+        x = jnp.asarray(rng.randn(6, 10), jnp.float32)
+        gamma = jnp.asarray(rng.rand(10) + 0.5, jnp.float32)
+        beta = jnp.asarray(rng.randn(10), jnp.float32)
+
+        def grads_with(mode):
+            monkeypatch.setenv("DL4J_TPU_KERNEL_NORM_ACT", mode)
+            registry.clear_cache()
+            return jax.grad(
+                lambda xv, g: jnp.sum(
+                    norm_act.layernorm_norm_act(xv, g, beta, 1e-5, "tanh")
+                    ** 2),
+                argnums=(0, 1))(x, gamma)
+
+        for p, r in zip(grads_with("pallas"), grads_with("xla")):
+            np.testing.assert_allclose(np.asarray(p), np.asarray(r),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_forced_pallas_net_trains_float_close(self, monkeypatch):
+        # The end-to-end regression for the autodiff seam: a BN net trained
+        # with every kernel forced to Pallas (interpret on CPU) must run —
+        # not crash in value_and_grad — and land float-close to XLA.
+        def train(mode):
+            if mode is None:
+                monkeypatch.delenv("DL4J_TPU_KERNELS", raising=False)
+            else:
+                monkeypatch.setenv("DL4J_TPU_KERNELS", mode)
+            registry.clear_cache()
+            net = MultiLayerNetwork(_mlp_conf()).init()
+            for ds in _make_batches(8, n_batches=4):
+                net.fit(ds)
+            return np.asarray(net.params())
+
+        np.testing.assert_allclose(train("pallas"), train("xla"),
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_flash_attention_xla_mode_matches_pallas(self, monkeypatch):
+        rng = np.random.RandomState(6)
+        q, k, v = (jnp.asarray(rng.randn(2, 16, 2, 8), jnp.float32)
+                   for _ in range(3))
+
+        def run(mode):
+            if mode is None:
+                monkeypatch.delenv("DL4J_TPU_KERNELS", raising=False)
+            else:
+                monkeypatch.setenv("DL4J_TPU_KERNELS", mode)
+            registry.clear_cache()
+            return kflash.flash_attention(q, k, v, causal=True)
+
+        np.testing.assert_allclose(np.asarray(run(None)),  # auto: pallas
+                                   np.asarray(run("xla")),  # dense reference
+                                   rtol=1e-5, atol=1e-5)
+
+
+# --------------------------------------------------------------------------
+# Acceptance: DL4J_TPU_KERNELS=xla is bit-identical to auto on CPU
+
+
+class TestBitIdentity:
+    def _train(self, conf_fn, batches_fn, mode, monkeypatch):
+        if mode is None:
+            monkeypatch.delenv("DL4J_TPU_KERNELS", raising=False)
+        else:
+            monkeypatch.setenv("DL4J_TPU_KERNELS", mode)
+        registry.clear_cache()
+        net = conf_fn()
+        for _ in range(2):
+            for ds in batches_fn():
+                net.fit(ds)
+        return net.params_tree, net.opt_state
+
+    def _pair(self, conf_fn, batches_fn, monkeypatch):
+        ref = self._train(conf_fn, batches_fn, "xla", monkeypatch)
+        got = self._train(conf_fn, batches_fn, None, monkeypatch)
+        _assert_trees_identical(ref, got)
+
+    def test_mln_adam_bn(self, monkeypatch):
+        self._pair(lambda: MultiLayerNetwork(_mlp_conf()).init(),
+                   lambda: _make_batches(1, n_batches=3), monkeypatch)
+
+    def test_graph_engine(self, monkeypatch):
+        self._pair(lambda: ComputationGraph(_graph_conf()).init(),
+                   lambda: _make_batches(2, n_batches=3), monkeypatch)
+
+    def test_lstm_net(self, monkeypatch):
+        def batches():
+            rng = np.random.RandomState(3)
+            b, t = 4, 9
+            X = rng.randn(b, t, N_IN).astype("float32")
+            Y = np.eye(N_OUT)[rng.randint(0, N_OUT, (b, t))].astype("float32")
+            return [DataSet(X, Y)]
+
+        self._pair(lambda: MultiLayerNetwork(_lstm_conf()).init(),
+                   batches, monkeypatch)
+
+    def test_superstep_k4(self, monkeypatch):
+        def train(mode):
+            if mode is None:
+                monkeypatch.delenv("DL4J_TPU_KERNELS", raising=False)
+            else:
+                monkeypatch.setenv("DL4J_TPU_KERNELS", mode)
+            registry.clear_cache()
+            net = MultiLayerNetwork(_mlp_conf(superstep_k=4)).init()
+            net.fit(_make_batches(4, n_batches=7))
+            return net.params_tree, net.opt_state
+
+        _assert_trees_identical(train("xla"), train(None))

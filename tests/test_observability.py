@@ -704,6 +704,19 @@ class TestStepProfilerAcceptance:
         with StepProfiler(net, sync=True) as prof:
             for _ in range(5):
                 net.fit(DataSet(x, y))
+
+        # One adam-updated fit so the kernel dispatch seam (ISSUE 10)
+        # resolves `fused_update` and its counter carries a child for the
+        # scrape assertion below (mlp_net's sgd never enters the seam).
+        adam_conf = (NeuralNetConfiguration.builder()
+                     .seed(7).learning_rate(0.1).updater("adam")
+                     .list()
+                     .layer(DenseLayer(n_out=8, activation="tanh"))
+                     .layer(OutputLayer(n_out=3, activation="softmax",
+                                        loss_function="mcxent"))
+                     .set_input_type(InputType.feed_forward(4))
+                     .build())
+        MultiLayerNetwork(adam_conf).init().fit(DataSet(x, y))
         summary = prof.summary()
         assert summary["steps"] == 5
         assert summary["first_call_steps"] >= 1
@@ -733,6 +746,7 @@ class TestStepProfilerAcceptance:
                 "dl4j_request_latency_seconds_bucket",    # request histogram
                 "dl4j_serving_batch_size_bucket",
                 'dl4j_jit_cache_misses_total{engine="mln"}',
+                "dl4j_kernel_dispatch_total{",    # kernel registry seam
                 "dl4j_train_flops_per_step",
                 "dl4j_program_hbm_bytes",                 # static HBM gauges
                 "dl4j_input_wait_seconds_bucket",         # starvation split

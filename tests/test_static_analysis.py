@@ -478,12 +478,70 @@ def install(reg):
         assert lint(src, ["JX008"]) == []
 
 
+# --------------------------------------------------------------- JX010
+
+class TestJX010PallasOutsideKernels:
+    def test_pallas_import_fires(self):
+        src = """
+from jax.experimental import pallas as pl
+
+def my_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+"""
+        fs = lint(src, ["JX010"])
+        assert rules_of(fs) == {"JX010"}
+        assert "kernels/" in fs[0].message
+
+    def test_dotted_import_and_pallas_call_fire(self):
+        src = """
+import jax.experimental.pallas
+
+def run(fn, x):
+    return jax.experimental.pallas.pallas_call(fn, out_shape=x)(x)
+"""
+        fs = lint(src, ["JX010"])
+        assert len(fs) == 2  # the import and the .pallas_call attribute
+        assert any("import" in f.message for f in fs)
+        assert any("pallas_call" in f.message for f in fs)
+
+    def test_tpu_submodule_import_fires(self):
+        src = """
+from jax.experimental.pallas import tpu as pltpu
+"""
+        fs = lint(src, ["JX010"])
+        assert rules_of(fs) == {"JX010"}
+
+    def test_registry_dispatch_is_clean(self):
+        src = """
+from deeplearning4j_tpu.kernels import registry
+
+def resolve(shapes, dtypes):
+    return registry.resolve("lstm_cell", shapes=shapes, dtypes=dtypes)
+"""
+        assert lint(src, ["JX010"]) == []
+
+    def test_kernels_package_is_allowed(self, tmp_path):
+        src = """
+from jax.experimental import pallas as pl
+
+def build(fn, out):
+    return pl.pallas_call(fn, out_shape=out)
+"""
+        d = tmp_path / "kernels"
+        d.mkdir(parents=True)
+        p = d / "lstm_cell.py"
+        p.write_text(src)
+        from deeplearning4j_tpu.analysis import lint_file
+        assert [f for f in lint_file(str(p)) if f.rule == "JX010"] == []
+
+
 # ------------------------------------------------------------ framework
 
 class TestLinterFramework:
     def test_registry_has_all_rules(self):
         assert set(ALL_RULES) >= {"JX001", "JX002", "JX003", "JX004",
-                                  "JX005", "JX006", "JX007", "JX008"}
+                                  "JX005", "JX006", "JX007", "JX008",
+                                  "JX009", "JX010"}
 
     def test_findings_are_typed_and_sorted(self):
         src = """
