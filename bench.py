@@ -232,6 +232,85 @@ def bench_lenet(steps, warmup):
     )
 
 
+def bench_lenet_pipeline_overlap(steps, warmup):
+    """Staging-tier proof (PERF.md §20): the SAME run times a synchronous
+    arm (DL4J_TPU_STAGING=0 — each fresh batch is produced and put on the
+    consumer thread, inside the step cadence) against the overlapped arm
+    (AsyncDataSetIterator -> DeviceStager: production, cast, and the put
+    ride the worker thread while the jitted step computes). Batches are
+    produced FRESH each step in both arms — a streaming workload, not a
+    replayed pool — so the synchronous arm pays host production plus the
+    wire inline and the overlapped arm hides both behind compute. The
+    input_wait fraction is the engine's own
+    dl4j_input_wait_seconds{source="mln"} delta over the overlapped arm's
+    wall: with full overlap it collapses toward zero (the workload is
+    compute-bound again)."""
+    from deeplearning4j_tpu import observability as obs
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import AsyncDataSetIterator
+    from deeplearning4j_tpu.models import zoo
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    batch = int(os.environ.get("BENCH_BATCH_LENET", "512"))
+
+    def fresh(n, seed):
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            yield DataSet(
+                rng.rand(batch, 28, 28, 1).astype("float32"),
+                np.eye(10, dtype="float32")[rng.randint(0, 10, batch)])
+
+    wait_child = obs.metrics.histogram(
+        "dl4j_input_wait_seconds", label_names=("source",)
+    ).labels(source="mln")
+
+    def wait_seconds():
+        _, _, s, _ = wait_child.histogram_state()
+        return s
+
+    net = MultiLayerNetwork(zoo.lenet_mnist()).init()
+    # Synchronous arm first: it also warms the (shared) compiled program,
+    # so the overlapped arm carries zero trace+compile. Same shapes/dtypes
+    # in both arms -> one program.
+    prior = os.environ.get("DL4J_TPU_STAGING")
+    os.environ["DL4J_TPU_STAGING"] = "0"
+    try:
+        net.fit(fresh(max(warmup, 2), seed=99))
+        _ = net.score_value
+        t0 = time.perf_counter()
+        net.fit(fresh(steps, seed=0))
+        _ = net.score_value
+        sync_dt = time.perf_counter() - t0
+    finally:
+        if prior is None:
+            os.environ.pop("DL4J_TPU_STAGING", None)
+        else:
+            os.environ["DL4J_TPU_STAGING"] = prior
+
+    rtt_ms, mibps = _link_probe()
+
+    w0 = wait_seconds()
+    t0 = time.perf_counter()
+    net.fit(AsyncDataSetIterator(fresh(steps, seed=0), queue_size=4))
+    _ = net.score_value
+    ov_dt = time.perf_counter() - t0
+    wait_frac = max(0.0, wait_seconds() - w0) / ov_dt
+
+    ov_sps = batch * steps / ov_dt
+    sync_sps = batch * steps / sync_dt
+    head = _entry("lenet_pipeline_overlap_samples_per_sec", ov_sps,
+                  "samples/sec", note=_LINK_NOTE)
+    head["tunnel_rtt_ms"] = round(rtt_ms, 2)
+    head["link_mibps"] = round(mibps, 1)
+    head["input_wait_fraction"] = round(wait_frac, 4)
+    head["overlap_speedup"] = round(ov_sps / max(sync_sps, 1e-9), 3)
+    return (
+        head,
+        _entry("lenet_pipeline_sync_samples_per_sec", sync_sps,
+               "samples/sec", note=_LINK_NOTE),
+    )
+
+
 def bench_lenet_step(steps, warmup):
     """Legacy r01 metric: pre-staged device batch, step throughput only."""
     import jax
@@ -1296,7 +1375,8 @@ def main():
         "BENCH_CONFIGS",
         "resnet50,resnet50_bf16,lenet,char_rnn,char_rnn_fused_lstm,"
         "lenet_step,lenet_superstep,fused_update_superstep,"
-        "lenet_cold_warm,word2vec,vgg16,flash_attn,flash_tri,transformer,"
+        "lenet_cold_warm,lenet_pipeline_overlap,word2vec,vgg16,"
+        "flash_attn,flash_tri,transformer,"
         "serving_slo,lm_int8_serving,obs_overhead,elastic_recovery"
     ).split(",")
 
@@ -1333,6 +1413,11 @@ def main():
     if "lenet_cold_warm" in configs:
         e = bench_lenet_cold_vs_warm(steps, warmup)
         extra[e["metric"]] = e
+    if "lenet_pipeline_overlap" in configs:
+        # Same >=200-step floor as the other lenet streaming configs: both
+        # compared arms must dwarf the tail sync RTT (PERF.md §4).
+        for e in bench_lenet_pipeline_overlap(max(200, steps), warmup):
+            extra[e["metric"]] = e
     if "word2vec" in configs:
         e = bench_word2vec(steps, warmup)
         extra[e["metric"]] = e

@@ -727,3 +727,78 @@ class PallasOutsideKernelsRule(Rule):
                     "`.pallas_call` outside kernels/: raw kernel "
                     "invocations bypass the registry's availability "
                     "probe, mode knobs, and dispatch metric")
+
+
+@register_rule
+class SyncStagingInFitLoopRule(Rule):
+    """JX011: synchronous host->device staging inside a fit/dispatch loop.
+
+    A `stage_to_device(...)` or `jax.device_put(...)` issued from an
+    engine fit loop or a ParallelWrapper dispatch path serializes the
+    transfer with compute: the device idles while the batch crosses the
+    link, which is exactly the stall `datasets/staging.py`'s DeviceStager
+    exists to hide (PERF.md §20). Hot-path code consumes already-staged
+    batches; the puts belong in staging.py (or a helper the stager calls
+    off-thread). Scalar puts (`jax.device_put(np.float32(...))` — the
+    engines' device-clock/effective-batch constants) are exempt: they
+    move bytes, not batches.
+    """
+
+    id = "JX011"
+    description = ("synchronous stage_to_device/device_put in a fit/"
+                   "dispatch hot path (staging belongs in "
+                   "datasets/staging.py)")
+
+    _SCALAR_CTORS = {"float32", "float64", "int32", "int64"}
+
+    def _hot(self, name: str) -> bool:
+        return (name in ("fit", "flush") or name.startswith("_fit")
+                or "dispatch" in name)
+
+    def _scalar_put(self, call: ast.Call) -> bool:
+        if not call.args:
+            return False
+        arg = call.args[0]
+        if isinstance(arg, ast.Constant):
+            return True
+        if isinstance(arg, ast.Call):
+            fn = arg.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else getattr(
+                fn, "id", None)
+            return name in self._SCALAR_CTORS
+        return False
+
+    def check(self, ctx):
+        rel = ctx.rel.replace("\\", "/")
+        if ("datasets/staging.py" in rel or "/analysis/" in rel
+                or rel.startswith("analysis/")):
+            return
+        if not any(seg in rel for seg in ("nn/", "parallel/", "datasets/")):
+            return
+        for qual, info in ctx.functions.items():
+            if not self._hot(info.name):
+                continue
+            for node in walk_body(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                if isinstance(fn, ast.Attribute):
+                    name = fn.attr
+                elif isinstance(fn, ast.Name):
+                    name = fn.id
+                else:
+                    continue
+                if name == "stage_to_device":
+                    yield self.finding(
+                        ctx, node,
+                        f"synchronous stage_to_device in `{info.name}`: "
+                        "the fit loop blocks on the transfer; feed it "
+                        "staged batches via datasets/staging.py "
+                        "(DeviceStager / maybe_stage)")
+                elif name == "device_put" and not self._scalar_put(node):
+                    yield self.finding(
+                        ctx, node,
+                        f"jax.device_put in hot path `{info.name}`: batch "
+                        "transfers in a fit/dispatch loop serialize the "
+                        "link with compute — stage off-thread through "
+                        "datasets/staging.py")

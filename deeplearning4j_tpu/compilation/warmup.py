@@ -313,12 +313,19 @@ def _warmup_items(net, items, kinds) -> Dict[str, Any]:
         counts["programs"] += 1
         counts[status] = counts.get(status, 0) + 1
 
+    from deeplearning4j_tpu.datasets.staging import transfer_cast
+
+    tdt = getattr(getattr(net, "dtype_policy", None), "transfer_dtype", None)
     for item in items:
         if isinstance(item, (Superbatch, MultiSuperbatch)):
             warm("train_superstep",
                  {"k": int(item.k), "scan": _superstep.use_scan()},
                  _superstep_args(net, item, is_graph))
             continue
+        # Live batches reach dispatch through the staging tier, which
+        # ships them in the policy's transfer dtype — warm the program
+        # for THAT signature or the warmup compiles the wrong one.
+        item = transfer_cast(item, tdt)
         has_labels = (item.labels is not None)
         item_kinds = list(kinds) if kinds is not None else [
             kd for kd in DEFAULT_KINDS if has_labels or kd == "output"]

@@ -15,14 +15,26 @@ from __future__ import annotations
 
 import logging
 import os
-import queue
-import threading
 import time
 from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence
 
 import numpy as np
 
 from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.datasets import staging as _staging
+from deeplearning4j_tpu.datasets.staging import (  # noqa: F401  (re-exports:
+    # the transfer layer moved to datasets/staging.py in PR 11; engines,
+    # wrapper, and tests historically import these from here)
+    _TUPLE_PUT_MAX_BYTES,
+    _drop_staged,
+    _maybe_stage,
+    _np_transfer_dtype,
+    _stage_arrays,
+    DeviceStager,
+    stage_item,
+    stage_to_device,
+    transfer_cast,
+)
 from deeplearning4j_tpu import observability as _obs
 
 _log = logging.getLogger(__name__)
@@ -140,101 +152,19 @@ class ListDataSetIterator(DataSetIterator):
         return sum(b.num_examples() for b in self._batches)
 
 
-# Below this many bytes, one device_put of the whole batch tuple wins
-# (saves per-message round trips: 1.0ms vs 5.2ms for a LeNet batch on a
-# tunneled TPU). Above it, the batched-transfer RPC degrades badly
-# (178ms vs 23ms for a ResNet batch) and per-array puts win.
-_TUPLE_PUT_MAX_BYTES = 4 << 20
-
-
-def _stage_arrays(parts: Sequence[np.ndarray]) -> List:
-    """device_put a set of host arrays, choosing the transfer shape
-    empirically fastest for the total size (see _TUPLE_PUT_MAX_BYTES)."""
-    import jax
-
-    if sum(p.nbytes for p in parts) <= _TUPLE_PUT_MAX_BYTES:
-        return list(jax.device_put(tuple(parts)))
-    return [jax.device_put(p) for p in parts]
-
-
-def _np_transfer_dtype(transfer_dtype):
-    """Resolve a DtypePolicy `transfer_dtype` string to a numpy dtype
-    (bf16 via ml_dtypes). None passes through (no cast)."""
-    if transfer_dtype is None:
-        return None
-    s = str(transfer_dtype)
-    if s in ("bfloat16", "bf16"):
-        import ml_dtypes
-
-        return np.dtype(ml_dtypes.bfloat16)
-    if s in ("float16", "f16", "fp16"):
-        return np.dtype(np.float16)
-    return np.dtype(s)
-
-
-def transfer_cast(item, transfer_dtype):
-    """Cast a batch's floating features/labels HOST-SIDE to the policy's
-    `transfer_dtype` before staging — the generalized BENCH_r05 streaming
-    cast: bytes over the host->device link halve (f32 -> bf16) and the
-    `dl4j_host_to_device_bytes_total` counters record the reduced size.
-    Masks and integer parts (embedding ids, image bytes) are untouched;
-    already-staged device arrays pass through (their transfer is sunk)."""
-    dt = _np_transfer_dtype(transfer_dtype)
-    if dt is None:
-        return item
-
-    def cast(a):
-        if (isinstance(a, np.ndarray)
-                and np.issubdtype(a.dtype, np.floating) and a.dtype != dt):
-            return a.astype(dt)
-        return a
-
-    def host(a):
-        return a if hasattr(a, "dtype") else np.asarray(a)
-
-    if isinstance(item, MultiDataSet):
-        return MultiDataSet(
-            features=[cast(host(f)) for f in item.features],
-            labels=[cast(host(l)) for l in item.labels],
-            features_masks=item.features_masks,
-            labels_masks=item.labels_masks,
-        )
-    if isinstance(item, DataSet):
-        return DataSet(
-            cast(host(item.features)),
-            None if item.labels is None else cast(host(item.labels)),
-            item.features_mask,
-            item.labels_mask,
-        )
-    return item
-
-
-def stage_to_device(ds: DataSet, transfer_dtype=None) -> DataSet:
-    """Transfer one DataSet's arrays host->device (see _stage_arrays),
-    optionally casting floating features/labels to `transfer_dtype` first
-    so the link carries the reduced representation."""
-    if transfer_dtype is not None:
-        ds = transfer_cast(ds, transfer_dtype)
-    parts = [np.asarray(ds.features)]
-    idx = {"features": 0}
-    for name in ("labels", "features_mask", "labels_mask"):
-        a = getattr(ds, name)
-        if a is not None:
-            idx[name] = len(parts)
-            parts.append(np.asarray(a))
-    staged = _stage_arrays(parts)
-    return DataSet(
-        staged[0],
-        staged[idx["labels"]] if "labels" in idx else None,
-        staged[idx["features_mask"]] if "features_mask" in idx else None,
-        staged[idx["labels_mask"]] if "labels_mask" in idx else None,
-    )
-
-
 class AsyncDataSetIterator(DataSetIterator):
     """Background-thread prefetch to device (reference:
     `AsyncDataSetIterator.java` — the host-side I/O boundary of the fit()
-    call stack, SURVEY.md §3.1)."""
+    call stack, SURVEY.md §3.1).
+
+    `device_prefetch=True` (default) runs the overlapped `DeviceStager`
+    path: batches cross the host->device link on the worker thread while
+    the consumer computes, with HBM backpressure from the staging byte
+    budget. `device_prefetch=False` prefetches host-side only (the cast
+    still applies; the consumer pays the transfer). Consumer-side queue
+    waits are observed as `dl4j_input_wait_seconds{source="async"}`, so
+    a prefetch queue running dry is visible, and worker stalls on the
+    base iterator land in `dl4j_staging_wait_seconds`."""
 
     def __init__(self, base: Iterable, queue_size: int = 4, device_prefetch: bool = True,
                  transfer_dtype=None):
@@ -242,76 +172,37 @@ class AsyncDataSetIterator(DataSetIterator):
         self.queue_size = max(1, int(queue_size))
         self.device_prefetch = device_prefetch
         self.transfer_dtype = transfer_dtype
+        self._active: Optional[DeviceStager] = None
 
-    def _put(self, ds: DataSet) -> DataSet:
-        if not self.device_prefetch:
-            return transfer_cast(ds, self.transfer_dtype)
-        return stage_to_device(ds, transfer_dtype=self.transfer_dtype)
+    @property
+    def stages_to_device(self) -> bool:
+        return bool(self.device_prefetch)
 
     def __iter__(self):
-        q: "queue.Queue" = queue.Queue(maxsize=self.queue_size)
-        _END = object()
-        stop = threading.Event()
-        errors: List[BaseException] = []
-
-        def offer(item) -> bool:
-            # Bounded put that gives up when the consumer abandoned iteration,
-            # so the worker never blocks forever holding device buffers.
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.1)
-                    return True
-                except queue.Full:
-                    continue
-            return False
-
-        def worker():
-            try:
-                for ds in self.base:
-                    if not offer(self._put(ds)):
-                        return
-            except BaseException as e:  # surfaced on the consumer side
-                errors.append(e)
-            finally:
-                offer(_END)
-
-        t = threading.Thread(target=worker, daemon=True)
-        t.start()
-        try:
-            while True:
-                item = q.get()
-                if item is _END:
-                    break
-                yield item
-        finally:
-            # Consumer done or bailed early (break/exception/GeneratorExit):
-            # release the worker and drop any prefetched device buffers.
-            stop.set()
-            try:
-                while True:
-                    q.get_nowait()
-            except queue.Empty:
-                pass
-            t.join(timeout=5)
-        if errors:
-            raise errors[0]
+        prior = self._active
+        if prior is not None:
+            prior.close()  # one live worker per iterator; re-iter restarts
+        stager = DeviceStager(
+            self.base,
+            depth=self.queue_size,
+            transfer_dtype=self.transfer_dtype,
+            device_stage=self.device_prefetch,
+            engine="async" if self.device_prefetch else None,
+            source="async",
+        )
+        self._active = stager
+        return stager
 
     def reset(self):
+        # Stop any live worker FIRST (it may still be draining the base;
+        # resetting underneath it would interleave two epochs) and drop
+        # its staged device buffers, then reset the base.
+        stager = self._active
+        if stager is not None:
+            self._active = None
+            stager.close()
         if hasattr(self.base, "reset"):
             self.base.reset()
-
-
-def _drop_staged(staged: Sequence[DataSet]) -> None:
-    """Eagerly free the device buffers of partially staged batches."""
-    for ds in staged:
-        for a in (ds.features, ds.labels, ds.features_mask, ds.labels_mask):
-            delete = getattr(a, "delete", None)
-            if delete is None:
-                continue
-            try:
-                delete()
-            except Exception:
-                pass  # already deleted / not a device array
 
 
 class DeviceCacheDataSetIterator(DataSetIterator):
@@ -326,6 +217,8 @@ class DeviceCacheDataSetIterator(DataSetIterator):
     Use for datasets that fit in device memory (MNIST/CIFAR scale); for
     streaming-scale data use AsyncDataSetIterator and accept the link cost.
     """
+
+    stages_to_device = True  # replays device-resident batches
 
     def __init__(self, base: Iterable, max_bytes: Optional[int] = None,
                  transfer_dtype=None):
@@ -642,7 +535,7 @@ class SuperbatchIterator(DataSetIterator):
                  max_bytes: Optional[int] = None, stage: bool = True,
                  cache: Optional[bool] = None,
                  transform: Optional[Callable] = None,
-                 transfer_dtype=None):
+                 transfer_dtype=None, net=None):
         self.base = base
         self.k = max(1, int(k))
         if max_bytes is None:
@@ -654,10 +547,17 @@ class SuperbatchIterator(DataSetIterator):
                       if cache is None else bool(cache))
         self.transform = transform
         self.transfer_dtype = transfer_dtype
+        self.net = net  # staging byte-budget context (measured_model_bytes)
         self._blocks: Optional[List] = None
         self._built_from: Any = None
 
-    def _iter_blocks(self) -> Iterator:
+    @property
+    def stages_to_device(self) -> bool:
+        return bool(self.stage)
+
+    def _iter_blocks(self, stage: Optional[bool] = None) -> Iterator:
+        if stage is None:
+            stage = self.stage
         buf: List = []
         sig = None
         limit = self.k
@@ -665,7 +565,7 @@ class SuperbatchIterator(DataSetIterator):
         def flush():
             if len(buf) == 1:
                 return buf[0]
-            return stack_superbatch(buf, stage=self.stage)
+            return stack_superbatch(buf, stage=stage)
 
         base_it = iter(self.base)
         while True:
@@ -706,6 +606,13 @@ class SuperbatchIterator(DataSetIterator):
 
     def __iter__(self):
         if not self.cache:
+            if self.stage and _staging.staging_enabled():
+                # Stack blocks host-side on the stager thread and device-put
+                # them there: the NEXT [K, B, ...] block crosses the link
+                # while the current K-step scan runs. The cast already
+                # happened in _iter_blocks, so the stager only puts.
+                return DeviceStager(self._iter_blocks(stage=False),
+                                    net=self.net, engine="superstep")
             return self._iter_blocks()
         base_cache = getattr(self.base, "_cache", None)
         if self._blocks is None or self._built_from is not base_cache:

@@ -42,6 +42,7 @@ from deeplearning4j_tpu.ops import updaters as updaters_mod
 from deeplearning4j_tpu.nn import jit_cache as jit_cache_mod
 from deeplearning4j_tpu.nn import superstep as _superstep
 from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.datasets import staging as _staging
 from deeplearning4j_tpu.datasets.iterators import (
     MultiSuperbatch,
     Superbatch,
@@ -733,20 +734,32 @@ class ComputationGraph:
         with _obs.tracer.span("graph.fit", cat="train", epoch=self.epoch):
             k = self._superstep_k()
             src = self._superstep_wrap(iterator, k) if k > 1 else iterator
+            # Overlap host->device transfers with compute: multi-batch
+            # epochs stream through a background DeviceStager (single
+            # batches and already-staging sources pass through).
+            src = _staging.maybe_stage(
+                src, net=self, engine="graph",
+                transfer_dtype=getattr(self.dtype_policy,
+                                       "transfer_dtype", None))
             src_it = iter(src)
-            while True:
-                # iterator-next is timed separately: with async/staged
-                # input tiers this wait is pure device starvation.
-                t_wait = time.perf_counter()
-                try:
-                    item = next(src_it)
-                except StopIteration:
-                    break
-                self._last_input_wait = time.perf_counter() - t_wait
-                _M_INPUT_WAIT.observe(self._last_input_wait)
-                self._fit_dispatch(
-                    item if isinstance(item, MultiSuperbatch)
-                    else _as_mds(item))
+            try:
+                while True:
+                    # iterator-next is timed separately: with async/staged
+                    # input tiers this wait is pure device starvation.
+                    t_wait = time.perf_counter()
+                    try:
+                        item = next(src_it)
+                    except StopIteration:
+                        break
+                    self._last_input_wait = time.perf_counter() - t_wait
+                    _M_INPUT_WAIT.observe(self._last_input_wait)
+                    self._fit_dispatch(
+                        item if isinstance(item, MultiSuperbatch)
+                        else _as_mds(item))
+            finally:
+                # An abandoned epoch must not leave staged HBM buffers.
+                _staging.close_stager(src_it)
+                _staging.close_stager(src)
         self.epoch += 1
         _M_EPOCHS.inc()
         for listener in self.listeners:
@@ -875,9 +888,10 @@ class ComputationGraph:
         if (isinstance(wrapper, SuperbatchIterator)
                 and wrapper.base is iterator and wrapper.k == k
                 and getattr(wrapper, "transfer_dtype", None) == tdt):
+            wrapper.net = self  # staging budget follows the current net
             return wrapper
         wrapper = SuperbatchIterator(iterator, k, transform=_as_mds,
-                                     transfer_dtype=tdt)
+                                     transfer_dtype=tdt, net=self)
         try:
             iterator._superbatch_wrapper = wrapper
         except (AttributeError, TypeError):

@@ -171,6 +171,7 @@ class StepProfiler:
         self._compile_s0 = self._compile_seconds()
         self._cache_counts0 = self._cache_counts()
         self._input_wait0 = self._input_wait_totals()
+        self._staging0 = self._staging_totals()
         self._jit_known = len(self.net._jit_cache)
         self._orig_dispatch = self.net._fit_dispatch
         self._orig_output = self.net.output
@@ -279,6 +280,36 @@ class StepProfiler:
         s, c = self._input_wait_totals()
         return (max(0.0, s - s0), max(0, c - c0))
 
+    def _staging_totals(self) -> Dict[str, float]:
+        """Current totals of the datasets/staging transfer counters:
+        bytes shipped by background stagers, and device_put seconds split
+        by overlapped (stager-thread) vs synchronous (caller-thread)."""
+        out = {"overlapped_bytes": 0.0, "overlapped_put_seconds": 0.0,
+               "synchronous_put_seconds": 0.0, "staging_wait_seconds": 0.0}
+        fam = self.registry.get_family("dl4j_staging_bytes_total")
+        if fam is not None:
+            out["overlapped_bytes"] = sum(c.get() for c in fam.children())
+        fam = self.registry.get_family("dl4j_staging_put_seconds_total")
+        if fam is not None:
+            for child in fam.children():
+                mode = child.labels.get("mode", "synchronous")
+                out[f"{mode}_put_seconds"] = (
+                    out.get(f"{mode}_put_seconds", 0.0) + child.get())
+        fam = self.registry.get_family("dl4j_staging_wait_seconds")
+        if fam is not None:
+            for child in fam.children():
+                _, _, s, _ = child.histogram_state()
+                out["staging_wait_seconds"] += s
+        return out
+
+    def staging_deltas(self) -> Dict[str, float]:
+        """Overlapped-transfer activity inside the profiled window (see
+        `_staging_totals` for the keys). All zeros when no DeviceStager
+        ran — the synchronous path."""
+        base = getattr(self, "_staging0", {})
+        return {key: max(0.0, val - base.get(key, 0.0))
+                for key, val in self._staging_totals().items()}
+
     def execute_seconds_median(self) -> Optional[float]:
         if not self.step_times:
             return None
@@ -307,13 +338,26 @@ class StepProfiler:
 
     def summary(self) -> Dict[str, Any]:
         med = self.execute_seconds_median()
+        staging = self.staging_deltas()
         out: Dict[str, Any] = {
             "steps": len(self.step_times) + len(self.first_step_times),
             "first_call_steps": len(self.first_step_times),
             "compile_seconds": self.compile_seconds() or self._m_compile.get(),
             "execute_seconds_median": med,
-            "host_to_device_bytes": self.h2d_bytes,
+            # Dispatch-visible host bytes plus what background stagers
+            # shipped (staged batches reach dispatch device-resident, so
+            # the dispatch-side count alone would read ~0 under overlap).
+            "host_to_device_bytes": (self.h2d_bytes
+                                     + int(staging["overlapped_bytes"])),
         }
+        if any(staging.values()):
+            out["transfer"] = {
+                "overlapped_bytes": int(staging["overlapped_bytes"]),
+                "synchronous_bytes": self.h2d_bytes,
+                "overlapped_put_seconds": staging["overlapped_put_seconds"],
+                "synchronous_put_seconds": staging["synchronous_put_seconds"],
+                "staging_wait_seconds": staging["staging_wait_seconds"],
+            }
         cache = self.compile_cache_deltas()
         if cache:
             out["compile_cache"] = cache

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.datasets import staging as _staging
 from deeplearning4j_tpu.datasets.iterators import (
     MultiSuperbatch,
     Superbatch,
@@ -64,6 +65,7 @@ class ParallelWrapper:
                  seq_axis: Optional[str] = None,
                  expert_axis: Optional[str] = None):
         self.net = net
+        self.prefetch_buffer = max(1, int(prefetch_buffer or 2))
         if mesh is None:
             devices = jax.devices()[:workers] if workers else jax.devices()
             mesh = mesh_mod.create_mesh(devices=devices)
@@ -218,6 +220,47 @@ class ParallelWrapper:
             k=k,
         )
 
+    def _grouped(self, iterator, k: int, is_graph: bool):
+        """Yield lists of padded, transfer-cast, same-signature host
+        batches: singletons when the superstep knob is off, else up to K
+        per group (a signature change flushes early — heterogeneous
+        shapes form per-signature blocks). Runs on the stager thread when
+        staging is enabled, so pad+cast host work overlaps compute."""
+        pending: list = []
+        sig = None
+        for ds in iterator:
+            t0 = time.perf_counter()
+            padded = self._prepare(ds, is_graph)
+            _M_SHARD_SECONDS.inc(time.perf_counter() - t0)
+            if k < 2:
+                yield [padded]
+                continue
+            s = batch_signature(padded)
+            if pending and s != sig:
+                yield pending
+                pending = []
+            sig = s
+            pending.append(padded)
+            if len(pending) >= k:
+                yield pending
+                pending = []
+        if pending:
+            yield pending
+
+    def _stage_group(self, group, is_graph: bool):
+        """Shard one padded group over the mesh: a singleton becomes a
+        batch-sharded DataSet/MultiDataSet, K batches a `[K, B, ...]`
+        superbatch sharded on the batch axis. The DeviceStager's
+        `stage_fn` — per-shard puts issue on the stager thread, ahead of
+        dispatch."""
+        t0 = time.perf_counter()
+        if len(group) == 1:
+            sharded = self._shard_batch(group[0], is_graph)
+        else:
+            sharded = self._stack_shard(group, is_graph)
+        _M_SHARD_SECONDS.inc(time.perf_counter() - t0)
+        return sharded
+
     def fit(self, iterator):
         """One pass over the iterator, each batch sharded across the mesh.
 
@@ -231,65 +274,53 @@ class ParallelWrapper:
         superbatches sharded on the BATCH axis (dim 1), so sharded training
         amortizes dispatch the same way local training does (PERF.md §13);
         the engine gate (`_superstep_k`) also covers the stats-listener /
-        tBPTT / solver fallbacks here."""
+        tBPTT / solver fallbacks here.
+
+        Multi-batch epochs pad/cast/shard on a background `DeviceStager`
+        (`prefetch_buffer` deep — the reference knob, now real), so the
+        next sharded batch crosses the link while the current dispatch
+        runs; single-batch fits (the elastic per-step path) shard
+        synchronously, as does `DL4J_TPU_STAGING=0`."""
         net = self.net
         is_graph = type(net).__name__ == "ComputationGraph"
         maybe_reset(iterator)
+        single = isinstance(iterator, (DataSet, MultiDataSet)) or (
+            isinstance(iterator, (list, tuple)) and len(iterator) <= 1)
         if isinstance(iterator, (DataSet, MultiDataSet)):
             iterator = [iterator]
         k = net._superstep_k() if hasattr(net, "_superstep_k") else 0
-        pending: list = []
-        sig = None
+        groups = self._grouped(iterator, k, is_graph)
 
-        def flush():
-            nonlocal wait_accum
-            if not pending:
-                return
-            t0 = time.perf_counter()
-            if len(pending) == 1:
-                sharded = self._shard_batch(pending[0], is_graph)
-            else:
-                sharded = self._stack_shard(pending, is_graph)
-            _M_SHARD_SECONDS.inc(time.perf_counter() - t0)
-            _M_BATCHES.inc(len(pending))
-            pending.clear()
-            with _obs.tracer.span("parallel.batch", cat="parallel",
-                                  devices=self.n_devices,
-                                  data_axis=self.data_axis,
-                                  k=int(getattr(sharded, "k", 1))):
-                with parallel_context(getattr(self, "context", None)):
-                    net._fit_dispatch(sharded)
-            wait_accum = 0.0
+        def stage(group):
+            return self._stage_group(group, is_graph)
 
-        src_it = iter(iterator)
-        wait_accum = 0.0
-        while True:
-            t_wait = time.perf_counter()
-            try:
-                ds = next(src_it)
-            except StopIteration:
-                break
-            wait = time.perf_counter() - t_wait
-            _M_INPUT_WAIT.observe(wait)
-            # K batches feed one stacked dispatch: the flight record's
-            # input_wait is the summed wait behind that dispatch.
-            wait_accum += wait
-            net._last_input_wait = wait_accum
-            t0 = time.perf_counter()
-            padded = self._prepare(ds, is_graph)
-            _M_SHARD_SECONDS.inc(time.perf_counter() - t0)
-            if k < 2:
-                pending.append(padded)
-                flush()
-                continue
-            s = batch_signature(padded)
-            if pending and s != sig:
-                flush()  # heterogeneous shapes: per-signature blocks
-            sig = s
-            pending.append(padded)
-            if len(pending) >= k:
-                flush()
-        flush()
+        if single or not _staging.staging_enabled():
+            src = map(stage, groups)
+        else:
+            src = _staging.DeviceStager(
+                groups, stage_fn=stage, net=net, engine="parallel",
+                depth=self.prefetch_buffer)
+        try:
+            while True:
+                t_wait = time.perf_counter()
+                try:
+                    sharded = next(src)
+                except StopIteration:
+                    break
+                wait = time.perf_counter() - t_wait
+                _M_INPUT_WAIT.observe(wait)
+                # K batches feed one stacked dispatch: the flight record's
+                # input_wait is the wait behind that dispatch.
+                net._last_input_wait = wait
+                _M_BATCHES.inc(int(getattr(sharded, "k", 1)))
+                with _obs.tracer.span("parallel.batch", cat="parallel",
+                                      devices=self.n_devices,
+                                      data_axis=self.data_axis,
+                                      k=int(getattr(sharded, "k", 1))):
+                    with parallel_context(getattr(self, "context", None)):
+                        net._fit_dispatch(sharded)
+        finally:
+            _staging.close_stager(src)
         return net
 
     def evaluate(self, iterator, top_n: int = 1):

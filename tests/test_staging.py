@@ -1,0 +1,317 @@
+"""DeviceStager (datasets/staging.py) tests: the overlapped input
+pipeline must be behaviorally invisible — staged training bit-identical
+to the synchronous path through MLN, graph, and superstep — while the
+in-flight window respects the byte budget (backpressure) and failure
+paths leak zero in-flight HBM (gauges return to baseline). Plus the
+AsyncDataSetIterator satellites: consumer-side input-wait observation
+and reset() stopping a live worker before the base resets."""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from deeplearning4j_tpu import NeuralNetConfiguration
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets import staging
+from deeplearning4j_tpu.datasets.staging import (
+    _M_DEPTH,
+    _M_INFLIGHT,
+    DeviceStager,
+    host_item_nbytes,
+    maybe_stage,
+    stage_item,
+    staging_budget_bytes,
+    staging_enabled,
+)
+from deeplearning4j_tpu.datasets.iterators import (
+    AsyncDataSetIterator,
+    ListDataSetIterator,
+    SuperbatchIterator,
+)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    BatchNormalization,
+    DenseLayer,
+    DropoutLayer,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu import observability as _obs
+
+from conftest import make_classification_data
+
+N_IN, N_OUT = 4, 3
+
+
+def mlp_conf(superstep_k=0):
+    return (NeuralNetConfiguration.builder()
+            .seed(7).learning_rate(0.05).updater("adam").weight_init("xavier")
+            .superstep_k(superstep_k)
+            .list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(BatchNormalization())
+            .layer(DropoutLayer(dropout=0.5))
+            .layer(OutputLayer(n_out=N_OUT, activation="softmax",
+                               loss_function="mcxent"))
+            .set_input_type(InputType.feed_forward(N_IN)).build())
+
+
+def graph_conf(superstep_k=0):
+    return (NeuralNetConfiguration.builder()
+            .seed(7).learning_rate(0.05).updater("adam").weight_init("xavier")
+            .superstep_k(superstep_k)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d", DenseLayer(n_out=8, activation="relu"), "in")
+            .add_layer("out", OutputLayer(n_out=N_OUT, activation="softmax",
+                                          loss_function="mcxent"), "d")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(N_IN))
+            .build())
+
+
+def make_batches(rng, n_batches=6, batch=6):
+    out = []
+    for _ in range(n_batches):
+        X, Y = make_classification_data(rng, n=batch, n_features=N_IN,
+                                        n_classes=N_OUT, dtype="float32")
+        out.append(DataSet(X, Y))
+    return out
+
+
+def assert_trees_identical(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def gauges():
+    return (_M_INFLIGHT.get(), _M_DEPTH.get())
+
+
+# --------------------------------------------------------------- streaming
+
+
+class TestDeviceStager:
+    def test_same_data_same_order(self, rng):
+        batches = make_batches(rng)
+        staged = list(DeviceStager(batches))
+        assert len(staged) == len(batches)
+        for got, want in zip(staged, batches):
+            assert not isinstance(got.features, np.ndarray)  # device-resident
+            np.testing.assert_array_equal(np.asarray(got.features),
+                                          want.features)
+            np.testing.assert_array_equal(np.asarray(got.labels), want.labels)
+
+    def test_host_only_mode_passes_items_through(self, rng):
+        batches = make_batches(rng)
+        staged = list(DeviceStager(batches, device_stage=False))
+        assert [s is b for s, b in zip(staged, batches)] == [True] * len(batches)
+
+    def test_gauges_return_to_baseline_after_epoch(self, rng):
+        base = gauges()
+        list(DeviceStager(make_batches(rng)))
+        assert gauges() == pytest.approx(base)
+
+    def test_maybe_stage_passthroughs(self, rng, monkeypatch):
+        batches = make_batches(rng)
+        # single-batch list: the fit(ds)/elastic path stays synchronous
+        single = [batches[0]]
+        assert maybe_stage(single) is single
+        # already-staging sources are not double-wrapped
+        async_it = AsyncDataSetIterator(batches)
+        assert maybe_stage(async_it) is async_it
+        sbit = SuperbatchIterator(batches, k=2)
+        assert maybe_stage(sbit) is sbit
+        # kill switch
+        monkeypatch.setenv("DL4J_TPU_STAGING", "0")
+        assert not staging_enabled()
+        assert maybe_stage(batches) is batches
+        monkeypatch.delenv("DL4J_TPU_STAGING")
+        wrapped = maybe_stage(batches)
+        assert isinstance(wrapped, DeviceStager)
+        wrapped.close()
+
+    def test_budget_env_override(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_STAGE_BYTES", "12345")
+        assert staging_budget_bytes() == 12345
+
+
+# ------------------------------------------------------------ backpressure
+
+
+class TestBackpressure:
+    def test_inflight_never_exceeds_budget(self, rng, monkeypatch):
+        batches = make_batches(rng, n_batches=8, batch=16)
+        per = host_item_nbytes(batches[0])
+        budget = 2 * per + per // 2  # window fits two batches, not three
+        monkeypatch.setenv("DL4J_TPU_STAGE_BYTES", str(budget))
+        stager = DeviceStager(batches, depth=8)
+        seen = 0
+        for _ in stager:
+            time.sleep(0.02)  # slow consumer: let the worker run ahead
+            seen += 1
+        assert seen == len(batches)
+        assert stager.max_inflight_bytes > 0
+        assert stager.max_inflight_bytes <= budget
+
+    def test_oversized_item_admitted_alone(self, rng, monkeypatch):
+        batches = make_batches(rng, n_batches=4, batch=16)
+        per = host_item_nbytes(batches[0])
+        monkeypatch.setenv("DL4J_TPU_STAGE_BYTES", str(per // 4))
+        # Budget below one batch: the window shrinks to one-at-a-time
+        # instead of erroring.
+        stager = DeviceStager(batches, depth=8)
+        assert len(list(stager)) == len(batches)
+        assert stager.max_inflight_bytes == per
+
+
+# ------------------------------------------------------------ failure paths
+
+
+class TestFailurePaths:
+    def test_producer_error_surfaces_with_zero_leaked_hbm(self, rng):
+        batches = make_batches(rng, n_batches=3)
+        base = gauges()
+
+        def boom():
+            yield batches[0]
+            yield batches[1]
+            raise RuntimeError("boom mid-stream")
+
+        stager = DeviceStager(boom(), depth=2)
+        with pytest.raises(RuntimeError, match="boom mid-stream"):
+            for _ in stager:
+                pass
+        stager.close()
+        assert gauges() == pytest.approx(base)
+
+    def test_abandoned_iteration_drops_staged_buffers(self, rng):
+        batches = make_batches(rng, n_batches=6)
+        base = gauges()
+        stager = DeviceStager(batches, depth=4)
+        next(iter(stager))  # consume one, abandon the rest
+        stager.close()
+        assert gauges() == pytest.approx(base)
+        # closed stagers iterate as exhausted
+        assert list(stager) == []
+
+    def test_engine_fit_propagates_producer_error(self, rng):
+        net = MultiLayerNetwork(mlp_conf())
+        net.init()
+        batches = make_batches(rng, n_batches=3)
+
+        def boom():
+            yield batches[0]
+            yield batches[1]
+            raise RuntimeError("stream died")
+
+        base = gauges()
+        with pytest.raises(RuntimeError, match="stream died"):
+            net.fit(boom())
+        assert gauges() == pytest.approx(base)
+
+
+# ----------------------------------------------- AsyncDataSetIterator fixes
+
+
+class TestAsyncIteratorSatellites:
+    def test_consumer_wait_observed_under_source_async(self, rng):
+        child = _obs.metrics.histogram(
+            "dl4j_input_wait_seconds", label_names=("source",)
+        ).labels(source="async")
+        _, _, _, c0 = child.histogram_state()
+        list(AsyncDataSetIterator(make_batches(rng), queue_size=2))
+        _, _, _, c1 = child.histogram_state()
+        assert c1 > c0
+
+    def test_staging_wait_records_producer_stalls(self, rng):
+        fam = _obs.metrics.get_family("dl4j_staging_wait_seconds")
+        assert fam is not None
+        (child,) = list(fam.children())
+        _, _, _, c0 = child.histogram_state()
+        list(AsyncDataSetIterator(make_batches(rng), queue_size=2))
+        _, _, _, c1 = child.histogram_state()
+        assert c1 > c0
+
+    def test_reset_stops_live_worker_before_base_reset(self, rng):
+        batches = make_batches(rng, n_batches=6)
+        base_it = ListDataSetIterator(batches, batch_size=6)
+        async_it = AsyncDataSetIterator(base_it, queue_size=2)
+        gauge0 = gauges()
+        it = iter(async_it)
+        next(it)  # worker is live, part-way through the base
+        async_it.reset()  # must stop + drain the worker, then reset base
+        assert async_it._active is None
+        assert gauges() == pytest.approx(gauge0)
+        # A fresh epoch sees the FULL stream, in order, from the start.
+        replay = list(async_it)
+        assert len(replay) == len(batches)
+        for got, want in zip(replay, batches):
+            np.testing.assert_array_equal(np.asarray(got.features),
+                                          want.features)
+
+    def test_reiter_closes_prior_worker(self, rng):
+        async_it = AsyncDataSetIterator(make_batches(rng), queue_size=2)
+        first = iter(async_it)
+        next(first)
+        second = iter(async_it)  # prior epoch's stager must be closed
+        assert first._closed
+        assert len(list(second)) == 6
+
+
+# -------------------------------------------------------------- bit-identity
+
+
+class TestBitIdentity:
+    def _fit_both(self, make_net, batches, monkeypatch, epochs=2):
+        monkeypatch.setenv("DL4J_TPU_STAGING", "0")
+        sync_net = make_net()
+        for _ in range(epochs):
+            sync_net.fit(batches)
+        monkeypatch.delenv("DL4J_TPU_STAGING")
+        assert staging_enabled()
+        staged_net = make_net()
+        for _ in range(epochs):
+            staged_net.fit(batches)
+        return sync_net, staged_net
+
+    def test_mln_staged_matches_synchronous(self, rng, monkeypatch):
+        batches = make_batches(rng)
+        a, b = self._fit_both(lambda: MultiLayerNetwork(mlp_conf()),
+                              batches, monkeypatch)
+        assert_trees_identical(a.params_tree, b.params_tree)
+        assert_trees_identical(a.opt_state, b.opt_state)
+
+    def test_graph_staged_matches_synchronous(self, rng, monkeypatch):
+        batches = make_batches(rng)
+        a, b = self._fit_both(lambda: ComputationGraph(graph_conf()),
+                              batches, monkeypatch)
+        assert_trees_identical(a.params_tree, b.params_tree)
+        assert_trees_identical(a.opt_state, b.opt_state)
+
+    def test_superstep_staged_matches_synchronous(self, rng, monkeypatch):
+        batches = make_batches(rng, n_batches=10)  # k=4: two blocks + tail 2
+        a, b = self._fit_both(lambda: MultiLayerNetwork(mlp_conf(superstep_k=4)),
+                              batches, monkeypatch)
+        assert_trees_identical(a.params_tree, b.params_tree)
+        assert_trees_identical(a.opt_state, b.opt_state)
+
+    def test_stage_item_handles_superbatch_containers(self, rng):
+        from deeplearning4j_tpu.datasets.iterators import stack_superbatch
+
+        batches = make_batches(rng, n_batches=3)
+        sb = stack_superbatch(batches, stage=False)
+        staged = stage_item(sb)
+        assert type(staged).__name__ == "Superbatch"
+        assert staged.k == 3
+        assert not isinstance(staged.features, np.ndarray)
+        np.testing.assert_array_equal(
+            np.asarray(staged.features),
+            np.stack([b.features for b in batches]))

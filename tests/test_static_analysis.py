@@ -535,13 +535,102 @@ def build(fn, out):
         assert [f for f in lint_file(str(p)) if f.rule == "JX010"] == []
 
 
+# --------------------------------------------------------------- JX011
+
+class TestJX011SyncStagingInFitLoop:
+    # JX011 is path-scoped (nn/, parallel/, datasets/), so snippets lint
+    # under an explicit in-scope path instead of "<snippet>".
+    def _lint(self, src, path="nn/fake_engine.py"):
+        return lint_source(src, path, rules=["JX011"])
+
+    def test_stage_to_device_in_fit_fires(self):
+        src = """
+from deeplearning4j_tpu.datasets.staging import stage_to_device
+
+class Net:
+    def fit(self, iterator):
+        for ds in iterator:
+            staged = stage_to_device(ds)
+            self._fit_dispatch(staged)
+"""
+        fs = self._lint(src)
+        assert rules_of(fs) == {"JX011"}
+        assert "staging.py" in fs[0].message
+
+    def test_device_put_in_dispatch_fires(self):
+        src = """
+import jax
+
+class Wrapper:
+    def _fit_dispatch(self, ds):
+        x = jax.device_put(ds.features)
+        return self.step(x)
+"""
+        fs = self._lint(src, path="parallel/fake_wrapper.py")
+        assert rules_of(fs) == {"JX011"}
+        assert "device_put" in fs[0].message
+
+    def test_scalar_put_is_exempt(self):
+        src = """
+import jax
+import numpy as np
+
+class Net:
+    def _fit_tbptt(self, ds):
+        eb = jax.device_put(np.float32(2.0))
+        return eb
+"""
+        assert self._lint(src) == []
+
+    def test_staged_consumption_is_clean(self):
+        src = """
+from deeplearning4j_tpu.datasets import staging as _staging
+
+class Net:
+    def fit(self, iterator):
+        src = _staging.maybe_stage(iterator, net=self, engine="mln")
+        try:
+            for ds in src:
+                self._fit_dispatch(ds)
+        finally:
+            _staging.close_stager(src)
+"""
+        assert self._lint(src) == []
+
+    def test_cold_path_helper_is_clean(self):
+        # Puts outside fit/dispatch-named functions (warmup, cache build)
+        # are not hot-path stalls.
+        src = """
+import jax
+
+class Wrapper:
+    def warmup(self, batch):
+        return jax.device_put(batch.features)
+"""
+        assert self._lint(src, path="parallel/fake_wrapper.py") == []
+
+    def test_staging_module_is_allowed(self):
+        src = """
+import jax
+
+def fit(parts):
+    return jax.device_put(tuple(parts))
+"""
+        assert self._lint(
+            src, path="deeplearning4j_tpu/datasets/staging.py") == []
+
+    def test_package_is_jx011_clean(self):
+        from deeplearning4j_tpu.analysis.linter import lint_package
+        assert [f for f in lint_package(rules=["JX011"])] == []
+
+
 # ------------------------------------------------------------ framework
 
 class TestLinterFramework:
     def test_registry_has_all_rules(self):
         assert set(ALL_RULES) >= {"JX001", "JX002", "JX003", "JX004",
                                   "JX005", "JX006", "JX007", "JX008",
-                                  "JX009", "JX010"}
+                                  "JX009", "JX010", "JX011"}
 
     def test_findings_are_typed_and_sorted(self):
         src = """
