@@ -1363,6 +1363,125 @@ def bench_elastic_recovery(steps, warmup):
               "is an improvement."))
 
 
+def bench_fleet_slo(steps, warmup):
+    """Serving-fleet SLO drill (serving/fleet.py + serving/router.py):
+    a 3-replica CPU fleet behind the least-loaded failover router. A
+    deterministic fault plan SIGKILLs replica 0 mid-run (1.0s lease) and
+    a rolling update re-deploys a second checkpoint across the survivors
+    while client traffic continues. Reports non-shed availability (the
+    acceptance floor is 0.99), mean failover latency, and the compiles
+    the rollout performed — all of which happen on the DRAINED replica
+    (AOT warm before rejoin), never on the serving path."""
+    import tempfile
+    import threading
+
+    from deeplearning4j_tpu import (MultiLayerNetwork,
+                                    NeuralNetConfiguration,
+                                    observability as obs)
+    from deeplearning4j_tpu.checkpoint.manager import CheckpointManager
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.parallel.coordinator import Coordinator
+    from deeplearning4j_tpu.serving import FleetManager, FleetRouter
+
+    def mlp(seed):
+        return MultiLayerNetwork(
+            (NeuralNetConfiguration.builder()
+             .seed(seed).learning_rate(0.1).weight_init("xavier")
+             .list()
+             .layer(DenseLayer(n_out=4, activation="tanh"))
+             .layer(OutputLayer(n_out=2, activation="softmax",
+                                loss_function="mcxent"))
+             .set_input_type(InputType.feed_forward(3))
+             .build())).init()
+
+    tmp = tempfile.mkdtemp(prefix="bench-fleet-")
+    path_a = os.path.join(tmp, "ckpt-a")
+    path_b = os.path.join(tmp, "ckpt-b")
+    CheckpointManager(path_a, async_save=False).save(mlp(1))
+    CheckpointManager(path_b, async_save=False).save(mlp(7))
+
+    n_req = max(120, steps * 4)
+    kill_at = max(8, n_req // 12)
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _HERE + os.pathsep + env.get("PYTHONPATH", "")
+    env["DL4J_TPU_FAULT_PLAN"] = json.dumps(
+        [{"kind": "kill_replica", "step": kill_at, "worker": 0}])
+
+    coord = Coordinator(lost_after_s=1.0).start()
+    manager = FleetManager(coord.address, path_a, heartbeat_s=0.25,
+                           env=env, log_dir=os.path.join(tmp, "logs"))
+    router = FleetRouter(coord.address, poll_interval_s=0.1,
+                         request_timeout_s=10.0, attempt_timeout_s=0.75,
+                         quarantine_s=4.0, http=False).start()
+    ok = failed = 0
+    update = {}
+    try:
+        for _ in range(3):
+            manager.spawn()
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            if sum(1 for r in router.table()
+                   if r["state"] == "live") == 3:
+                break
+            time.sleep(0.1)
+        else:
+            raise RuntimeError("fleet never reached 3 live replicas")
+
+        rolled = [None]
+
+        def roll():
+            rolled[0] = manager.rolling_update(path_b, router,
+                                               timeout_s=120.0)
+
+        x = [[0.1, -0.2, 0.3]]
+        roller = None
+        for i in range(n_req):
+            if i == n_req // 2:
+                roller = threading.Thread(target=roll)
+                roller.start()
+            try:
+                router.predict(x, timeout_s=10.0)
+                ok += 1
+            except Exception:
+                failed += 1
+        if roller is not None:
+            roller.join(180.0)
+        update = rolled[0] or {}
+    finally:
+        try:
+            router.stop()
+        finally:
+            manager.stop_all()
+            coord.close()
+
+    counts = router.counts()
+    shed = int(counts.get("shed", 0))
+    availability = ok / max(1, n_req - shed)
+    fam = obs.metrics.get_family("dl4j_router_failover_seconds")
+    fo_mean, fo_count = 0.0, 0
+    if fam is not None:
+        for child in fam.children():
+            _, _, fo_sum, fo_count = child.histogram_state()
+            fo_mean = fo_sum / fo_count if fo_count else 0.0
+    rollout_compiles = sum(int(r.get("compiled_during_warm", 0))
+                           for r in update.values()
+                           if isinstance(r, dict))
+    head = _entry(
+        "fleet_availability_nonshed", availability, "ratio",
+        note=(f"3 CPU replicas, replica 0 SIGKILLed at its request "
+              f"#{kill_at}, rolling update mid-run; {ok}/{n_req} ok, "
+              f"{shed} shed, {failed - shed} failed. Floor is 0.99."))
+    head["rolled_replicas"] = sum(
+        1 for r in update.values() if isinstance(r, dict) and r.get("ok"))
+    head["rollout_compiles_while_drained"] = rollout_compiles
+    fo = _entry("fleet_failover_seconds", fo_mean, "seconds",
+                note=(f"mean of {fo_count} failovers (lease 1.0s, "
+                      "attempt timeout 0.75s); acceptance is < 1s."))
+    return [head, fo]
+
+
 def main():
     # Compile-time accounting for the self-attribution snapshot in _emit():
     # every XLA compile during the run lands in dl4j_xla_compile_* counters.
@@ -1377,7 +1496,8 @@ def main():
         "lenet_step,lenet_superstep,fused_update_superstep,"
         "lenet_cold_warm,lenet_pipeline_overlap,word2vec,vgg16,"
         "flash_attn,flash_tri,transformer,"
-        "serving_slo,lm_int8_serving,obs_overhead,elastic_recovery"
+        "serving_slo,lm_int8_serving,obs_overhead,elastic_recovery,"
+        "fleet_slo"
     ).split(",")
 
     head, extra = None, {}
@@ -1448,6 +1568,9 @@ def main():
     if "elastic_recovery" in configs:
         e = bench_elastic_recovery(steps, warmup)
         extra[e["metric"]] = e
+    if "fleet_slo" in configs:
+        for e in bench_fleet_slo(steps, warmup):
+            extra[e["metric"]] = e
     if head is None:  # resnet50 excluded: promote the first extra metric
         if not extra:
             _emit({

@@ -802,3 +802,76 @@ class SyncStagingInFitLoopRule(Rule):
                         "transfers in a fit/dispatch loop serialize the "
                         "link with compute — stage off-thread through "
                         "datasets/staging.py")
+
+
+@register_rule
+class UnboundedBlockingIORule(Rule):
+    """JX012: blocking socket/HTTP call without an explicit timeout on a
+    serving or coordination request path.
+
+    A socket call with no timeout blocks forever; in `serving/` and
+    `parallel/` that default turns one hung peer into a hung fleet — the
+    router's failover, the coordinator's reaper, and the drain path all
+    assume every network wait is bounded (the fleet design budgets each
+    attempt against the request deadline). The timeout must be at the
+    CALL SITE: `socket.setdefaulttimeout` is process-global action at a
+    distance, and "the caller probably set one" is not auditable.
+
+    Flagged (when no `timeout=` kwarg and no positional in the timeout
+    slot): `socket.create_connection`, `urllib.request.urlopen`,
+    `http.client.HTTP(S)Connection`, and `requests.<verb>`.
+    """
+
+    id = "JX012"
+    description = ("blocking socket/HTTP call without an explicit timeout "
+                   "in serving/ or parallel/ (one hung peer hangs the "
+                   "fleet)")
+
+    # callable name -> index of the positional timeout slot (a call with
+    # more positionals than this has passed a timeout positionally)
+    _TIMEOUT_SLOT = {
+        "create_connection": 1,   # socket.create_connection(addr, timeout)
+        "urlopen": 2,             # urlopen(url, data, timeout)
+        "HTTPConnection": 2,      # HTTPConnection(host, port, timeout)
+        "HTTPSConnection": 2,
+    }
+    _REQUESTS_VERBS = {"get", "post", "put", "delete", "head", "patch",
+                       "request"}
+
+    def check(self, ctx):
+        rel = ctx.rel.replace("\\", "/")
+        if "/analysis/" in rel or rel.startswith("analysis/"):
+            return
+        if not any(seg in rel for seg in ("serving/", "parallel/")):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else getattr(
+                fn, "id", None)
+            if name is None:
+                continue
+            has_timeout_kw = any(kw.arg == "timeout" or kw.arg == "timeout_s"
+                                 for kw in node.keywords)
+            if has_timeout_kw:
+                continue
+            slot = self._TIMEOUT_SLOT.get(name)
+            if slot is not None:
+                if len(node.args) > slot:
+                    continue  # timeout passed positionally
+                yield self.finding(
+                    ctx, node,
+                    f"`{name}(...)` without an explicit timeout on a "
+                    "request path: this blocks forever on a hung peer — "
+                    "pass `timeout=` (budgeted against the request "
+                    "deadline, like util/retry.Backoff.max_elapsed_s)")
+            elif (name in self._REQUESTS_VERBS
+                  and isinstance(fn, ast.Attribute)
+                  and attr_base(fn) == "requests"):
+                yield self.finding(
+                    ctx, node,
+                    f"`requests.{name}(...)` without `timeout=`: requests "
+                    "has NO default timeout — a silent hang on a dead "
+                    "replica; every serving/parallel HTTP call must carry "
+                    "an explicit deadline")

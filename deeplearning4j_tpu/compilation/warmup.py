@@ -127,6 +127,23 @@ def warmup_buckets(net, batch_sizes: Sequence[int],
     return out
 
 
+def summarize_bucket_warmup(out: Dict[int, Dict[str, Any]]
+                            ) -> Dict[str, Any]:
+    """Collapse a `warmup_buckets` result into the rollout ledger the
+    serving fleet records per drained-replica warm: how many buckets were
+    driven, how many programs actually COMPILED (vs landed from the AOT
+    store — the number that must be zero once the compile cache is hot),
+    and the wall seconds the drain window spent warming."""
+    buckets = sorted(out)
+    return {
+        "buckets": len(buckets),
+        "compiled": sum(int(s.get("compiled", 0)) for s in out.values()),
+        "aot": sum(int(s.get("aot", 0)) for s in out.values()),
+        "seconds": round(sum(float(s.get("seconds", 0.0))
+                             for s in out.values()), 4),
+    }
+
+
 # ----------------------------------------------------------- program args
 
 

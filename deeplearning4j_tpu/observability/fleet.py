@@ -1,0 +1,49 @@
+"""Serving-fleet lifecycle instruments on the process-global registry.
+
+The fleet analog of `observability/elastic.py`: the router and the
+replica runtime (`serving/fleet.py`, `serving/router.py`) feed one event
+counter plus the flight-recorder ring, so a post-mortem bundle shows the
+failover timeline (replica joined -> lease expired -> evicted -> traffic
+rerouted -> replacement warmed) next to the request-level records.
+
+Events:
+
+- ``replica_join``     — a replica became routable (role ``replica``)
+- ``replica_warming``  — a replica registered but is still pre-warming
+- ``replica_draining`` — drain started (SIGTERM or rolling update)
+- ``replica_left``     — clean leave observed
+- ``replica_dead``     — lease expiry evicted a replica from the table
+- ``failover``         — a request was rerouted off a failed replica
+- ``shed``             — the router shed a request (all replicas busy)
+- ``rolling_update``   — a replica finished a drained checkpoint swap
+- ``autoscale_up`` / ``autoscale_down`` — the autoscaler acted
+
+Families are created ONCE at import (JX008); `record_event` never
+raises — it runs inside signal handlers and the router's poll thread.
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu import observability as _obs
+
+EVENTS = _obs.metrics.counter(
+    "dl4j_fleet_events_total",
+    "Serving-fleet lifecycle events (replica_join / replica_dead / "
+    "failover / shed / rolling_update / autoscale_up / ...)",
+    label_names=("event",))
+
+
+def record_event(event: str, **fields) -> None:
+    """Count one fleet lifecycle event and mirror it into the flight
+    ring. Never raises: instrumentation must not mask the fault being
+    handled (same contract as `observability.elastic.record_event`)."""
+    try:
+        EVENTS.labels(event=event).inc()
+    except Exception:
+        pass
+    try:
+        from deeplearning4j_tpu.observability import flight
+
+        flight.record_event(f"fleet:{event}", **fields)
+    except Exception:
+        pass

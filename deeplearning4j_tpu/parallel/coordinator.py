@@ -124,6 +124,7 @@ class Coordinator:
                  lost_after_s: float = LOST_AFTER_S):
         self._cond = threading.Condition()
         self._members: Dict[str, float] = {}  # worker_id -> last_seen
+        self._roles: Dict[str, str] = {}      # worker_id -> declared role
         self._generation = 0
         self._hang_until = 0.0
         self._contribs: Dict[tuple, Dict[str, Dict[str, np.ndarray]]] = {}
@@ -197,6 +198,7 @@ class Coordinator:
                 if dead:
                     for w in dead:
                         del self._members[w]
+                        self._roles.pop(w, None)
                     self._bump_generation()
             for w in dead:
                 _ev.record_event("host_lost", worker=w,
@@ -246,12 +248,19 @@ class Coordinator:
     def _op_join(self, req) -> Dict[str, Any]:
         """Add the worker; when `expected` is given, block until that many
         members are present (or `grace_s` runs out — the cluster then
-        forms on whoever showed up, elastically)."""
+        forms on whoever showed up, elastically). `role` tags the member
+        for `status` consumers (trainer vs serving replica; a re-join with
+        a new role updates it in place — the serving fleet drives its
+        warming/draining lifecycle through exactly that)."""
         worker = str(req["worker"])
         expected = req.get("expected")
         grace = float(req.get("grace_s", JOIN_GRACE_S))
         deadline = time.monotonic() + grace
         with self._cond:
+            if "role" in req and req["role"] is not None:
+                self._roles[worker] = str(req["role"])
+            else:
+                self._roles.setdefault(worker, "trainer")
             if worker not in self._members:
                 self._members[worker] = time.monotonic()
                 self._bump_generation()
@@ -294,14 +303,26 @@ class Coordinator:
         with self._cond:
             if worker in self._members:
                 del self._members[worker]
+                self._roles.pop(worker, None)
                 self._bump_generation()
             doc = self._member_doc()
         doc.update(ok=True)
         return doc
 
     def _op_status(self, req) -> Dict[str, Any]:
+        """Membership plus per-member lease age and role: the serving
+        router reads staleness here BEFORE the reaper evicts (a replica
+        whose lease is most of the way to `lost_after_s` stops getting new
+        requests), and humans get the same table via the CLI."""
         with self._cond:
             doc = self._member_doc()
+            now = time.monotonic()
+            floor = self._hang_until
+            doc["detail"] = {
+                w: {"role": self._roles.get(w, "trainer"),
+                    "lease_age_s": round(max(0.0, now - max(seen, floor)), 4)}
+                for w, seen in self._members.items()}
+            doc["lost_after_s"] = self.lost_after_s
         doc.update(ok=True)
         return doc
 
@@ -401,9 +422,11 @@ class CoordinatorClient:
 
     def __init__(self, address: str, worker_id: str,
                  rpc_timeout_s: float = RPC_TIMEOUT_S,
-                 backoff: Optional[Backoff] = None):
+                 backoff: Optional[Backoff] = None,
+                 role: str = "trainer"):
         self.host, self.port = parse_address(address)
         self.worker_id = str(worker_id)
+        self.role = str(role)
         self.rpc_timeout_s = float(rpc_timeout_s)
         self.backoff = backoff or Backoff(base_s=0.05, max_s=2.0, tries=8)
         self.gen = -1
@@ -432,9 +455,13 @@ class CoordinatorClient:
         return resp
 
     def _rpc(self, doc: Dict[str, Any], timeout_s: Optional[float] = None,
-             tries: Optional[int] = None) -> Dict[str, Any]:
+             tries: Optional[int] = None,
+             max_elapsed_s: Optional[float] = None) -> Dict[str, Any]:
         bo = Backoff(base_s=self.backoff.base_s, max_s=self.backoff.max_s,
-                     tries=tries or self.backoff.tries)
+                     tries=tries or self.backoff.tries,
+                     max_elapsed_s=(max_elapsed_s
+                                    if max_elapsed_s is not None
+                                    else self.backoff.max_elapsed_s))
 
         def on_retry(attempt, exc):
             _ev.record_event("coordinator_retry", op=doc.get("op"),
@@ -449,14 +476,23 @@ class CoordinatorClient:
 
     def join(self, expected: Optional[int] = None,
              grace_s: float = JOIN_GRACE_S,
-             deadline_s: Optional[float] = None) -> Dict[str, Any]:
+             deadline_s: Optional[float] = None,
+             role: Optional[str] = None) -> Dict[str, Any]:
         """Join (or re-join) the cluster; blocks server-side until the
         expected world forms or the grace lapses. Clears any pending
-        regen flag — after a successful join we ARE the new generation."""
+        regen flag — after a successful join we ARE the new generation.
+        The retry envelope is capped at the caller's budget (`deadline_s`
+        or the grace) — a coordinator that stays down can no longer push
+        the join past its caller's timeout by one extra backoff step."""
+        if role is not None:
+            self.role = str(role)
+        budget = (deadline_s or grace_s) + self.rpc_timeout_s
         doc = self._rpc({"op": "join", "worker": self.worker_id,
-                         "expected": expected, "grace_s": grace_s},
-                        timeout_s=(deadline_s or grace_s) + self.rpc_timeout_s,
-                        tries=max(self.backoff.tries, 8))
+                         "expected": expected, "grace_s": grace_s,
+                         "role": self.role},
+                        timeout_s=budget,
+                        tries=max(self.backoff.tries, 8),
+                        max_elapsed_s=budget)
         self.gen, self.rank = int(doc["gen"]), int(doc["rank"])
         self.world = int(doc["world"])
         self._hb_regen.clear()
@@ -473,6 +509,15 @@ class CoordinatorClient:
                          "gen": self.gen})
         if doc.get("regen") or not doc.get("known", True):
             self._hb_regen.set()
+        return doc
+
+    def status(self) -> Dict[str, Any]:
+        """The coordinator's membership table with per-member role and
+        lease age (`detail`): ``{gen, members, world, lost_after_s,
+        detail: {worker: {role, lease_age_s}}}``. Read-only — usable
+        without having joined (the serving router polls it)."""
+        doc = self._rpc({"op": "status"})
+        doc.setdefault("detail", {})
         return doc
 
     def start_heartbeats(self, interval_s: float = HEARTBEAT_S) -> None:
@@ -538,3 +583,38 @@ class CoordinatorClient:
             {"op": "allreduce", "name": name, "step": int(step),
              "data": encode_tree(tree)}, timeout_s)
         return decode_tree(resp["data"])
+
+
+# ------------------------------------------------------------------- cli
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``python -m deeplearning4j_tpu.parallel.coordinator HOST:PORT`` —
+    print the membership table (role + lease age per member), the human
+    view of the same `status` op the serving router polls."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="inspect a running coordinator's membership")
+    ap.add_argument("address", help="coordinator host:port")
+    ap.add_argument("--timeout-s", type=float, default=5.0)
+    args = ap.parse_args(argv)
+    client = CoordinatorClient(args.address, worker_id="cli-status",
+                               rpc_timeout_s=args.timeout_s)
+    try:
+        doc = client.status()
+    except RetryError as e:
+        print(f"coordinator unreachable at {args.address}: {e}")
+        return 1
+    print(f"generation {doc['gen']}  world {doc['world']}  "
+          f"lost_after {doc.get('lost_after_s', '?')}s")
+    detail = doc.get("detail", {})
+    for w in doc.get("members", []):
+        d = detail.get(w, {})
+        print(f"  {w:40s} role={d.get('role', '?'):18s} "
+              f"lease_age={d.get('lease_age_s', '?')}s")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

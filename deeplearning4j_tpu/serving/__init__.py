@@ -13,7 +13,12 @@ unbounded queue, one fixed padded batch shape) is now a package:
                 path;
 - `http`      — the route handlers;
 - `errors`    — typed failures with their HTTP statuses;
-- `metrics`   — the SLO instrument families.
+- `metrics`   — the SLO instrument families;
+- `fleet`     — replica runtime: coordinator membership, graceful drain,
+                rolling updates, autoscaling (`ReplicaServer`,
+                `FleetManager`, `Autoscaler`);
+- `router`    — the fleet front-end: least-loaded routing with
+                deadline-budgeted failover (`FleetRouter`).
 
 `from deeplearning4j_tpu.serving import InferenceServer` and
 `InferenceServer.from_checkpoint(...)` are unchanged from the module era.
@@ -29,11 +34,18 @@ from deeplearning4j_tpu.serving.errors import (
     InputValidationError,
     ModelNotFoundError,
     ModelNotReadyError,
+    ReplicaDrainingError,
     RequestTimeoutError,
     ServerOverloadedError,
     ServingError,
 )
+from deeplearning4j_tpu.serving.fleet import (
+    Autoscaler,
+    FleetManager,
+    ReplicaServer,
+)
 from deeplearning4j_tpu.serving.host import ModelHost, ServedModel
+from deeplearning4j_tpu.serving.router import FleetRouter
 from deeplearning4j_tpu.serving.scheduler import (
     GenerationRequest,
     GenerationScheduler,
@@ -48,7 +60,12 @@ __all__ = [
     "GenerationRequest",
     "ModelHost",
     "ServedModel",
+    "ReplicaServer",
+    "FleetManager",
+    "FleetRouter",
+    "Autoscaler",
     "ServingError",
+    "ReplicaDrainingError",
     "InputValidationError",
     "ModelNotFoundError",
     "ModelNotReadyError",

@@ -172,12 +172,16 @@ class ShapeBucketBatcher:
         return self
 
     def stop(self) -> None:
-        if self._thread is not None:
+        t = self._thread
+        if t is not None:
+            self._thread = None
             try:
                 self._queue.put_nowait(None)
             except queue.Full:
                 pass  # the loop sheds the backlog and exits on the sentinel
-            self._thread = None
+            # Bounded join: a worker left mid-dispatch at interpreter
+            # shutdown dies inside native code (SIGABRT, not a clean exit).
+            t.join(timeout=10.0)
 
     def qsize(self) -> int:
         return self._queue.qsize()

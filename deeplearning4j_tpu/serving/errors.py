@@ -44,6 +44,16 @@ class ServerOverloadedError(ServingError):
     retry_after = 1
 
 
+class ReplicaDrainingError(ServingError):
+    """This replica is draining (SIGTERM / rolling update): it finishes
+    its in-flight work but admits nothing new. The fleet router treats
+    the 503 as a clean failover signal — the request was never admitted,
+    so retrying it on another replica is always safe."""
+
+    status = 503
+    retry_after = 1
+
+
 class RequestTimeoutError(ServingError, TimeoutError):
     """Deadline expired (in queue or waiting for a batch). Subclasses
     TimeoutError so pre-package callers catching TimeoutError still work."""

@@ -1,0 +1,611 @@
+"""Serving-replica runtime: membership, graceful drain, rolling updates.
+
+One `InferenceServer` process becomes a *fleet member* by wrapping it in
+a `ReplicaServer`: the replica registers with the PR 9 elastic
+`Coordinator` under a ``replica`` role (health = the same heartbeat
+leases that detect a lost trainer), the front-end `FleetRouter`
+(`serving/router.py`) reads the membership table and routes, and the
+replica's lifecycle is driven through role re-joins — the state machine
+the router sees IS the coordinator's role field:
+
+    replica:warming  ->  replica  ->  replica:draining  ->  (left)
+
+- **warming**: joined (so the fleet is visible) but pre-compiling every
+  batch/prompt bucket through the `compilation/` AOT store; the router
+  does not route here, so a cold replica never costs a caller a compile.
+- **replica**: routable. Heartbeats refresh the lease; lease expiry gets
+  the replica reaped server-side and evicted from the routing table.
+- **draining**: stops admitting (503 + Retry-After — a clean failover
+  signal, the request was never admitted), finishes in-flight work, then
+  leaves. SIGTERM triggers exactly this, so `kubectl delete pod` /
+  preemption is a zero-error event; a **rolling update** is a drain that
+  swaps the checkpoint, re-warms every bucket (PERF.md §14's warm-start,
+  per replica), and re-joins as ``replica`` — the deploy never costs a
+  user a compile OR a 5xx.
+
+`FleetManager` spawns/retires replica subprocesses via this module's CLI
+(``python -m deeplearning4j_tpu.serving.fleet``); `Autoscaler` calls
+spawn/retire on sustained queue-depth or p99 SLO breach. Deterministic
+chaos comes from `util/faultinject.py`'s fleet kinds (``kill_replica`` /
+``hang_replica`` / ``slow_decode``), fired at the replica's request-
+admission seam at an exact (request_n, replica_index).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from deeplearning4j_tpu import observability as _obs
+from deeplearning4j_tpu.observability import fleet as _fev
+from deeplearning4j_tpu.parallel.coordinator import (
+    HEARTBEAT_S,
+    CoordinatorClient,
+)
+from deeplearning4j_tpu.serving.errors import ReplicaDrainingError
+from deeplearning4j_tpu.serving.server import InferenceServer
+from deeplearning4j_tpu.util.faultinject import Fault, FaultPlan
+
+ROLE_LIVE = "replica"
+ROLE_WARMING = "replica:warming"
+ROLE_DRAINING = "replica:draining"
+
+
+def compiles_total() -> int:
+    """Process-total `dl4j_xla_compiles_total` (0 when the jax compile
+    hook isn't installed) — the number the rolling-update ledger and the
+    zero-compile acceptance check read."""
+    fam = _obs.metrics.get_family("dl4j_xla_compiles_total")
+    if fam is None:
+        return 0
+    return int(sum(c.get() for c in fam.children()))
+
+
+class ReplicaServer:
+    """One fleet member: an `InferenceServer` plus coordinator membership,
+    drain/rolling-update lifecycle, and the deterministic fault seam.
+
+    The HTTP layer calls `on_request()` at admission (faults fire here,
+    draining 503s here) and `request_done()` when the request finishes
+    (the drain waits on in-flight hitting zero).
+    """
+
+    def __init__(self, coordinator_address: str, *, name: str = "replica",
+                 net=None, path=None, replica_index: int = 0,
+                 host: str = "127.0.0.1", port: int = 0,
+                 heartbeat_s: Optional[float] = None, warm: bool = True,
+                 fault_plan: Optional[FaultPlan] = None,
+                 drain_timeout_s: float = 30.0,
+                 handle_sigterm: bool = True, **server_kwargs):
+        if net is None and path is None:
+            raise ValueError("ReplicaServer needs a live net or a "
+                             "checkpoint path")
+        self.coordinator_address = str(coordinator_address)
+        self.name = str(name)
+        self.replica_index = int(replica_index)
+        self.warm = bool(warm)
+        self.heartbeat_s = (HEARTBEAT_S if heartbeat_s is None
+                            else float(heartbeat_s))
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.handle_sigterm = bool(handle_sigterm)
+        self.fault_plan = fault_plan or FaultPlan.from_env()
+        # Count real backend compiles in every replica process: the
+        # rolling-update ledger and the fleet bench read this counter.
+        _obs.install_jax_compile_hook()
+        self.server = InferenceServer(net=net, host=host, port=port,
+                                      **server_kwargs)
+        if net is None:
+            self.server.add_model(self.server.default_model, path=path)
+        self.server.fleet_replica = self
+        self.client: Optional[CoordinatorClient] = None
+        self._cond = threading.Condition()
+        self._request_n = 0
+        self._inflight = 0
+        self._hang_until = 0.0
+        self._slow_ms = 0.0
+        self._draining = threading.Event()
+        self._stopped = threading.Event()
+        self._fault_handlers: Dict[str, Callable[[Fault], None]] = {
+            "kill_replica": lambda f: os._exit(137),
+            "hang_replica": self._on_hang_fault,
+            "slow_decode": self._on_slow_fault,
+        }
+
+    # ----------------------------------------------------------- lifecycle
+
+    @property
+    def url(self) -> str:
+        return self.server.url
+
+    def start(self) -> "ReplicaServer":
+        """Bind, register as warming, pre-compile every bucket, THEN
+        become routable — the router never sees a replica that would cost
+        a caller an XLA compile."""
+        self.server.start()
+        worker_id = f"{self.name}@{self.server.host}:{self.server.port}"
+        self.client = CoordinatorClient(self.coordinator_address, worker_id,
+                                        role=ROLE_WARMING)
+        self.client.join(role=ROLE_WARMING)
+        self.client.start_heartbeats(self.heartbeat_s)
+        _fev.record_event("replica_warming", replica=self.name,
+                          url=self.url)
+        if self.warm:
+            self._warm_all()
+        self.server._ready.set()
+        self.client.join(role=ROLE_LIVE)
+        _fev.record_event("replica_join", replica=self.name, url=self.url)
+        self._install_sigterm()
+        return self
+
+    def _warm_all(self) -> None:
+        for name in self.server.models.names():
+            model = self.server.models.get(name)
+            try:
+                if model.batcher is not None:
+                    model.batcher.warm()
+                if model.scheduler is not None:
+                    model.scheduler.warmup()
+            except Exception as e:
+                import warnings
+
+                warnings.warn(
+                    f"replica warmup failed for {name!r} "
+                    f"({type(e).__name__}: {e}); the first request will "
+                    "pay the compile")
+            finally:
+                model.ready.set()
+
+    def _install_sigterm(self) -> None:
+        if (not self.handle_sigterm or threading.current_thread()
+                is not threading.main_thread()):
+            return
+        try:
+            signal.signal(signal.SIGTERM, self._on_sigterm)
+        except ValueError:
+            pass
+
+    def _on_sigterm(self, signum, frame) -> None:
+        # Drain off the signal frame: the handler must return immediately
+        # so in-flight request threads can finish.
+        threading.Thread(target=self.drain, name="dl4j-replica-drain",
+                         daemon=True).start()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the replica has drained and stopped (the CLI's
+        main thread parks here)."""
+        return self._stopped.wait(timeout)
+
+    # ----------------------------------------------------------- admission
+
+    def on_request(self, route: str) -> None:
+        """Request-admission seam: deterministic faults fire here, an
+        injected hang stalls here (wedging this handler thread, exactly
+        like a hung replica), and a draining replica refuses here with a
+        clean 503. Callers MUST pair with `request_done()`."""
+        with self._cond:
+            n = self._request_n
+            self._request_n += 1
+        self.fault_plan.maybe_fire(n, self.replica_index,
+                                   self._fault_handlers)
+        while True:
+            with self._cond:
+                remaining = self._hang_until - time.monotonic()
+            if remaining <= 0:
+                break
+            time.sleep(min(remaining, 0.05))
+        if self._slow_ms > 0:
+            time.sleep(self._slow_ms / 1000.0)
+        if self._draining.is_set():
+            raise ReplicaDrainingError(
+                f"replica {self.name!r} is draining; retry another replica")
+        with self._cond:
+            self._inflight += 1
+
+    def request_done(self) -> None:
+        with self._cond:
+            self._inflight = max(0, self._inflight - 1)
+            self._cond.notify_all()
+
+    def inflight(self) -> int:
+        with self._cond:
+            return self._inflight
+
+    # -------------------------------------------------------------- faults
+
+    def _on_hang_fault(self, fault: Fault) -> None:
+        seconds = float(fault.args.get("seconds", 1.0))
+        if fault.args.get("stop_heartbeats"):
+            # A hang that also stops heartbeats exercises lease-expiry
+            # eviction; with heartbeats running it exercises the router's
+            # request-timeout + quarantine path instead.
+            if self.client is not None:
+                self.client.stop_heartbeats()
+        with self._cond:
+            self._hang_until = max(self._hang_until,
+                                   time.monotonic() + seconds)
+
+    def _on_slow_fault(self, fault: Fault) -> None:
+        self._slow_ms = float(fault.args.get("ms", 100.0))
+
+    # ----------------------------------------------------- drain / update
+
+    def _wait_inflight_zero(self, timeout_s: float) -> bool:
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(min(remaining, 0.25))
+        return True
+
+    def drain(self, timeout_s: Optional[float] = None) -> None:
+        """Graceful exit: stop admitting, tell the router (role flip),
+        finish in-flight work, leave the cluster cleanly, stop serving.
+        Idempotent — SIGTERM during an explicit drain is a no-op."""
+        if self._stopped.is_set():
+            return
+        first = not self._draining.is_set()
+        self._draining.set()
+        if not first:
+            return
+        _fev.record_event("replica_draining", replica=self.name)
+        if self.client is not None:
+            try:
+                self.client.join(role=ROLE_DRAINING)
+            except Exception:
+                pass  # coordinator gone: still drain locally
+        self._wait_inflight_zero(timeout_s if timeout_s is not None
+                                 else self.drain_timeout_s)
+        if self.client is not None:
+            self.client.leave()
+            self.client.stop_heartbeats()
+        self.server.stop()
+        _fev.record_event("replica_left", replica=self.name)
+        self._stopped.set()
+
+    def reload(self, path, warm: bool = True) -> Dict[str, Any]:
+        """Rolling model update on THIS replica: drain from the routing
+        table, finish in-flight, swap the default model to `path`,
+        AOT-warm every bucket while drained, then re-join as routable.
+        Every compile the new checkpoint needs happens inside the drain
+        window — zero compiles (and zero 5xx) on the serving path."""
+        t0 = time.monotonic()
+        c0 = compiles_total()
+        self._draining.set()
+        if self.client is not None:
+            try:
+                self.client.join(role=ROLE_DRAINING)
+            except Exception:
+                pass
+        self._wait_inflight_zero(self.drain_timeout_s)
+        host = self.server.models
+        name = self.server.default_model
+        with host._lock:
+            model = host._models[name]
+            model.path = str(path)
+            model.pinned = False  # path-backed now: evictable + reloadable
+            host._evict(model)
+        host._reload(model)
+        if warm:
+            try:
+                if model.batcher is not None:
+                    model.batcher.warm()
+                if model.scheduler is not None:
+                    model.scheduler.warmup()
+            finally:
+                model.ready.set()
+        compiled = compiles_total() - c0
+        self._draining.clear()
+        if self.client is not None:
+            self.client.join(role=ROLE_LIVE)
+        seconds = round(time.monotonic() - t0, 4)
+        _fev.record_event("rolling_update", replica=self.name,
+                          path=str(path), compiled=compiled,
+                          seconds=seconds)
+        return {"ok": True, "model": name, "path": str(path),
+                "compiled_during_warm": compiled, "seconds": seconds}
+
+
+# ------------------------------------------------------------------ fleet
+
+
+class FleetManager:
+    """Spawns and retires replica subprocesses through this module's CLI.
+
+    Each replica is one OS process (its own device runtime, its own
+    fate): `spawn()` launches it against the shared coordinator,
+    `retire()` SIGTERMs it (graceful drain), `kill()` SIGKILLs it (chaos
+    / failover drills), `rolling_update()` walks the live fleet one
+    replica at a time through `POST /admin/reload`.
+    """
+
+    def __init__(self, coordinator_address: str, path, *,
+                 python: Optional[str] = None, host: str = "127.0.0.1",
+                 heartbeat_s: Optional[float] = None,
+                 env: Optional[Dict[str, str]] = None,
+                 extra_args: Optional[List[str]] = None,
+                 log_dir=None):
+        self.coordinator_address = str(coordinator_address)
+        self.path = str(path)
+        self.python = python or sys.executable
+        self.host = host
+        self.heartbeat_s = heartbeat_s
+        self.env = dict(env or {})
+        self.extra_args = list(extra_args or [])
+        self.log_dir = None if log_dir is None else str(log_dir)
+        self.procs: Dict[str, subprocess.Popen] = {}
+        self._next_index = 0
+
+    def spawn(self, name: Optional[str] = None, port: int = 0,
+              replica_index: Optional[int] = None,
+              extra_env: Optional[Dict[str, str]] = None) -> str:
+        idx = self._next_index if replica_index is None else int(
+            replica_index)
+        self._next_index = max(self._next_index, idx) + 1
+        name = name or f"replica-{idx}"
+        cmd = [self.python, "-m", "deeplearning4j_tpu.serving.fleet",
+               "--coordinator", self.coordinator_address,
+               "--name", name, "--path", self.path,
+               "--host", self.host, "--port", str(port),
+               "--replica-index", str(idx)]
+        if self.heartbeat_s is not None:
+            cmd += ["--heartbeat-s", str(self.heartbeat_s)]
+        cmd += self.extra_args
+        env = dict(os.environ)
+        env.update(self.env)
+        env.update(extra_env or {})
+        stdout = None
+        if self.log_dir is not None:
+            os.makedirs(self.log_dir, exist_ok=True)
+            stdout = open(os.path.join(self.log_dir, f"{name}.log"), "ab")
+        self.procs[name] = subprocess.Popen(
+            cmd, env=env, stdout=stdout,
+            stderr=subprocess.STDOUT if stdout is not None else None)
+        return name
+
+    def alive(self) -> Dict[str, bool]:
+        return {n: p.poll() is None for n, p in self.procs.items()}
+
+    def retire(self, name: Optional[str] = None,
+               timeout_s: float = 30.0) -> Optional[int]:
+        """Graceful retire: SIGTERM -> the replica drains, leaves, exits
+        0. Returns the exit code (None if it had already exited)."""
+        name = name or self._newest_alive()
+        if name is None:
+            return None
+        proc = self.procs[name]
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=5.0)
+        return proc.returncode
+
+    def kill(self, name: str) -> None:
+        """Hard loss (chaos drills): SIGKILL, no drain, no leave — the
+        coordinator's reaper and the router's failover must clean up."""
+        proc = self.procs[name]
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10.0)
+
+    def _newest_alive(self) -> Optional[str]:
+        for name in reversed(list(self.procs)):
+            if self.procs[name].poll() is None:
+                return name
+        return None
+
+    def rolling_update(self, new_path, router,
+                       timeout_s: float = 300.0) -> Dict[str, Any]:
+        """Deploy `new_path` across the live fleet one replica at a time:
+        each replica drains, warms the new checkpoint through the AOT
+        store, and re-joins before the next one starts — capacity never
+        drops by more than one replica and no caller ever sees a compile."""
+        from deeplearning4j_tpu.serving.router import post_json
+
+        results: Dict[str, Any] = {}
+        deadline = time.monotonic() + timeout_s
+        for row in router.table():
+            if row["state"] != "live":
+                continue
+            try:
+                results[row["name"]] = post_json(
+                    row["url"] + "/admin/reload", {"path": str(new_path)},
+                    timeout_s=timeout_s)
+            except OSError as e:
+                # The replica died between the table snapshot and its turn
+                # (its lease may not have expired yet, so it still read as
+                # live). The router discovers that on its own; the rollout
+                # moves on to the survivors.
+                results[row["name"]] = {"ok": False, "error": str(e)}
+                continue
+            # Don't drain the next replica until the router has actually
+            # observed this one back in the live set — otherwise its stale
+            # table can briefly show zero routable replicas and shed.
+            while time.monotonic() < deadline:
+                if any(r["name"] == row["name"] and r["state"] == "live"
+                       for r in router.table()):
+                    break
+                time.sleep(0.05)
+        return results
+
+    def stop_all(self, timeout_s: float = 30.0) -> Dict[str, Optional[int]]:
+        for name, proc in self.procs.items():
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        deadline = time.monotonic() + timeout_s
+        codes: Dict[str, Optional[int]] = {}
+        for name, proc in self.procs.items():
+            try:
+                proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=5.0)
+            codes[name] = proc.returncode
+        return codes
+
+
+# -------------------------------------------------------------- autoscale
+
+
+class Autoscaler:
+    """Spawn/retire replicas on *sustained* SLO breach.
+
+    Signals come from `FleetRouter.load_stats()` (queue depth per live
+    replica, request p99); actions are injected callables (production:
+    `FleetManager.spawn` / `.retire`). Breach must persist for
+    `breach_s` before an action fires, and actions are `cooldown_s`
+    apart — a one-scrape spike never flaps the fleet. The clock is
+    injectable so tests drive the state machine deterministically.
+    """
+
+    def __init__(self, router, spawn: Callable[[], Any],
+                 retire: Callable[[], Any], *,
+                 min_replicas: int = 1, max_replicas: int = 8,
+                 queue_high: float = 8.0, queue_low: float = 1.0,
+                 p99_slo_s: Optional[float] = None,
+                 breach_s: float = 5.0, cooldown_s: float = 10.0,
+                 interval_s: float = 1.0,
+                 _clock: Callable[[], float] = time.monotonic):
+        self.router = router
+        self.spawn = spawn
+        self.retire = retire
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.queue_high = float(queue_high)
+        self.queue_low = float(queue_low)
+        self.p99_slo_s = p99_slo_s
+        self.breach_s = float(breach_s)
+        self.cooldown_s = float(cooldown_s)
+        self.interval_s = float(interval_s)
+        self._clock = _clock
+        self._breach_since: Optional[float] = None
+        self._idle_since: Optional[float] = None
+        self._last_action = -float("inf")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.actions: List[Dict[str, Any]] = []
+
+    def evaluate(self) -> Optional[str]:
+        """One decision step; returns "up" / "down" / None. Called by the
+        background loop — and directly by tests with a pinned clock."""
+        now = self._clock()
+        stats = self.router.load_stats()
+        live = int(stats.get("live", 0))
+        per_replica = (stats.get("total_load", 0.0) / live if live
+                       else float("inf"))
+        p99 = stats.get("p99_s")
+        breach = per_replica > self.queue_high or (
+            self.p99_slo_s is not None and p99 is not None
+            and p99 > self.p99_slo_s)
+        idle = live > self.min_replicas and per_replica < self.queue_low
+        if breach:
+            if self._breach_since is None:
+                self._breach_since = now
+        else:
+            self._breach_since = None
+        if idle:
+            if self._idle_since is None:
+                self._idle_since = now
+        else:
+            self._idle_since = None
+        if now - self._last_action < self.cooldown_s:
+            # Conditions observed during cooldown don't count toward the
+            # sustain window — the fleet must re-prove the breach after the
+            # last action settles.
+            self._breach_since = None
+            self._idle_since = None
+            return None
+        if (self._breach_since is not None
+                and now - self._breach_since >= self.breach_s
+                and live < self.max_replicas):
+            self._act("up", now, stats)
+            return "up"
+        if (self._idle_since is not None
+                and now - self._idle_since >= self.breach_s):
+            self._act("down", now, stats)
+            return "down"
+        return None
+
+    def _act(self, direction: str, now: float, stats: Dict[str, Any]) -> None:
+        (self.spawn if direction == "up" else self.retire)()
+        self._last_action = now
+        self._breach_since = None
+        self._idle_since = None
+        self.actions.append({"direction": direction, "at": now,
+                             "stats": dict(stats)})
+        _fev.record_event(f"autoscale_{direction}", **{
+            k: v for k, v in stats.items() if isinstance(v, (int, float))})
+
+    def start(self) -> "Autoscaler":
+        if self._thread is None:
+            self._stop.clear()
+
+            def loop():
+                while not self._stop.wait(self.interval_s):
+                    try:
+                        self.evaluate()
+                    except Exception:
+                        pass  # scaling must never kill the poller
+
+            self._thread = threading.Thread(
+                target=loop, name="dl4j-fleet-autoscaler", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+
+# -------------------------------------------------------------------- cli
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``python -m deeplearning4j_tpu.serving.fleet`` — run one replica
+    until SIGTERM (graceful drain). Prints one JSON "ready" line with the
+    bound URL so spawners can wire the fleet without port guessing."""
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(description="run one serving replica")
+    ap.add_argument("--coordinator", required=True,
+                    help="coordinator host:port")
+    ap.add_argument("--path", required=True, help="checkpoint to serve")
+    ap.add_argument("--name", default="replica")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--replica-index", type=int, default=0)
+    ap.add_argument("--heartbeat-s", type=float, default=None)
+    ap.add_argument("--max-batch-size", type=int, default=32)
+    ap.add_argument("--max-delay-ms", type=float, default=2.0)
+    ap.add_argument("--decode-slots", type=int, default=4)
+    ap.add_argument("--queue-depth", type=int, default=256)
+    ap.add_argument("--no-warm", action="store_true")
+    args = ap.parse_args(argv)
+
+    replica = ReplicaServer(
+        args.coordinator, name=args.name, path=args.path,
+        replica_index=args.replica_index, host=args.host, port=args.port,
+        heartbeat_s=args.heartbeat_s, warm=not args.no_warm,
+        max_batch_size=args.max_batch_size, max_delay_ms=args.max_delay_ms,
+        decode_slots=args.decode_slots, queue_depth=args.queue_depth)
+    replica.start()
+    print(json.dumps({"event": "ready", "name": args.name,
+                      "url": replica.url, "pid": os.getpid()}), flush=True)
+    replica.wait()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -20,7 +20,10 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from deeplearning4j_tpu.serving import metrics as _m
-from deeplearning4j_tpu.serving.errors import ModelNotFoundError
+from deeplearning4j_tpu.serving.errors import (
+    ModelNotFoundError,
+    ModelNotReadyError,
+)
 
 
 def estimate_hbm_bytes(net) -> int:
@@ -156,6 +159,7 @@ class ServedModel:
         self.options = dict(options or {})
         self.batcher = None
         self.scheduler = None
+        self.loading = False  # a reload is in flight off the host lock
         self.ready = threading.Event()
         self.last_used = time.monotonic()
         self.hbm_source = "estimated"
@@ -231,19 +235,35 @@ class ModelHost:
     # ----------------------------------------------------- budget/evict
 
     def _reload(self, model: ServedModel) -> None:
+        """Reload an evicted model. The slow synchronous load runs OFF the
+        host lock: while one thread loads, `/v1/models` snapshots and
+        `get()` on every OTHER model proceed — only callers of the
+        reloading model see a 503 (`ModelNotReadyError`) until the load
+        publishes. The first caller pays the load; concurrent callers of
+        the same model are told to retry instead of queueing behind it."""
         from deeplearning4j_tpu.checkpoint.legacy import load_any
         from deeplearning4j_tpu.util.retry import with_retries
 
         with self._lock:
             if model.resident:
                 return
+            if model.loading:
+                raise ModelNotReadyError(
+                    f"model {model.name!r} is reloading; retry shortly")
+            model.loading = True
             model.ready.clear()
+        try:
             # A reload racing an atomic-rename republish can see a
             # half-moment of ENOENT; retry with backoff instead of
             # evicting the model over a publisher's rename window.
             net = with_retries(lambda: load_any(model.path),
                                retry_on=(OSError,), tries=3,
                                describe=f"model reload {model.name}")
+        except Exception:
+            with self._lock:
+                model.loading = False
+            raise
+        with self._lock:
             model.net = net
             model.hbm_bytes = estimate_hbm_bytes(net)
             _measure_hbm(model)
@@ -254,6 +274,7 @@ class ModelHost:
             if self.on_load is not None:
                 self.on_load(model)
             self._enforce_budget(keep=model)
+            model.loading = False
 
     def resident_bytes(self) -> int:
         with self._lock:
@@ -305,7 +326,8 @@ class ModelHost:
             return [{
                 "name": m.name,
                 "status": ("ready" if m.ready.is_set()
-                           else "warming" if m.resident else "evicted"),
+                           else "warming" if m.resident
+                           else "loading" if m.loading else "evicted"),
                 "resident": m.resident,
                 "pinned": m.pinned,
                 "hbm_bytes": int(m.hbm_bytes),

@@ -11,6 +11,16 @@ Routes (all JSON):
                        "top_k"?, "top_p"?, "seed"?, "eos_id"?, "model"?,
                        "timeout_ms"?}`
 
+When the server is a fleet member (`server.fleet_replica` set by
+`serving/fleet.py`), two admin routes appear and every predict/generate
+passes through the replica's admission seam first — deterministic fleet
+faults fire there and a draining replica refuses there with a clean 503:
+
+- `POST /admin/drain`   start a graceful drain (returns immediately)
+- `POST /admin/reload`  `{"path": ...}` drained rolling update: swap the
+                        checkpoint, AOT-warm it, re-join the fleet; the
+                        response carries the compile/warm ledger
+
 Failure mapping is a table over the typed errors in `serving/errors.py`:
 the status comes off the exception class, `Retry-After` appears whenever
 the error carries one (load shedding, warming, eviction reload), plain
@@ -118,9 +128,26 @@ def make_handler(server):
                 return self._post_predict()
             if self.path == "/generate":
                 return self._post_generate()
+            replica = getattr(server, "fleet_replica", None)
+            if replica is not None and self.path == "/admin/drain":
+                return self._post_drain(replica)
+            if replica is not None and self.path == "/admin/reload":
+                return self._post_reload(replica)
             return self._json({"error": "not found"}, 404)
 
+        def _admit(self, route: str):
+            """Fleet admission seam: fleet faults fire here and a
+            draining replica 503s here, BEFORE the request touches the
+            batcher. Returns the replica when the caller owes a
+            `request_done()`, None for a non-fleet server."""
+            replica = getattr(server, "fleet_replica", None)
+            if replica is None:
+                return None
+            replica.on_request(route)
+            return replica
+
         def _post_predict(self):
+            admitted = None
             try:
                 payload = self._payload()
                 name = payload.get("model")
@@ -128,13 +155,18 @@ def make_handler(server):
                 if warming is not None:
                     return self._json(warming, 503,
                                       headers={"Retry-After": "1"})
+                admitted = self._admit("predict")
                 preds = server.predict(payload["data"], model=name,
                                        timeout_s=self._timeout_s(payload))
             except Exception as e:
                 return self._error(e)
+            finally:
+                if admitted is not None:
+                    admitted.request_done()
             self._json({"predictions": preds.tolist()})
 
         def _post_generate(self):
+            admitted = None
             try:
                 payload = self._payload()
                 name = payload.get("model")
@@ -145,6 +177,7 @@ def make_handler(server):
                 sampling = {k: payload[k] for k in
                             ("temperature", "top_k", "top_p", "seed",
                              "eos_id") if k in payload}
+                admitted = self._admit("generate")
                 ids = server.generate(payload["prompt_ids"],
                                       int(payload["n_steps"]),
                                       model=name,
@@ -152,6 +185,27 @@ def make_handler(server):
                                       **sampling)
             except Exception as e:
                 return self._error(e)
+            finally:
+                if admitted is not None:
+                    admitted.request_done()
             self._json({"ids": [int(t) for t in ids]})
+
+        # ----------------------------------------------------------- admin
+
+        def _post_drain(self, replica):
+            import threading
+
+            threading.Thread(target=replica.drain,
+                             name="dl4j-admin-drain", daemon=True).start()
+            self._json({"status": "draining", "inflight": replica.inflight()})
+
+        def _post_reload(self, replica):
+            try:
+                payload = self._payload()
+                summary = replica.reload(payload["path"],
+                                         warm=bool(payload.get("warm", True)))
+            except Exception as e:
+                return self._error(e)
+            self._json(summary)
 
     return Handler

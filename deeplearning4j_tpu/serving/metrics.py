@@ -82,3 +82,27 @@ DECODE_SLOTS_BUSY = _obs.metrics.gauge(
     "dl4j_serving_decode_slots_busy",
     "Generation scheduler slots currently holding an active sequence",
     label_names=("model",))
+
+# ------------------------------------------------------------------ fleet
+# Router/fleet SLO families: same one-scrape registry, so a single
+# `GET /metrics` on the router shows fleet membership, request outcomes
+# and failover latency next to the per-replica serving families.
+FLEET_REPLICAS = _obs.metrics.gauge(
+    "dl4j_fleet_replicas",
+    "Serving replicas known to the router by state (live = routable, "
+    "warming = joined but pre-warming, draining = finishing in-flight, "
+    "dead = lease-expired and evicted since router start)",
+    label_names=("state",))
+ROUTER_REQUESTS = _obs.metrics.counter(
+    "dl4j_router_requests_total",
+    "Fleet-router requests by outcome: ok (first replica answered), "
+    "failover (answered after rerouting off a failed replica), shed "
+    "(503 + Retry-After — every live replica saturated or none live), "
+    "failed (deadline/retry budget exhausted — counted separately from "
+    "shed by design)",
+    label_names=("outcome",))
+ROUTER_FAILOVER_SECONDS = _obs.metrics.histogram(
+    "dl4j_router_failover_seconds",
+    "First failure on the original replica -> success on another "
+    "(detection + reroute + answer)",
+    buckets=_obs.WIDE_BUCKETS)

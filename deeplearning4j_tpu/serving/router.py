@@ -1,0 +1,609 @@
+"""Fleet front-end: failure-aware routing over N serving replicas.
+
+The `FleetRouter` owns no models and runs no compute — it owns the
+*routing table* and the *failure policy*:
+
+- **membership is the coordinator's** (`parallel/coordinator.py`): a poll
+  thread reads `status` (members + per-member role + lease age) at
+  sub-lease cadence, so replica health is the SAME heartbeat lease that
+  detects a lost trainer. Role strings are the lifecycle
+  (``replica`` routable / ``replica:warming`` / ``replica:draining``);
+  a live replica that vanishes from the table was lease-reaped — counted
+  dead, its traffic rerouted.
+- **load is the replicas' own SLO gauges**: each poll scrapes every live
+  replica's `/metrics` (explicit timeout — JX012) and sums
+  `dl4j_serving_model_queue_depth` + `dl4j_serving_decode_slots_busy`
+  into one score; `_pick` takes the least-loaded live replica, with the
+  router's own outstanding-request count added so traffic doesn't dogpile
+  between scrapes.
+- **failover runs under the request's deadline**: each request is a
+  `util/retry.Backoff` envelope with ``max_elapsed_s`` = the caller's
+  budget; a failed attempt excludes that replica and retries the next
+  pick. Retry is classified, never blind: replica 503s (shed / draining
+  / warming) were **never admitted** and always retry; connection-refused
+  never reached the socket and always retries; but a request that FAILED
+  AFTER ADMISSION (timeout / reset / 5xx) retries only when idempotent —
+  a partial generation is surfaced as `PartialFailureError`, not silently
+  re-sampled. 4xx pass through verbatim (client bugs don't failover).
+- **saturation is shed, not queued**: no pickable replica means an
+  immediate `ServerOverloadedError` (503 + Retry-After at the HTTP
+  front), counted ``shed`` — deliberately distinct from ``failed``
+  (budget exhausted by real failures) in
+  `dl4j_router_requests_total{outcome}`.
+
+A failed replica is also locally quarantined for a few seconds so a hung
+process (heartbeats alive, service dead — lease expiry will NOT evict
+it) stops receiving fresh traffic after its first timeout.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from collections import deque
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Set
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+from deeplearning4j_tpu import observability as _obs
+from deeplearning4j_tpu.observability import fleet as _fev
+from deeplearning4j_tpu.parallel.coordinator import CoordinatorClient
+from deeplearning4j_tpu.serving import metrics as _m
+from deeplearning4j_tpu.serving.errors import (
+    ServerOverloadedError,
+    ServingError,
+)
+from deeplearning4j_tpu.util.retry import Backoff, RetryError
+
+ROLE_LIVE = "replica"
+ROLE_WARMING = "replica:warming"
+ROLE_DRAINING = "replica:draining"
+
+_STATE_BY_ROLE = {ROLE_LIVE: "live", ROLE_WARMING: "warming",
+                  ROLE_DRAINING: "draining"}
+
+
+class PartialFailureError(ServingError):
+    """A non-idempotent request (generation samples tokens) failed AFTER
+    the replica admitted it. The router refuses to blind-retry — the
+    caller decides whether re-sampling is acceptable."""
+
+    status = 502
+
+
+class UpstreamError(ServingError):
+    """A replica answered with a non-retryable client error (4xx); the
+    router propagates status + body verbatim instead of failing over —
+    a malformed payload fails identically on every replica."""
+
+    def __init__(self, status: int, body: dict):
+        super().__init__(body.get("error", f"upstream {status}"))
+        self.status = int(status)
+        self.body = dict(body)
+
+    def payload(self) -> dict:
+        return self.body
+
+
+class _Failover(Exception):
+    """Internal: this attempt failed in a way that is safe to retry on a
+    different replica (inside the deadline budget)."""
+
+
+# ------------------------------------------------------------- http utils
+
+
+def post_json(url: str, payload: dict, timeout_s: float) -> dict:
+    """POST JSON -> parsed JSON body, with an EXPLICIT socket timeout on
+    every call (JX012: an unbounded request path turns one hung replica
+    into a hung fleet)."""
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode("utf-8"),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def get_text(url: str, timeout_s: float) -> str:
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return resp.read().decode("utf-8")
+
+
+def _error_body(e: urllib.error.HTTPError) -> dict:
+    try:
+        return json.loads(e.read().decode("utf-8"))
+    except Exception:
+        return {"error": f"HTTP {e.code}"}
+
+
+def _unwrap(e: BaseException) -> BaseException:
+    """urllib wraps connect-phase failures in URLError(reason=...); the
+    classification below needs the underlying OSError/timeout."""
+    if isinstance(e, urllib.error.URLError) \
+            and not isinstance(e, urllib.error.HTTPError) \
+            and isinstance(e.reason, BaseException):
+        return e.reason
+    return e
+
+
+def sum_metric_families(text: str, names) -> float:
+    """Sum every sample of the named families out of a Prometheus text
+    exposition (labels ignored — the router wants one load score)."""
+    total = 0.0
+    names = tuple(names)
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        metric, _, value = line.rpartition(" ")
+        family = metric.split("{", 1)[0]
+        if family in names:
+            try:
+                total += float(value)
+            except ValueError:
+                pass
+    return total
+
+
+# ----------------------------------------------------------------- router
+
+
+@dataclass
+class ReplicaInfo:
+    """One routing-table row (router-local view of one replica)."""
+
+    worker_id: str
+    name: str
+    url: str
+    state: str            # live | warming | draining
+    lease_age_s: float
+    seen_at: float        # monotonic time of the poll that produced this
+    load: float = 0.0     # scraped queue depth + busy decode slots
+    inflight: int = 0     # router-local outstanding requests to it
+    scrape_ok: bool = True
+
+    def row(self) -> Dict[str, Any]:
+        return {"worker_id": self.worker_id, "name": self.name,
+                "url": self.url, "state": self.state,
+                "lease_age_s": self.lease_age_s, "load": self.load,
+                "inflight": self.inflight, "scrape_ok": self.scrape_ok}
+
+
+class FleetRouter:
+    """Least-loaded routing + deadline-budgeted failover over the fleet.
+
+    In-process API (`predict` / `generate`) plus an optional HTTP front
+    mirroring the replica surface (`/predict`, `/generate`, `/metrics`,
+    `/health`, `/fleet`) so external clients talk to ONE address while
+    replicas come, go, die and roll underneath.
+    """
+
+    def __init__(self, coordinator_address: str, *,
+                 poll_interval_s: float = 0.25,
+                 scrape_timeout_s: float = 1.0,
+                 request_timeout_s: float = 30.0,
+                 attempt_timeout_s: Optional[float] = None,
+                 failover_tries: int = 4,
+                 quarantine_s: float = 2.0,
+                 stale_lease_fraction: float = 0.75,
+                 host: str = "127.0.0.1", port: int = 0,
+                 http: bool = True):
+        self.coordinator_address = str(coordinator_address)
+        self.poll_interval_s = float(poll_interval_s)
+        self.scrape_timeout_s = float(scrape_timeout_s)
+        self.request_timeout_s = float(request_timeout_s)
+        # Per-attempt cap < deadline is what makes a HUNG replica (lease
+        # alive, service dead) cost one bounded attempt, not the whole
+        # request budget.
+        self.attempt_timeout_s = attempt_timeout_s
+        self.failover_tries = int(failover_tries)
+        self.quarantine_s = float(quarantine_s)
+        self.stale_lease_fraction = float(stale_lease_fraction)
+        self.host = host
+        self.port = int(port)
+        self.http = bool(http)
+        self._client = CoordinatorClient(
+            self.coordinator_address, worker_id="fleet-router",
+            role="router",
+            # The poll loop already retries every poll_interval_s; per-RPC
+            # retries would only stall it (and the shed-path refresh).
+            backoff=Backoff(base_s=0.05, max_s=0.1, tries=1))
+        self._lock = threading.Lock()
+        self._table: Dict[str, ReplicaInfo] = {}
+        self._quarantine: Dict[str, float] = {}
+        self._lost_after_s = 15.0
+        self._dead_total = 0
+        self._rr = 0
+        self._counts: Dict[str, int] = {"ok": 0, "failover": 0, "shed": 0,
+                                        "failed": 0}
+        self._latencies: deque = deque(maxlen=1024)
+        self._stop = threading.Event()
+        self._poll_thread: Optional[threading.Thread] = None
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._http_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "FleetRouter":
+        try:
+            self.poll_once()
+        except Exception:
+            pass  # coordinator may still be coming up; the loop retries
+        self._stop.clear()
+        self._poll_thread = threading.Thread(
+            target=self._poll_loop, name="dl4j-router-poll", daemon=True)
+        self._poll_thread.start()
+        for state in ("live", "warming", "draining", "dead"):
+            _m.FLEET_REPLICAS.labels(state=state).set_function(
+                (lambda s: lambda: float(self._count_state(s)))(state))
+        if self.http:
+            self._httpd = ThreadingHTTPServer(
+                (self.host, self.port), _make_router_handler(self))
+            self.port = self._httpd.server_address[1]
+            self._http_thread = threading.Thread(
+                target=self._httpd.serve_forever, name="dl4j-router-http",
+                daemon=True)
+            self._http_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._poll_thread is not None:
+            self._poll_thread.join(timeout=2.0)
+            self._poll_thread = None
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        for state in ("live", "warming", "draining", "dead"):
+            _m.FLEET_REPLICAS.labels(state=state).set_function(None)
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # ---------------------------------------------------------- membership
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                self.poll_once()
+            except Exception:
+                # Coordinator unreachable: keep the last table — replicas
+                # may still be serving; the request path finds out.
+                pass
+
+    def poll_once(self) -> None:
+        """Rebuild the routing table from coordinator membership, then
+        refresh each live replica's load score from its own /metrics."""
+        doc = self._client.status()
+        detail = doc.get("detail", {})
+        now = time.monotonic()
+        rows: Dict[str, ReplicaInfo] = {}
+        for wid in doc.get("members", []):
+            role = detail.get(wid, {}).get("role", "trainer")
+            state = _STATE_BY_ROLE.get(role)
+            if state is None:
+                continue  # trainers/routers share the coordinator
+            name, _, addr = wid.partition("@")
+            if not addr:
+                continue
+            rows[wid] = ReplicaInfo(
+                worker_id=wid, name=name, url=f"http://{addr}",
+                state=state,
+                lease_age_s=float(
+                    detail.get(wid, {}).get("lease_age_s", 0.0)),
+                seen_at=now)
+        with self._lock:
+            self._lost_after_s = float(
+                doc.get("lost_after_s", self._lost_after_s))
+            for wid, old in self._table.items():
+                if wid not in rows and old.state == "live":
+                    # A voluntary `leave` removes the member while its lease
+                    # is still fresh; the reaper only evicts once the lease
+                    # runs past lost_after_s.  Use the last-observed
+                    # effective age to tell a clean goodbye from a death —
+                    # a fast drain can leave between two polls without ever
+                    # being seen in the draining role.
+                    age = old.lease_age_s + (now - old.seen_at)
+                    if age >= 0.5 * self._lost_after_s:
+                        self._dead_total += 1
+                        _fev.record_event("replica_dead", replica=old.name,
+                                          url=old.url)
+                elif wid in rows:
+                    rows[wid].inflight = old.inflight
+                    rows[wid].load = old.load
+            self._table = rows
+            live = [r for r in rows.values() if r.state == "live"]
+        for info in live:
+            try:
+                text = get_text(info.url + "/metrics",
+                                timeout_s=self.scrape_timeout_s)
+                info.load = sum_metric_families(
+                    text, ("dl4j_serving_model_queue_depth",
+                           "dl4j_serving_decode_slots_busy"))
+                info.scrape_ok = True
+            except Exception:
+                # Keep the stale score; the request path (timeout +
+                # quarantine) is the authority on a broken replica.
+                info.scrape_ok = False
+
+    def table(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [info.row() for info in self._table.values()]
+
+    def _count_state(self, state: str) -> int:
+        if state == "dead":
+            with self._lock:
+                return self._dead_total
+        with self._lock:
+            return sum(1 for r in self._table.values()
+                       if r.state == state)
+
+    def _pick(self, exclude: Set[str]) -> Optional[ReplicaInfo]:
+        """Least-loaded live replica: fresh lease, not quarantined, not
+        already tried by this request. None -> the fleet has no capacity
+        for this request (shed)."""
+        now = time.monotonic()
+        with self._lock:
+            stale_cut = self.stale_lease_fraction * self._lost_after_s
+            candidates = [
+                r for r in self._table.values()
+                if r.state == "live" and r.worker_id not in exclude
+                and self._quarantine.get(r.worker_id, 0.0) <= now
+                and (r.lease_age_s + (now - r.seen_at)) <= stale_cut
+            ]
+            if not candidates:
+                return None
+            best = min(r.load + r.inflight for r in candidates)
+            tied = sorted((r for r in candidates
+                           if r.load + r.inflight == best),
+                          key=lambda r: r.name)
+            # Round-robin among equally-idle replicas: a sequential client
+            # (inflight always 0 at pick time) must not pin one replica.
+            self._rr += 1
+            return tied[self._rr % len(tied)]
+
+    def _quarantine_replica(self, info: ReplicaInfo) -> None:
+        with self._lock:
+            self._quarantine[info.worker_id] = (time.monotonic()
+                                                + self.quarantine_s)
+
+    # ------------------------------------------------------------- requests
+
+    def predict(self, data, model: Optional[str] = None,
+                timeout_s: Optional[float] = None) -> np.ndarray:
+        payload: Dict[str, Any] = {"data": np.asarray(data).tolist()}
+        if model is not None:
+            payload["model"] = model
+        out = self._request("predict", payload, timeout_s, idempotent=True)
+        return np.asarray(out["predictions"])
+
+    def generate(self, prompt_ids, n_steps: int,
+                 model: Optional[str] = None,
+                 timeout_s: Optional[float] = None,
+                 **sampling) -> List[int]:
+        payload: Dict[str, Any] = {
+            "prompt_ids": [int(t) for t in prompt_ids],
+            "n_steps": int(n_steps)}
+        payload.update(sampling)
+        if model is not None:
+            payload["model"] = model
+        out = self._request("generate", payload, timeout_s,
+                            idempotent=False)
+        return [int(t) for t in out["ids"]]
+
+    def _request(self, route: str, payload: dict,
+                 timeout_s: Optional[float], idempotent: bool) -> dict:
+        budget = (self.request_timeout_s if timeout_s is None
+                  else float(timeout_s))
+        t0 = time.monotonic()
+        deadline = t0 + budget
+        tried_failed: Set[str] = set()
+        tried_saturated: Set[str] = set()
+        first_fail: List[Optional[float]] = [None]
+
+        def note_failure(info: ReplicaInfo) -> None:
+            tried_failed.add(info.worker_id)
+            self._quarantine_replica(info)
+            if first_fail[0] is None:
+                first_fail[0] = time.monotonic()
+
+        def once() -> dict:
+            rep = self._pick(exclude=tried_failed | tried_saturated)
+            if rep is None and time.monotonic() < deadline:
+                # The table may be one poll interval stale (a replica that
+                # just rejoined after a drain or reload is not visible
+                # yet).  Refresh membership once before shedding.
+                try:
+                    self.poll_once()
+                except Exception:
+                    pass
+                rep = self._pick(exclude=tried_failed | tried_saturated)
+            if rep is None:
+                raise ServerOverloadedError(
+                    f"fleet saturated: no live replica can take this "
+                    f"{route} (tried {len(tried_failed | tried_saturated)})")
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise _Failover("request deadline exhausted")
+            attempt_budget = (remaining if self.attempt_timeout_s is None
+                              else min(remaining, self.attempt_timeout_s))
+            with self._lock:
+                rep.inflight += 1
+            try:
+                return post_json(rep.url + "/" + route, payload,
+                                 timeout_s=attempt_budget)
+            except urllib.error.HTTPError as e:
+                body = _error_body(e)
+                if e.code == 503:
+                    # Never admitted (shedding / draining / warming):
+                    # always safe to try another replica.
+                    tried_saturated.add(rep.worker_id)
+                    raise _Failover(f"{rep.name}: 503 {body.get('error')}")
+                if 400 <= e.code < 500:
+                    raise UpstreamError(e.code, body)
+                note_failure(rep)
+                if idempotent:
+                    raise _Failover(f"{rep.name}: HTTP {e.code}")
+                raise PartialFailureError(
+                    f"{route} failed on {rep.name} after admission "
+                    f"(HTTP {e.code}); not retried: non-idempotent")
+            except (OSError, TimeoutError) as e:
+                cause = _unwrap(e)
+                refused = isinstance(cause, ConnectionRefusedError)
+                note_failure(rep)
+                if idempotent or refused:
+                    # Refused = the request never left the router; safe
+                    # even for generation.
+                    raise _Failover(
+                        f"{rep.name}: {type(cause).__name__}: {cause}")
+                raise PartialFailureError(
+                    f"{route} on {rep.name} died after admission "
+                    f"({type(cause).__name__}); a partial generation is "
+                    f"never blind-retried")
+            finally:
+                with self._lock:
+                    rep.inflight = max(0, rep.inflight - 1)
+
+        bo = Backoff(base_s=0.02, max_s=0.25,
+                     tries=max(2, self.failover_tries),
+                     max_elapsed_s=budget)
+        try:
+            out = bo.run(once, retry_on=(_Failover,),
+                         describe=f"router {route}")
+        except ServerOverloadedError:
+            self._count("shed")
+            _fev.record_event("shed", route=route)
+            raise
+        except (PartialFailureError, UpstreamError, RetryError):
+            self._count("failed")
+            raise
+        now = time.monotonic()
+        if first_fail[0] is not None:
+            seconds = now - first_fail[0]
+            _m.ROUTER_FAILOVER_SECONDS.observe(seconds)
+            _fev.record_event("failover", route=route,
+                              seconds=round(seconds, 4))
+            self._count("failover")
+        else:
+            self._count("ok")
+        with self._lock:
+            self._latencies.append(now - t0)
+        return out
+
+    def _count(self, outcome: str) -> None:
+        _m.ROUTER_REQUESTS.labels(outcome=outcome).inc()
+        with self._lock:
+            self._counts[outcome] = self._counts.get(outcome, 0) + 1
+
+    # ------------------------------------------------------------------ slo
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def load_stats(self) -> Dict[str, Any]:
+        """The autoscaler's input: live capacity, aggregate load, request
+        p99 over the recent window, and outcome counters."""
+        with self._lock:
+            live = [r for r in self._table.values() if r.state == "live"]
+            total_load = sum(r.load + r.inflight for r in live)
+            lat = sorted(self._latencies)
+            counts = dict(self._counts)
+            dead = self._dead_total
+        p99 = lat[int(0.99 * (len(lat) - 1))] if lat else None
+        return {"live": len(live), "dead": dead,
+                "total_load": total_load, "p99_s": p99, "counts": counts}
+
+
+# ------------------------------------------------------------- http front
+
+
+def _make_router_handler(router: FleetRouter):
+    """The router's own HTTP surface — the same request/metrics routes a
+    replica exposes, so clients can't tell they moved behind a fleet."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):
+            pass
+
+        def _json(self, obj, code=200, headers=None):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _error(self, e: Exception):
+            if isinstance(e, ServingError):
+                headers = ({"Retry-After": str(e.retry_after)}
+                           if e.retry_after is not None else None)
+                return self._json(e.payload(), e.status, headers=headers)
+            if isinstance(e, RetryError):
+                return self._json(
+                    {"error": str(e), "attempts": e.attempts,
+                     "elapsed_s": round(e.elapsed, 4)}, 502)
+            if isinstance(e, (KeyError, ValueError, json.JSONDecodeError)):
+                return self._json({"error": f"bad request: {e}"}, 400)
+            return self._json({"error": str(e)}, 500)
+
+        def do_GET(self):
+            url = urlparse(self.path)
+            if url.path == "/health":
+                stats = router.load_stats()
+                self._json({"status": "ok", "live": stats["live"]})
+            elif url.path == "/fleet":
+                self._json({"replicas": router.table(),
+                            "stats": router.load_stats()})
+            elif url.path == "/metrics":
+                q = parse_qs(url.query)
+                fmt = (q.get("format") or ["prometheus"])[0]
+                body, ctype = _obs.prometheus_payload(fmt)
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self._json({"error": "not found",
+                            "routes": ["/health", "/fleet", "/metrics",
+                                       "/predict", "/generate"]}, 404)
+
+        def _payload(self) -> dict:
+            length = int(self.headers.get("Content-Length", 0))
+            return json.loads(self.rfile.read(length))
+
+        def do_POST(self):
+            if self.path not in ("/predict", "/generate"):
+                return self._json({"error": "not found"}, 404)
+            try:
+                payload = self._payload()
+                ms = payload.pop("timeout_ms", None)
+                timeout_s = None if ms is None else float(ms) / 1000.0
+                if self.path == "/predict":
+                    preds = router.predict(payload["data"],
+                                           model=payload.get("model"),
+                                           timeout_s=timeout_s)
+                    return self._json({"predictions": preds.tolist()})
+                sampling = {k: payload[k] for k in
+                            ("temperature", "top_k", "top_p", "seed",
+                             "eos_id") if k in payload}
+                ids = router.generate(payload["prompt_ids"],
+                                      int(payload["n_steps"]),
+                                      model=payload.get("model"),
+                                      timeout_s=timeout_s, **sampling)
+                return self._json({"ids": ids})
+            except Exception as e:
+                return self._error(e)
+
+    return Handler

@@ -121,12 +121,16 @@ class GenerationScheduler:
         return self
 
     def stop(self) -> None:
-        if self._thread is not None:
+        t = self._thread
+        if t is not None:
+            self._thread = None
             try:
                 self._queue.put_nowait(None)
             except queue.Full:
                 pass
-            self._thread = None
+            # Bounded join: see ShapeBucketBatcher.stop — a worker left
+            # mid-dispatch at interpreter shutdown dies inside native code.
+            t.join(timeout=10.0)
 
     def qsize(self) -> int:
         return self._queue.qsize()

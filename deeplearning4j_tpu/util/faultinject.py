@@ -37,6 +37,23 @@ Kinds (each fires at the TOP of its step, before the local fit):
                          (models a slow host->device link; exercises
                          step-barrier timeout margins).
 
+Fleet kinds (serving chaos; the injection point is the replica's request
+admission — ``step`` is the replica-local REQUEST NUMBER and ``worker``
+is the replica index, so "replica 1 dies on its 50th request" is exact
+and reproducible):
+
+- ``kill_replica``     — ``os._exit(137)`` mid-request: hard replica
+                         loss; the router must detect via lease expiry
+                         and fail the in-flight request over.
+- ``hang_replica``     — the replica stops answering for ``seconds``
+                         (in-flight requests stall, heartbeats continue
+                         or stop per ``stop_heartbeats``): exercises the
+                         router's per-request timeout + failover, not
+                         just eviction.
+- ``slow_decode``      — every subsequent request on the replica gains
+                         ``ms`` of latency (models decode slowdown; the
+                         least-loaded policy should shift traffic away).
+
 ``worker`` omitted means "fires on every worker". Each fault fires at
 most once per process (fire-once), so a restarted worker replaying steps
 after recovery does not re-inject its fault — recovery runs are clean by
@@ -59,7 +76,7 @@ from typing import Any, Callable, Dict, List, Optional
 ENV_KNOB = "DL4J_TPU_FAULT_PLAN"
 
 KINDS = ("kill", "preempt", "hang_coordinator", "truncate_chunk",
-         "delay_h2d")
+         "delay_h2d", "kill_replica", "hang_replica", "slow_decode")
 
 
 @dataclass
@@ -142,13 +159,15 @@ class FaultPlan:
             handler = (handlers or {}).get(fault.kind)
             if handler is not None:
                 handler(fault)
-            elif fault.kind == "kill":
+            elif fault.kind in ("kill", "kill_replica"):
                 # Hard loss: no atexit, no flushes — mirrors a yanked host.
                 os._exit(137)
             elif fault.kind == "preempt":
                 os.kill(os.getpid(), signal.SIGTERM)
-            elif fault.kind == "delay_h2d":
+            elif fault.kind in ("delay_h2d", "slow_decode"):
                 time.sleep(float(fault.args.get("ms", 100)) / 1000.0)
+            elif fault.kind == "hang_replica":
+                time.sleep(float(fault.args.get("seconds", 1.0)))
             # hang_coordinator / truncate_chunk without a handler: recorded
             # as fired, no action (the injection point lacks the object).
         return fired

@@ -56,11 +56,16 @@ def _env_int(name: str, default: int) -> int:
 
 
 class RetryError(Exception):
-    """All attempts exhausted. ``last`` carries the final cause."""
+    """All attempts exhausted. ``last`` carries the final cause;
+    ``elapsed`` / ``attempts`` carry the spent budget so a caller holding
+    a request deadline can report exactly what the envelope cost."""
 
-    def __init__(self, message: str, last: Optional[BaseException] = None):
+    def __init__(self, message: str, last: Optional[BaseException] = None,
+                 elapsed: float = 0.0, attempts: int = 0):
         super().__init__(message)
         self.last = last
+        self.elapsed = float(elapsed)
+        self.attempts = int(attempts)
 
 
 @dataclass
@@ -70,9 +75,13 @@ class Backoff:
     stampedes better than equal-jitter for thundering-herd joins).
 
     ``tries`` counts ATTEMPTS, not sleeps: tries=5 means 5 calls with 4
-    sleeps between them. ``deadline_s`` (optional) bounds total elapsed
-    time regardless of remaining tries — the elastic join path uses a
-    deadline so "coordinator is gone" is detected in bounded time.
+    sleeps between them. ``max_elapsed_s`` (optional) is the total
+    elapsed-time budget: no sleep is taken that would push the envelope
+    past it, so a caller holding a request deadline never overshoots by
+    a backoff step — the router's failover path and the elastic join both
+    hand their caller's deadline straight in. ``deadline_s`` is the older
+    spelling of the same budget (kept for callers that already pass it);
+    when both are set the tighter one wins.
     """
 
     base_s: float = field(
@@ -82,6 +91,7 @@ class Backoff:
     tries: int = field(
         default_factory=lambda: _env_int("DL4J_TPU_RETRY_TRIES", 5))
     deadline_s: Optional[float] = None
+    max_elapsed_s: Optional[float] = None
     jitter: bool = True
     # Injectable for deterministic tests (fault harness pins these).
     _sleep: Callable[[float], None] = field(default=time.sleep, repr=False)
@@ -92,19 +102,30 @@ class Backoff:
         cap = min(self.max_s, self.base_s * (2.0 ** attempt))
         return cap * self._rand() if self.jitter else cap
 
+    def _budget(self) -> Optional[float]:
+        if self.deadline_s is None:
+            return self.max_elapsed_s
+        if self.max_elapsed_s is None:
+            return self.deadline_s
+        return min(self.deadline_s, self.max_elapsed_s)
+
     def run(self, fn: Callable[[], T], *,
             retry_on: Tuple[Type[BaseException], ...] = (Exception,),
             on_retry: Optional[Callable[[int, BaseException], None]] = None,
             describe: str = "operation") -> T:
         """Call ``fn`` until it returns, a non-retryable exception escapes,
-        or the budget (tries and/or deadline) runs out -> `RetryError`.
+        or the budget (tries and/or elapsed-time) runs out -> `RetryError`
+        carrying ``elapsed`` and ``attempts``.
 
         ``on_retry(attempt, exc)`` fires before each sleep — the elastic
         client uses it to bump `dl4j_elastic_events_total` and log.
         """
         start = time.monotonic()
+        budget = self._budget()
         last: Optional[BaseException] = None
+        attempts = 0
         for attempt in range(max(1, self.tries)):
+            attempts = attempt + 1
             try:
                 return fn()
             except retry_on as exc:  # noqa: PERF203 - retry loop
@@ -112,15 +133,17 @@ class Backoff:
                 if attempt + 1 >= max(1, self.tries):
                     break
                 pause = self.sleep_for(attempt)
-                if (self.deadline_s is not None
-                        and time.monotonic() - start + pause > self.deadline_s):
+                if (budget is not None
+                        and time.monotonic() - start + pause > budget):
                     break
                 if on_retry is not None:
                     on_retry(attempt, exc)
                 self._sleep(pause)
+        elapsed = time.monotonic() - start
         raise RetryError(
-            f"{describe} failed after {max(1, self.tries)} attempts "
-            f"({time.monotonic() - start:.1f}s): {last!r}", last)
+            f"{describe} failed after {attempts} attempts "
+            f"({elapsed:.1f}s): {last!r}", last,
+            elapsed=elapsed, attempts=attempts)
 
 
 def with_retries(fn: Callable[[], T], *,
@@ -128,6 +151,7 @@ def with_retries(fn: Callable[[], T], *,
                  base_s: Optional[float] = None,
                  max_s: Optional[float] = None,
                  deadline_s: Optional[float] = None,
+                 max_elapsed_s: Optional[float] = None,
                  retry_on: Tuple[Type[BaseException], ...] = (Exception,),
                  on_retry: Optional[Callable[[int, BaseException], None]] = None,
                  describe: str = "operation") -> T:
@@ -143,4 +167,5 @@ def with_retries(fn: Callable[[], T], *,
     if max_s is not None:
         bo.max_s = max_s
     bo.deadline_s = deadline_s
+    bo.max_elapsed_s = max_elapsed_s
     return bo.run(fn, retry_on=retry_on, on_retry=on_retry, describe=describe)

@@ -1,0 +1,791 @@
+"""Fault-tolerant serving fleet (`serving/fleet.py` + `serving/router.py`).
+
+Acceptance coverage for the fleet PR:
+
+- `util/retry.Backoff` honors a total elapsed-time budget
+  (`max_elapsed_s`) and `RetryError` reports what the envelope cost;
+- the coordinator's `status` op exposes per-member role + lease age, the
+  client parses it, and the CLI renders it;
+- `ModelHost._reload` holds the host lock only around bookkeeping: while
+  one model loads, snapshots and OTHER models proceed, and the reloading
+  model 503s instead of queueing callers behind the load;
+- the router picks the least-loaded live replica, fails over under the
+  request's deadline budget with classified retries (503/refused always,
+  after-admission only when idempotent — a partial generation is never
+  blind-retried), sheds with a 503 counted distinctly from failures;
+- a 3-replica fleet under a deterministic fault plan (one replica
+  SIGKILLed mid-request, one hung mid-decode) sustains >= 99%
+  availability with sub-second failover;
+- a rolling model update drains each replica, AOT-warms the new
+  checkpoint while drained, and re-admits it with ZERO client-visible
+  errors and ZERO serving-path compiles after rejoin;
+- SIGTERM is a graceful drain: exit code 0, clean leave, never counted
+  dead;
+- the fleet SLO families all land in ONE `/metrics` scrape.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (MultiLayerNetwork, NeuralNetConfiguration,
+                                observability as obs)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.parallel.coordinator import (
+    Coordinator,
+    CoordinatorClient,
+)
+from deeplearning4j_tpu.serving import (
+    Autoscaler,
+    FleetManager,
+    FleetRouter,
+    ModelNotReadyError,
+    ReplicaDrainingError,
+    ReplicaServer,
+    ServerOverloadedError,
+)
+from deeplearning4j_tpu.serving.host import ModelHost
+from deeplearning4j_tpu.serving.router import (
+    PartialFailureError,
+    ReplicaInfo,
+    UpstreamError,
+    sum_metric_families,
+)
+from deeplearning4j_tpu.util.faultinject import FaultPlan
+from deeplearning4j_tpu.util.retry import Backoff, RetryError, with_retries
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def mlp_net(seed=1, n_in=3, n_out=2):
+    return MultiLayerNetwork(
+        (NeuralNetConfiguration.builder()
+         .seed(seed).learning_rate(0.1).weight_init("xavier")
+         .list()
+         .layer(DenseLayer(n_out=4, activation="tanh"))
+         .layer(OutputLayer(n_out=n_out, activation="softmax",
+                            loss_function="mcxent"))
+         .set_input_type(InputType.feed_forward(n_in))
+         .build())).init()
+
+
+def _save(net, path):
+    from deeplearning4j_tpu.checkpoint.manager import CheckpointManager
+
+    CheckpointManager(str(path), async_save=False).save(net)
+    return str(path)
+
+
+def _sub_env(plan=None):
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    if plan is not None:
+        env["DL4J_TPU_FAULT_PLAN"] = json.dumps(plan)
+    return env
+
+
+def _wait(predicate, timeout_s, every_s=0.1, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(every_s)
+    raise AssertionError(f"timed out after {timeout_s}s waiting for {what}")
+
+
+# ---------------------------------------------------- satellite 1: retry
+
+
+class TestBackoffElapsedBudget:
+    def test_budget_stops_before_an_overshooting_sleep(self):
+        # base 5s sleep would blow a 0.2s budget: the envelope must give
+        # up BEFORE sleeping, not after.
+        calls = []
+
+        def fail():
+            calls.append(1)
+            raise OSError("down")
+
+        bo = Backoff(base_s=5.0, max_s=5.0, tries=10, jitter=False,
+                     max_elapsed_s=0.2)
+        t0 = time.monotonic()
+        with pytest.raises(RetryError) as ei:
+            bo.run(fail, retry_on=(OSError,), describe="join")
+        assert time.monotonic() - t0 < 1.0
+        assert len(calls) == 1
+        assert ei.value.attempts == 1
+        assert ei.value.elapsed < 1.0
+        assert isinstance(ei.value.last, OSError)
+
+    def test_budget_allows_retries_that_fit(self):
+        calls = []
+
+        def fail():
+            calls.append(1)
+            raise OSError("down")
+
+        bo = Backoff(base_s=0.01, max_s=0.01, tries=3, jitter=False,
+                     max_elapsed_s=5.0)
+        with pytest.raises(RetryError) as ei:
+            bo.run(fail, retry_on=(OSError,))
+        assert len(calls) == 3
+        assert ei.value.attempts == 3
+
+    def test_tighter_of_deadline_and_max_elapsed_wins(self):
+        assert Backoff(deadline_s=5.0, max_elapsed_s=0.1)._budget() == 0.1
+        assert Backoff(deadline_s=0.1, max_elapsed_s=5.0)._budget() == 0.1
+        assert Backoff(max_elapsed_s=2.0)._budget() == 2.0
+        assert Backoff()._budget() is None
+
+    def test_with_retries_forwards_max_elapsed(self):
+        t0 = time.monotonic()
+        with pytest.raises(RetryError):
+            with_retries(lambda: (_ for _ in ()).throw(OSError("x")),
+                         tries=50, base_s=1.0, max_elapsed_s=0.05,
+                         retry_on=(OSError,))
+        assert time.monotonic() - t0 < 1.0
+
+
+# --------------------------------------- satellite 2: coordinator status
+
+
+class TestCoordinatorStatusDetail:
+    def test_status_carries_role_and_lease_age(self):
+        coord = Coordinator(lost_after_s=30.0).start()
+        addr = coord.address
+        try:
+            rep = CoordinatorClient(addr, "r0@127.0.0.1:9999",
+                                    role="replica:warming")
+            rep.join(role="replica:warming")
+            trainer = CoordinatorClient(addr, "t0")
+            trainer.join()
+            doc = trainer.status()
+            assert doc["lost_after_s"] == 30.0
+            d = doc["detail"]
+            assert d["r0@127.0.0.1:9999"]["role"] == "replica:warming"
+            assert d["t0"]["role"] == "trainer"
+            for row in d.values():
+                assert 0.0 <= row["lease_age_s"] < 30.0
+            # Re-join with a new role updates in place (the replica
+            # lifecycle: warming -> routable -> draining).
+            rep.join(role="replica")
+            assert trainer.status()["detail"][
+                "r0@127.0.0.1:9999"]["role"] == "replica"
+        finally:
+            coord.close()
+
+    def test_cli_renders_membership(self, capsys):
+        from deeplearning4j_tpu.parallel import coordinator as coordmod
+
+        coord = Coordinator(lost_after_s=15.0).start()
+        addr = coord.address
+        try:
+            c = CoordinatorClient(addr, "rep@127.0.0.1:1234", role="replica")
+            c.join(role="replica")
+            rc = coordmod.main([addr, "--timeout-s", "2.0"])
+        finally:
+            coord.close()
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "rep@127.0.0.1:1234" in out
+        assert "role=replica" in out
+        assert "lease_age=" in out
+
+
+# ------------------------------------- satellite 3: narrow reload locking
+
+
+class TestHostReloadNarrowLock:
+    def test_slow_reload_blocks_only_its_own_model(self, tmp_path,
+                                                   monkeypatch):
+        from deeplearning4j_tpu.checkpoint import legacy as _legacy
+
+        pa = _save(mlp_net(seed=1), tmp_path / "a")
+        pb = _save(mlp_net(seed=2), tmp_path / "b")
+        # The server's on_load attaches the batcher then flips ready; the
+        # bare-host stand-in just flips ready.
+        host = ModelHost(on_load=lambda m: m.ready.set())
+        host.add("a", path=pa)
+        host.add("b", path=pb)
+        host.get("a")
+        host.get("b")  # both resident
+        with host._lock:
+            host._evict(host._models["a"])
+
+        started, release = threading.Event(), threading.Event()
+        real_load = _legacy.load_any
+
+        def slow_load(path, *a, **kw):
+            started.set()
+            assert release.wait(10.0)
+            return real_load(path, *a, **kw)
+
+        monkeypatch.setattr(_legacy, "load_any", slow_load)
+        errors = []
+
+        def reload_a():
+            try:
+                host.get("a")
+            except Exception as e:  # pragma: no cover - failure detail
+                errors.append(e)
+
+        loader = threading.Thread(target=reload_a, daemon=True)
+        loader.start()
+        assert started.wait(5.0)
+        try:
+            # While the load is in flight, the host lock must be free:
+            # snapshots and the OTHER model answer immediately.
+            done = {}
+
+            def probe():
+                done["snapshot"] = {r["name"]: r["status"]
+                                    for r in host.snapshot()}
+                done["b"] = host.get("b").name
+
+            p = threading.Thread(target=probe, daemon=True)
+            p.start()
+            p.join(2.0)
+            assert not p.is_alive(), \
+                "snapshot()/get('b') blocked behind model a's reload"
+            assert done["snapshot"]["a"] == "loading"
+            assert done["b"] == "b"
+            # Concurrent callers of the SAME model get a retryable 503,
+            # not a queue position behind the load.
+            with pytest.raises(ModelNotReadyError):
+                host.get("a")
+        finally:
+            release.set()
+            loader.join(10.0)
+        assert not errors
+        assert host.get("a").resident
+        assert {r["name"]: r["status"]
+                for r in host.snapshot()}["a"] == "ready"
+
+
+# ------------------------------------------------------ router unit tests
+
+
+def _fake_replica(behavior, load=0.0):
+    """A stub replica: `behavior(path) -> (code, payload)` for POSTs,
+    /metrics exposes `load` as queue depth."""
+
+    class H(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _send(self, code, obj):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            self.rfile.read(n)
+            code, obj = behavior(self.path)
+            self._send(code, obj)
+
+        def do_GET(self):
+            text = ('dl4j_serving_model_queue_depth'
+                    '{model="default",route="predict"} %s\n' % load)
+            body = text.encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def _info(name, port, load=0.0, state="live"):
+    return ReplicaInfo(
+        worker_id=f"{name}@127.0.0.1:{port}", name=name,
+        url=f"http://127.0.0.1:{port}", state=state, lease_age_s=0.0,
+        seen_at=time.monotonic(), load=load)
+
+
+def _router_with(infos, **kw):
+    kw.setdefault("failover_tries", 4)
+    kw.setdefault("request_timeout_s", 10.0)
+    r = FleetRouter("127.0.0.1:1", http=False, **kw)
+    r._table = {i.worker_id: i for i in infos}
+    return r
+
+
+class TestRouterRouting:
+    def test_pick_least_loaded_live_only(self):
+        r = _router_with([_info("a", 1, load=5.0), _info("b", 2, load=1.0),
+                          _info("c", 3, load=0.0, state="warming"),
+                          _info("d", 4, load=0.0, state="draining")])
+        assert r._pick(exclude=set()).name == "b"
+
+    def test_pick_skips_quarantined_and_stale_leases(self):
+        a, b = _info("a", 1, load=0.0), _info("b", 2, load=9.0)
+        r = _router_with([a, b])
+        r._quarantine[a.worker_id] = time.monotonic() + 60.0
+        assert r._pick(exclude=set()).name == "b"
+        b.lease_age_s = 100.0  # most of the way past lost_after
+        assert r._pick(exclude=set()) is None
+
+    def test_equal_load_round_robins(self):
+        r = _router_with([_info("a", 1), _info("b", 2), _info("c", 3)])
+        picked = {r._pick(exclude=set()).name for _ in range(6)}
+        assert picked == {"a", "b", "c"}
+
+    def test_empty_fleet_sheds_distinctly(self):
+        r = _router_with([])
+        with pytest.raises(ServerOverloadedError):
+            r.predict([[1.0, 2.0, 3.0]])
+        assert r.counts()["shed"] == 1
+        assert r.counts()["failed"] == 0
+
+    def test_all_replicas_503_sheds(self):
+        busy = _fake_replica(lambda p: (503, {"error": "queue full"}))
+        try:
+            r = _router_with([_info("a", busy.server_address[1])])
+            with pytest.raises(ServerOverloadedError):
+                r.predict([[1.0, 2.0, 3.0]])
+            assert r.counts()["shed"] == 1
+        finally:
+            busy.shutdown()
+
+    def test_predict_fails_over_on_5xx_and_observes_latency(self):
+        bad = _fake_replica(lambda p: (500, {"error": "boom"}))
+        ok = _fake_replica(lambda p: (200, {"predictions": [[0.5, 0.5]]}))
+        fam = obs.metrics.get_family("dl4j_router_failover_seconds")
+        before = fam.children()[0].histogram_state()[3] if fam.children() \
+            else 0
+        try:
+            # bad has the lower load, so it is picked first.
+            r = _router_with([
+                _info("bad", bad.server_address[1], load=0.0),
+                _info("ok", ok.server_address[1], load=5.0)])
+            out = r.predict([[1.0, 2.0, 3.0]])
+            assert out.shape == (1, 2)
+            assert r.counts()["failover"] == 1
+            assert r.counts()["ok"] == 0
+            _, _, _, count = fam.children()[0].histogram_state()
+            assert count == before + 1
+        finally:
+            bad.shutdown()
+            ok.shutdown()
+
+    def test_generate_never_retried_after_admission(self):
+        bad = _fake_replica(lambda p: (500, {"error": "boom"}))
+        ok_calls = []
+
+        def ok_behavior(path):
+            ok_calls.append(path)
+            return 200, {"ids": [1, 2]}
+
+        ok = _fake_replica(ok_behavior)
+        try:
+            r = _router_with([
+                _info("bad", bad.server_address[1], load=0.0),
+                _info("ok", ok.server_address[1], load=5.0)])
+            with pytest.raises(PartialFailureError):
+                r.generate([1, 2], 2)
+            assert ok_calls == []  # the partial generation was NOT replayed
+            assert r.counts()["failed"] == 1
+        finally:
+            bad.shutdown()
+            ok.shutdown()
+
+    def test_generate_fails_over_on_503_and_refused(self):
+        # 503 = never admitted; refused = never reached a socket. Both are
+        # safe for non-idempotent work.
+        draining = _fake_replica(lambda p: (503, {"error": "draining"}))
+        ok = _fake_replica(lambda p: (200, {"ids": [7, 8, 9]}))
+        dead_port = _free_port()
+        try:
+            r = _router_with([
+                _info("dead", dead_port, load=0.0),
+                _info("drain", draining.server_address[1], load=1.0),
+                _info("ok", ok.server_address[1], load=5.0)])
+            assert r.generate([1], 3) == [7, 8, 9]
+            assert r.counts()["failover"] == 1
+        finally:
+            draining.shutdown()
+            ok.shutdown()
+
+    def test_4xx_passes_through_without_failover(self):
+        bad_req = _fake_replica(lambda p: (400, {"error": "bad dtype"}))
+        ok = _fake_replica(lambda p: (200, {"predictions": [[1.0]]}))
+        try:
+            r = _router_with([
+                _info("a", bad_req.server_address[1], load=0.0),
+                _info("ok", ok.server_address[1], load=5.0)])
+            with pytest.raises(UpstreamError) as ei:
+                r.predict([[1.0]])
+            assert ei.value.status == 400
+            assert ei.value.payload() == {"error": "bad dtype"}
+        finally:
+            bad_req.shutdown()
+            ok.shutdown()
+
+    def test_failover_respects_deadline_budget(self):
+        # Every replica down: the envelope must give up within the
+        # caller's budget, surfacing RetryError with the spent budget.
+        r = _router_with([_info("a", _free_port()),
+                          _info("b", _free_port())],
+                         failover_tries=50)
+        t0 = time.monotonic()
+        with pytest.raises((RetryError, ServerOverloadedError)):
+            r.predict([[1.0, 2.0, 3.0]], timeout_s=1.0)
+        assert time.monotonic() - t0 < 3.0
+
+
+# ------------------------------------------------- replica fault seam
+
+
+class TestReplicaFaultSeam:
+    def test_fleet_fault_kinds_parse(self):
+        plan = FaultPlan.from_json(json.dumps([
+            {"kind": "kill_replica", "step": 10, "worker": 0},
+            {"kind": "hang_replica", "step": 3, "worker": 1,
+             "seconds": 2.0, "stop_heartbeats": True},
+            {"kind": "slow_decode", "step": 5, "worker": 2, "ms": 50},
+        ]))
+        assert [f.kind for f in plan.faults] == [
+            "kill_replica", "hang_replica", "slow_decode"]
+        assert plan.faults[1].args["stop_heartbeats"] is True
+
+    def test_slow_decode_and_drain_refusal(self):
+        plan = FaultPlan.from_json(
+            '[{"kind": "slow_decode", "step": 1, "worker": 0, "ms": 1}]')
+        rep = ReplicaServer("127.0.0.1:1", net=mlp_net(), fault_plan=plan,
+                            handle_sigterm=False)
+        try:
+            rep.on_request("predict")  # request 0: no fault
+            rep.request_done()
+            assert rep._slow_ms == 0.0
+            rep.on_request("predict")  # request 1: fires, sticky latency
+            rep.request_done()
+            assert rep._slow_ms == 1.0
+            assert plan.faults[0].fired
+            rep._draining.set()
+            with pytest.raises(ReplicaDrainingError):
+                rep.on_request("predict")
+            assert rep.inflight() == 0
+        finally:
+            rep.server.stop()
+
+
+# ----------------------------------------------------------- autoscaler
+
+
+class _StubRouter:
+    def __init__(self):
+        self.stats = {"live": 2, "total_load": 0.0, "p99_s": None}
+
+    def load_stats(self):
+        return dict(self.stats)
+
+
+class TestAutoscaler:
+    def _scaler(self, router, **kw):
+        clock = [0.0]
+        events = []
+        kw.setdefault("min_replicas", 1)
+        kw.setdefault("max_replicas", 4)
+        kw.setdefault("queue_high", 8.0)
+        kw.setdefault("queue_low", 1.0)
+        kw.setdefault("breach_s", 5.0)
+        kw.setdefault("cooldown_s", 10.0)
+        sc = Autoscaler(router, spawn=lambda: events.append("spawn"),
+                        retire=lambda: events.append("retire"),
+                        _clock=lambda: clock[0], **kw)
+        return sc, clock, events
+
+    def test_sustained_breach_scales_up_once_then_cools_down(self):
+        router = _StubRouter()
+        router.stats.update(live=2, total_load=40.0)  # 20 per replica
+        sc, clock, events = self._scaler(router)
+        sc.evaluate()  # breach noted, not yet sustained
+        assert events == []
+        clock[0] = 6.0
+        assert sc.evaluate() == "up"
+        assert events == ["spawn"]
+        clock[0] = 12.0  # breach again but inside cooldown
+        sc.evaluate()
+        clock[0] = 14.0
+        sc.evaluate()
+        assert events == ["spawn"]
+        clock[0] = 30.0  # cooldown over, breach must re-sustain
+        sc.evaluate()
+        clock[0] = 36.0
+        assert sc.evaluate() == "up"
+        assert events == ["spawn", "spawn"]
+
+    def test_transient_spike_never_scales(self):
+        router = _StubRouter()
+        sc, clock, events = self._scaler(router)
+        router.stats.update(total_load=40.0)
+        sc.evaluate()
+        router.stats.update(total_load=0.0)  # spike gone — and idle is
+        clock[0] = 6.0                       # also not yet sustained
+        sc.evaluate()
+        assert events == []
+
+    def test_p99_slo_breach_scales_up(self):
+        router = _StubRouter()
+        router.stats.update(p99_s=2.0)
+        sc, clock, events = self._scaler(router, p99_slo_s=0.5)
+        sc.evaluate()
+        clock[0] = 6.0
+        assert sc.evaluate() == "up"
+
+    def test_sustained_idle_scales_down_to_min(self):
+        router = _StubRouter()
+        router.stats.update(live=3, total_load=0.0)
+        sc, clock, events = self._scaler(router)
+        sc.evaluate()
+        clock[0] = 6.0
+        assert sc.evaluate() == "down"
+        assert events == ["retire"]
+        # At min_replicas idle never retires.
+        router.stats.update(live=1)
+        clock[0] = 30.0
+        sc.evaluate()
+        clock[0] = 40.0
+        assert sc.evaluate() is None
+        assert events == ["retire"]
+
+
+# ----------------------------------- in-process fleet integration + SLO
+
+
+class TestFleetInProcess:
+    def test_route_drain_and_one_scrape_slo(self):
+        coord = Coordinator(lost_after_s=5.0).start()
+        addr = coord.address
+        reps, router = [], None
+        try:
+            for i, name in enumerate(("rep-a", "rep-b")):
+                reps.append(ReplicaServer(
+                    addr, name=name, net=mlp_net(seed=i + 1),
+                    replica_index=i, heartbeat_s=0.25,
+                    handle_sigterm=False).start())
+            router = FleetRouter(addr, poll_interval_s=0.1,
+                                 request_timeout_s=10.0).start()
+            _wait(lambda: sum(1 for r in router.table()
+                              if r["state"] == "live") == 2,
+                  10.0, what="2 live replicas")
+            x = [[0.1, 0.2, 0.3]]
+            out = router.predict(x)
+            assert out.shape == (1, 2)
+            # Through the router's own HTTP front too.
+            req = urllib.request.Request(
+                router.url + "/predict",
+                data=json.dumps({"data": x}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10.0) as resp:
+                assert np.asarray(
+                    json.loads(resp.read())["predictions"]).shape == (1, 2)
+            # Graceful drain via the admin route: the replica leaves
+            # cleanly (never counted dead) and traffic continues on rep-a.
+            req = urllib.request.Request(reps[1].url + "/admin/drain",
+                                         data=b"{}", method="POST")
+            with urllib.request.urlopen(req, timeout=5.0) as resp:
+                assert json.loads(resp.read())["status"] == "draining"
+            _wait(lambda: reps[1]._stopped.is_set(), 10.0,
+                  what="rep-b drained")
+            _wait(lambda: sum(1 for r in router.table()
+                              if r["state"] == "live") == 1,
+                  10.0, what="table shrinks to 1")
+            for _ in range(5):
+                assert router.predict(x).shape == (1, 2)
+            stats = router.load_stats()
+            assert stats["dead"] == 0  # drain is not death
+            assert stats["counts"]["ok"] >= 6
+            # Satellite: all three fleet SLO families in ONE scrape.
+            with urllib.request.urlopen(router.url + "/metrics",
+                                        timeout=5.0) as resp:
+                text = resp.read().decode()
+            for family in ("dl4j_fleet_replicas",
+                           "dl4j_router_requests_total",
+                           "dl4j_router_failover_seconds"):
+                assert family in text, f"{family} missing from scrape"
+            assert 'dl4j_fleet_replicas{state="live"} 1' in text
+        finally:
+            if router is not None:
+                router.stop()
+            for rep in reps:
+                if not rep._stopped.is_set():
+                    rep.drain(timeout_s=5.0)
+            coord.close()
+
+
+# ------------------------------------------------- multi-process chaos CI
+
+
+def _spawn_fleet(tmp_path, ckpt, n, plan, lost_after_s, heartbeat_s):
+    coord = Coordinator(lost_after_s=lost_after_s).start()
+    addr = coord.address
+    manager = FleetManager(addr, ckpt, heartbeat_s=heartbeat_s,
+                           env=_sub_env(plan),
+                           log_dir=str(tmp_path / "logs"))
+    for _ in range(n):
+        manager.spawn()
+    return coord, addr, manager
+
+
+class TestFleetChaos:
+    def test_three_replica_fleet_survives_kill_and_hang(self, tmp_path):
+        """Acceptance chaos drill: 3 CPU replicas; the fault plan SIGKILLs
+        replica 0 on its 10th request and hangs replica 1 for 3s on its
+        12th. Non-shed availability must stay >= 99%, every failover must
+        complete inside 1s, and the kill must surface as a lease-expiry
+        eviction (dead replica) at a 1.0s lease."""
+        ckpt = _save(mlp_net(seed=1), tmp_path / "ckpt")
+        plan = [
+            {"kind": "kill_replica", "step": 10, "worker": 0},
+            {"kind": "hang_replica", "step": 12, "worker": 1,
+             "seconds": 3.0},
+        ]
+        coord, addr, manager = _spawn_fleet(
+            tmp_path, ckpt, n=3, plan=plan, lost_after_s=1.0,
+            heartbeat_s=0.25)
+        router = FleetRouter(addr, poll_interval_s=0.1,
+                             request_timeout_s=10.0,
+                             attempt_timeout_s=0.75, quarantine_s=4.0,
+                             http=False).start()
+        try:
+            _wait(lambda: sum(1 for r in router.table()
+                              if r["state"] == "live") == 3,
+                  120.0, what="3 live replicas")
+            x = [[0.3, -0.1, 0.7]]
+            ok = failed = 0
+            for _ in range(150):
+                try:
+                    router.predict(x, timeout_s=10.0)
+                    ok += 1
+                except ServerOverloadedError:
+                    raise  # shed under this load would be a routing bug
+                except Exception:
+                    failed += 1
+            assert ok / (ok + failed) >= 0.99, (ok, failed)
+            counts = router.counts()
+            # >= 1, not >= 2: the kill's failover retry can land on
+            # replica 1 exactly as its hang fires, so ONE request chain
+            # absorbs both faults and counts a single failover outcome.
+            assert counts["failover"] >= 1, counts  # kill/hang rerouted
+            assert counts["shed"] == 0
+            # Failover detection -> reroute -> answer inside 1s.
+            fam = obs.metrics.get_family("dl4j_router_failover_seconds")
+            _, _, fo_sum, fo_count = fam.children()[0].histogram_state()
+            assert fo_count >= 1
+            assert fo_sum / fo_count < 1.0, (fo_sum, fo_count)
+            # The killed replica died hard (137) and was lease-reaped.
+            _wait(lambda: manager.procs["replica-0"].poll() is not None,
+                  30.0, what="replica-0 killed")
+            assert manager.procs["replica-0"].returncode == 137
+            _wait(lambda: router.load_stats()["dead"] >= 1, 10.0,
+                  what="lease-expiry eviction observed")
+        finally:
+            router.stop()
+            manager.stop_all()
+            coord.close()
+
+    def test_rolling_update_zero_5xx_zero_compiles_and_sigterm_drain(
+            self, tmp_path):
+        """Rolling update acceptance: two replicas serve checkpoint A
+        under continuous traffic; a rolling update to checkpoint B must
+        complete with ZERO client-visible errors, the rolled replicas must
+        do ZERO serving-path compiles after rejoining, and the swap must
+        actually change the served model. Then SIGTERM retires a replica:
+        exit code 0, never counted dead."""
+        pa = _save(mlp_net(seed=1), tmp_path / "ckpt_a")
+        pb = _save(mlp_net(seed=7), tmp_path / "ckpt_b")
+        coord, addr, manager = _spawn_fleet(
+            tmp_path, pa, n=2, plan=None, lost_after_s=2.0,
+            heartbeat_s=0.25)
+        router = FleetRouter(addr, poll_interval_s=0.1,
+                             request_timeout_s=15.0,
+                             attempt_timeout_s=5.0, http=False).start()
+        try:
+            _wait(lambda: sum(1 for r in router.table()
+                              if r["state"] == "live") == 2,
+                  120.0, what="2 live replicas")
+            x = [[0.25, 0.5, -0.75]]
+            before = router.predict(x, timeout_s=15.0)
+
+            stop = threading.Event()
+            client_errors = []
+
+            def traffic():
+                while not stop.is_set():
+                    try:
+                        router.predict(x, timeout_s=15.0)
+                    except Exception as e:
+                        client_errors.append(repr(e))
+
+            t = threading.Thread(target=traffic, daemon=True)
+            t.start()
+            try:
+                results = manager.rolling_update(pb, router, timeout_s=300.0)
+            finally:
+                stop.set()
+                t.join(30.0)
+            assert client_errors == [], client_errors[:5]
+            assert len(results) == 2
+            for summary in results.values():
+                assert summary["ok"] is True
+                assert summary["path"] == pb
+                assert summary["compiled_during_warm"] >= 0
+            _wait(lambda: sum(1 for r in router.table()
+                              if r["state"] == "live") == 2,
+                  30.0, what="both replicas rejoined")
+            # The rollout actually changed the served model.
+            after = router.predict(x, timeout_s=15.0)
+            assert not np.allclose(before, after)
+            # Zero serving-path compiles after rejoin: per-replica compile
+            # counters must not move under fresh traffic.
+            urls = [r["url"] for r in router.table()
+                    if r["state"] == "live"]
+
+            def compiles():
+                total = 0.0
+                for u in urls:
+                    with urllib.request.urlopen(u + "/metrics",
+                                                timeout=5.0) as resp:
+                        total += sum_metric_families(
+                            resp.read().decode(),
+                            ("dl4j_xla_compiles_total",))
+                return total
+
+            c0 = compiles()
+            for _ in range(30):
+                router.predict(x, timeout_s=15.0)
+            assert compiles() == c0
+            # SIGTERM = graceful drain: exit 0, clean leave, not dead.
+            assert manager.retire("replica-1", timeout_s=60.0) == 0
+            _wait(lambda: sum(1 for r in router.table()
+                              if r["state"] == "live") == 1,
+                  15.0, what="retired replica left the table")
+            assert router.load_stats()["dead"] == 0
+            assert router.predict(x, timeout_s=15.0).shape == (1, 2)
+        finally:
+            router.stop()
+            manager.stop_all()
+            coord.close()

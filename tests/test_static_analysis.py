@@ -624,13 +624,85 @@ def fit(parts):
         assert [f for f in lint_package(rules=["JX011"])] == []
 
 
+# --------------------------------------------------------------- JX012
+
+class TestJX012UnboundedBlockingIO:
+    # JX012 is path-scoped to serving/ and parallel/ — the layers where a
+    # hung socket propagates to the whole fleet.
+    def _lint(self, src, path="serving/fake_router.py"):
+        return lint_source(src, path, rules=["JX012"])
+
+    def test_unbounded_calls_fire(self):
+        src = """
+import socket
+import urllib.request
+import requests
+
+def fetch(addr, url):
+    s = socket.create_connection(addr)
+    r = urllib.request.urlopen(url)
+    q = requests.get(url)
+"""
+        fs = self._lint(src)
+        assert rules_of(fs) == {"JX012"}
+        assert len(fs) == 3
+        assert any("timeout" in f.message for f in fs)
+
+    def test_http_client_ctor_fires(self):
+        src = """
+import http.client
+
+def probe(host):
+    return http.client.HTTPConnection(host, 8080)
+"""
+        fs = self._lint(src, path="parallel/fake_probe.py")
+        assert rules_of(fs) == {"JX012"}
+
+    def test_explicit_timeouts_are_clean(self):
+        src = """
+import socket
+import urllib.request
+import requests
+
+def fetch(addr, url):
+    s = socket.create_connection(addr, timeout=2.0)
+    r = urllib.request.urlopen(url, timeout=1.0)
+    q = requests.get(url, timeout=3)
+"""
+        assert self._lint(src) == []
+
+    def test_positional_timeout_is_clean(self):
+        src = """
+import socket
+
+def fetch(addr):
+    return socket.create_connection(addr, 2.0)
+"""
+        assert self._lint(src) == []
+
+    def test_out_of_scope_path_is_clean(self):
+        src = """
+import urllib.request
+
+def fetch(url):
+    return urllib.request.urlopen(url)
+"""
+        assert self._lint(src, path="datasets/fake_fetch.py") == []
+
+    def test_package_is_jx012_clean(self):
+        # The router, replica runtime and coordinator client must carry
+        # explicit deadlines on every blocking call they make.
+        from deeplearning4j_tpu.analysis.linter import lint_package
+        assert [f for f in lint_package(rules=["JX012"])] == []
+
+
 # ------------------------------------------------------------ framework
 
 class TestLinterFramework:
     def test_registry_has_all_rules(self):
         assert set(ALL_RULES) >= {"JX001", "JX002", "JX003", "JX004",
                                   "JX005", "JX006", "JX007", "JX008",
-                                  "JX009", "JX010", "JX011"}
+                                  "JX009", "JX010", "JX011", "JX012"}
 
     def test_findings_are_typed_and_sorted(self):
         src = """
