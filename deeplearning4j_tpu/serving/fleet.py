@@ -39,6 +39,7 @@ import subprocess
 import sys
 import threading
 import time
+import urllib.error
 from typing import Any, Callable, Dict, List, Optional
 
 from deeplearning4j_tpu import observability as _obs
@@ -110,6 +111,11 @@ class ReplicaServer:
         self._slow_ms = 0.0
         self._draining = threading.Event()
         self._stopped = threading.Event()
+        # Both guarded by self._cond: _terminating is the sticky "a real
+        # drain was requested" flag (SIGTERM / retire), distinct from the
+        # temporary _draining a rolling update sets and clears.
+        self._terminating = False
+        self._reloading = False
         self._fault_handlers: Dict[str, Callable[[Fault], None]] = {
             "kill_replica": lambda f: os._exit(137),
             "hang_replica": self._on_hang_fault,
@@ -247,13 +253,22 @@ class ReplicaServer:
     def drain(self, timeout_s: Optional[float] = None) -> None:
         """Graceful exit: stop admitting, tell the router (role flip),
         finish in-flight work, leave the cluster cleanly, stop serving.
-        Idempotent — SIGTERM during an explicit drain is a no-op."""
+        Idempotent — a second SIGTERM during a drain is a no-op. If a
+        rolling update currently owns the drained state, the exit is
+        deferred, not dropped: `reload()` observes the terminating flag
+        when it finishes and completes the drain instead of rejoining."""
         if self._stopped.is_set():
             return
-        first = not self._draining.is_set()
+        with self._cond:
+            first = not self._terminating
+            self._terminating = True
+            reloading = self._reloading
         self._draining.set()
-        if not first:
+        if not first or reloading:
             return
+        self._finish_drain(timeout_s)
+
+    def _finish_drain(self, timeout_s: Optional[float] = None) -> None:
         _fev.record_event("replica_draining", replica=self.name)
         if self.client is not None:
             try:
@@ -274,9 +289,21 @@ class ReplicaServer:
         table, finish in-flight, swap the default model to `path`,
         AOT-warm every bucket while drained, then re-join as routable.
         Every compile the new checkpoint needs happens inside the drain
-        window — zero compiles (and zero 5xx) on the serving path."""
+        window — zero compiles (and zero 5xx) on the serving path. A
+        failed swap restores the previous checkpoint and rejoins, so a
+        bad deploy never takes the replica out of rotation; the result
+        carries ``ok=False`` so the rollout can abort."""
         t0 = time.monotonic()
         c0 = compiles_total()
+        with self._cond:
+            if self._stopped.is_set() or self._terminating:
+                raise ReplicaDrainingError(
+                    f"replica {self.name!r} is terminating; not reloading")
+            if self._reloading:
+                raise ReplicaDrainingError(
+                    f"replica {self.name!r} already has a reload in "
+                    f"flight; retry shortly")
+            self._reloading = True
         self._draining.set()
         if self.client is not None:
             try:
@@ -288,28 +315,76 @@ class ReplicaServer:
         name = self.server.default_model
         with host._lock:
             model = host._models[name]
-            model.path = str(path)
-            model.pinned = False  # path-backed now: evictable + reloadable
-            host._evict(model)
-        host._reload(model)
-        if warm:
-            try:
-                if model.batcher is not None:
-                    model.batcher.warm()
-                if model.scheduler is not None:
-                    model.scheduler.warmup()
-            finally:
-                model.ready.set()
+            old_path, old_pinned = model.path, model.pinned
+        error: Optional[str] = None
+        restored = False
+        try:
+            with host._lock:
+                model.path = str(path)
+                model.pinned = False  # path-backed: evictable + reloadable
+                host._evict(model)
+            host._reload(model)
+            if warm:
+                try:
+                    if model.batcher is not None:
+                        model.batcher.warm()
+                    if model.scheduler is not None:
+                        model.scheduler.warmup()
+                finally:
+                    model.ready.set()
+        except Exception as e:
+            # A bad checkpoint must not leave the replica drained forever:
+            # put the old model back and rejoin. Only an unrestorable
+            # replica (net-backed old model, or the restore itself failed)
+            # stays out of rotation.
+            error = f"{type(e).__name__}: {e}"
+            restored = self._restore_model(host, model, old_path,
+                                           old_pinned)
         compiled = compiles_total() - c0
-        self._draining.clear()
-        if self.client is not None:
-            self.client.join(role=ROLE_LIVE)
+        with self._cond:
+            self._reloading = False
+            terminating = self._terminating
+        if terminating:
+            # SIGTERM landed mid-update: complete the real drain instead
+            # of rejoining, so `kubectl delete pod` during a deploy still
+            # exits promptly and gracefully.
+            self._finish_drain()
+        elif error is None or restored:
+            self._draining.clear()
+            if self.client is not None:
+                self.client.join(role=ROLE_LIVE)
         seconds = round(time.monotonic() - t0, 4)
+        if error is not None:
+            _fev.record_event("rolling_update_failed", replica=self.name,
+                              path=str(path), error=error,
+                              restored=restored)
+            return {"ok": False, "model": name, "path": str(path),
+                    "error": error, "restored": restored,
+                    "seconds": seconds}
         _fev.record_event("rolling_update", replica=self.name,
                           path=str(path), compiled=compiled,
                           seconds=seconds)
         return {"ok": True, "model": name, "path": str(path),
                 "compiled_during_warm": compiled, "seconds": seconds}
+
+    def _restore_model(self, host, model, old_path, old_pinned) -> bool:
+        """Best-effort rollback after a failed swap: re-point the model at
+        the previous checkpoint and load it. False when there is nothing
+        to restore from (the old model was net-backed) or the restore
+        itself failed — the replica then stays drained."""
+        if old_path is None:
+            return False
+        try:
+            with host._lock:
+                if model.resident:
+                    host._evict(model)
+                model.path = old_path
+                model.pinned = old_pinned
+            host._reload(model)
+            model.ready.set()
+            return True
+        except Exception:
+            return False
 
 
 # ------------------------------------------------------------------ fleet
@@ -408,7 +483,11 @@ class FleetManager:
         """Deploy `new_path` across the live fleet one replica at a time:
         each replica drains, warms the new checkpoint through the AOT
         store, and re-joins before the next one starts — capacity never
-        drops by more than one replica and no caller ever sees a compile."""
+        drops by more than one replica and no caller ever sees a compile.
+        A replica whose reload FAILS (``ok=False`` or an HTTP error from
+        the reload endpoint) ABORTS the rollout: the same checkpoint would
+        fail identically on every remaining replica, and continuing would
+        walk the whole fleet into the same bad deploy."""
         from deeplearning4j_tpu.serving.router import post_json
 
         results: Dict[str, Any] = {}
@@ -417,9 +496,20 @@ class FleetManager:
             if row["state"] != "live":
                 continue
             try:
-                results[row["name"]] = post_json(
+                summary = post_json(
                     row["url"] + "/admin/reload", {"path": str(new_path)},
                     timeout_s=timeout_s)
+            except urllib.error.HTTPError as e:
+                # The reload endpoint itself errored (bad checkpoint,
+                # replica terminating, ...). HTTPError subclasses OSError,
+                # so catch it FIRST — this is a failed deploy, not a dead
+                # replica, and it must stop the rollout.
+                results[row["name"]] = {"ok": False,
+                                        "error": f"HTTP {e.code}"}
+                _fev.record_event("rolling_update_aborted",
+                                  replica=row["name"],
+                                  error=f"HTTP {e.code}")
+                break
             except OSError as e:
                 # The replica died between the table snapshot and its turn
                 # (its lease may not have expired yet, so it still read as
@@ -427,6 +517,12 @@ class FleetManager:
                 # moves on to the survivors.
                 results[row["name"]] = {"ok": False, "error": str(e)}
                 continue
+            results[row["name"]] = summary
+            if not summary.get("ok"):
+                _fev.record_event("rolling_update_aborted",
+                                  replica=row["name"],
+                                  error=str(summary.get("error")))
+                break
             # Don't drain the next replica until the router has actually
             # observed this one back in the live set — otherwise its stale
             # table can briefly show zero routable replicas and shed.

@@ -264,17 +264,31 @@ class ModelHost:
                 model.loading = False
             raise
         with self._lock:
-            model.net = net
-            model.hbm_bytes = estimate_hbm_bytes(net)
-            _measure_hbm(model)
-            model.dtype = model_dtype(net=net)
-            _m.MODEL_HBM_BYTES.labels(model=model.name).set(model.hbm_bytes)
-            _m.MODEL_DTYPE.labels(model=model.name,
-                                  dtype=model.dtype).set(1)
-            if self.on_load is not None:
-                self.on_load(model)
-            self._enforce_budget(keep=model)
-            model.loading = False
+            try:
+                model.net = net
+                model.hbm_bytes = estimate_hbm_bytes(net)
+                _measure_hbm(model)
+                model.dtype = model_dtype(net=net)
+                _m.MODEL_HBM_BYTES.labels(model=model.name).set(
+                    model.hbm_bytes)
+                _m.MODEL_DTYPE.labels(model=model.name,
+                                      dtype=model.dtype).set(1)
+                if self.on_load is not None:
+                    self.on_load(model)
+                self._enforce_budget(keep=model)
+            except Exception:
+                # Publish failed (on_load hook, budget enforcement, ...):
+                # roll back to the evicted state so the next get() retries
+                # the load — a model stuck with loading=True would 503
+                # forever with no recovery path.
+                try:
+                    self._evict(model)
+                except Exception:
+                    model.net = None
+                    model.ready.clear()
+                raise
+            finally:
+                model.loading = False
 
     def resident_bytes(self) -> int:
         with self._lock:

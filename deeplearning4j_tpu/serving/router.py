@@ -165,14 +165,13 @@ class ReplicaInfo:
     lease_age_s: float
     seen_at: float        # monotonic time of the poll that produced this
     load: float = 0.0     # scraped queue depth + busy decode slots
-    inflight: int = 0     # router-local outstanding requests to it
     scrape_ok: bool = True
 
     def row(self) -> Dict[str, Any]:
         return {"worker_id": self.worker_id, "name": self.name,
                 "url": self.url, "state": self.state,
                 "lease_age_s": self.lease_age_s, "load": self.load,
-                "inflight": self.inflight, "scrape_ok": self.scrape_ok}
+                "scrape_ok": self.scrape_ok}
 
 
 class FleetRouter:
@@ -216,7 +215,14 @@ class FleetRouter:
             backoff=Backoff(base_s=0.05, max_s=0.1, tries=1))
         self._lock = threading.Lock()
         self._table: Dict[str, ReplicaInfo] = {}
+        # Outstanding requests per worker_id. Lives OUTSIDE the per-poll
+        # ReplicaInfo snapshots: a request that spans a table rebuild must
+        # decrement the same counter it incremented, or the leak skews
+        # _pick's load score forever.
+        self._inflight: Dict[str, int] = {}
         self._quarantine: Dict[str, float] = {}
+        self._refresh_lock = threading.Lock()  # single-flight shed refresh
+        self._refresh_gen = 0
         self._lost_after_s = 15.0
         self._dead_total = 0
         self._rr = 0
@@ -282,6 +288,24 @@ class FleetRouter:
     def poll_once(self) -> None:
         """Rebuild the routing table from coordinator membership, then
         refresh each live replica's load score from its own /metrics."""
+        live = self._refresh_membership()
+        for info in live:
+            try:
+                text = get_text(info.url + "/metrics",
+                                timeout_s=self.scrape_timeout_s)
+                info.load = sum_metric_families(
+                    text, ("dl4j_serving_model_queue_depth",
+                           "dl4j_serving_decode_slots_busy"))
+                info.scrape_ok = True
+            except Exception:
+                # Keep the stale score; the request path (timeout +
+                # quarantine) is the authority on a broken replica.
+                info.scrape_ok = False
+
+    def _refresh_membership(self) -> List[ReplicaInfo]:
+        """One coordinator status RPC -> new routing table; returns the
+        live rows (the poll loop's scrape candidates). Does no per-replica
+        I/O, so the shed path can afford it on the request thread."""
         doc = self._client.status()
         detail = doc.get("detail", {})
         now = time.monotonic()
@@ -317,26 +341,29 @@ class FleetRouter:
                         _fev.record_event("replica_dead", replica=old.name,
                                           url=old.url)
                 elif wid in rows:
-                    rows[wid].inflight = old.inflight
                     rows[wid].load = old.load
             self._table = rows
-            live = [r for r in rows.values() if r.state == "live"]
-        for info in live:
+            return [r for r in rows.values() if r.state == "live"]
+
+    def _refresh_membership_shared(self) -> None:
+        """Shed-path refresh: membership only, single-flight. Concurrent
+        shedding requests share one coordinator RPC — a saturated fleet
+        must not dogpile the coordinator (or re-scrape every replica's
+        /metrics) once per about-to-shed request."""
+        gen = self._refresh_gen
+        with self._refresh_lock:
+            if self._refresh_gen != gen:
+                return  # another request just refreshed; reuse its table
             try:
-                text = get_text(info.url + "/metrics",
-                                timeout_s=self.scrape_timeout_s)
-                info.load = sum_metric_families(
-                    text, ("dl4j_serving_model_queue_depth",
-                           "dl4j_serving_decode_slots_busy"))
-                info.scrape_ok = True
-            except Exception:
-                # Keep the stale score; the request path (timeout +
-                # quarantine) is the authority on a broken replica.
-                info.scrape_ok = False
+                self._refresh_membership()
+            finally:
+                self._refresh_gen += 1
 
     def table(self) -> List[Dict[str, Any]]:
         with self._lock:
-            return [info.row() for info in self._table.values()]
+            return [dict(info.row(),
+                         inflight=self._inflight.get(wid, 0))
+                    for wid, info in self._table.items()]
 
     def _count_state(self, state: str) -> int:
         if state == "dead":
@@ -361,9 +388,12 @@ class FleetRouter:
             ]
             if not candidates:
                 return None
-            best = min(r.load + r.inflight for r in candidates)
-            tied = sorted((r for r in candidates
-                           if r.load + r.inflight == best),
+
+            def score(r: ReplicaInfo) -> float:
+                return r.load + self._inflight.get(r.worker_id, 0)
+
+            best = min(score(r) for r in candidates)
+            tied = sorted((r for r in candidates if score(r) == best),
                           key=lambda r: r.name)
             # Round-robin among equally-idle replicas: a sequential client
             # (inflight always 0 at pick time) must not pin one replica.
@@ -420,9 +450,11 @@ class FleetRouter:
             if rep is None and time.monotonic() < deadline:
                 # The table may be one poll interval stale (a replica that
                 # just rejoined after a drain or reload is not visible
-                # yet).  Refresh membership once before shedding.
+                # yet).  Refresh membership once before shedding — cheap
+                # (one coordinator RPC, no per-replica /metrics scrape)
+                # and single-flight, so saturated traffic can't dogpile.
                 try:
-                    self.poll_once()
+                    self._refresh_membership_shared()
                 except Exception:
                     pass
                 rep = self._pick(exclude=tried_failed | tried_saturated)
@@ -435,8 +467,9 @@ class FleetRouter:
                 raise _Failover("request deadline exhausted")
             attempt_budget = (remaining if self.attempt_timeout_s is None
                               else min(remaining, self.attempt_timeout_s))
+            wid = rep.worker_id
             with self._lock:
-                rep.inflight += 1
+                self._inflight[wid] = self._inflight.get(wid, 0) + 1
             try:
                 return post_json(rep.url + "/" + route, payload,
                                  timeout_s=attempt_budget)
@@ -470,7 +503,13 @@ class FleetRouter:
                     f"never blind-retried")
             finally:
                 with self._lock:
-                    rep.inflight = max(0, rep.inflight - 1)
+                    n = self._inflight.get(wid, 1) - 1
+                    if n > 0:
+                        self._inflight[wid] = n
+                    else:
+                        # Drop zeroed entries so counters for replicas
+                        # that left the fleet don't accumulate.
+                        self._inflight.pop(wid, None)
 
         bo = Backoff(base_s=0.02, max_s=0.25,
                      tries=max(2, self.failover_tries),
@@ -514,7 +553,8 @@ class FleetRouter:
         p99 over the recent window, and outcome counters."""
         with self._lock:
             live = [r for r in self._table.values() if r.state == "live"]
-            total_load = sum(r.load + r.inflight for r in live)
+            total_load = sum(r.load + self._inflight.get(r.worker_id, 0)
+                             for r in live)
             lat = sorted(self._latencies)
             counts = dict(self._counts)
             dead = self._dead_total

@@ -277,6 +277,31 @@ class TestHostReloadNarrowLock:
         assert {r["name"]: r["status"]
                 for r in host.snapshot()}["a"] == "ready"
 
+    def test_failed_publish_clears_loading_and_recovers(self, tmp_path):
+        # If the post-load publish (on_load hook, budget enforcement)
+        # raises, the model must roll back to the evicted state — NOT
+        # stay loading=True forever, which would 503 every future get()
+        # with no recovery path.
+        pa = _save(mlp_net(seed=1), tmp_path / "a")
+        boom = [True]
+
+        def on_load(m):
+            if boom[0]:
+                boom[0] = False
+                raise RuntimeError("hook exploded")
+            m.ready.set()
+
+        host = ModelHost(on_load=on_load)
+        host.add("a", path=pa)
+        with pytest.raises(RuntimeError):
+            host.get("a")
+        m = host._models["a"]
+        assert m.loading is False
+        assert not m.resident  # rolled back, not half-published
+        # The next caller retries the load and succeeds.
+        assert host.get("a").resident
+        assert m.ready.is_set()
+
 
 # ------------------------------------------------------ router unit tests
 
@@ -454,6 +479,63 @@ class TestRouterRouting:
             r.predict([[1.0, 2.0, 3.0]], timeout_s=1.0)
         assert time.monotonic() - t0 < 3.0
 
+    def test_inflight_survives_table_rebuild(self):
+        # A request in flight across a poll rebuild must decrement the
+        # SAME counter it incremented: the per-replica score must return
+        # to zero when the request finishes, not leak forever and skew
+        # _pick away from the replica.
+        entered, release = threading.Event(), threading.Event()
+
+        def behavior(path):
+            entered.set()
+            assert release.wait(10.0)
+            return 200, {"predictions": [[0.5, 0.5]]}
+
+        srv = _fake_replica(behavior)
+        try:
+            port = srv.server_address[1]
+            wid = f"a@127.0.0.1:{port}"
+            r = _router_with([_info("a", port)])
+            t = threading.Thread(
+                target=lambda: r.predict([[1.0, 2.0, 3.0]]), daemon=True)
+            t.start()
+            assert entered.wait(5.0)
+            with r._lock:
+                assert r._inflight[wid] == 1
+                # Simulate the poll loop rebuilding the table with FRESH
+                # ReplicaInfo snapshots while the request is in flight.
+                r._table = {i.worker_id: i for i in [_info("a", port)]}
+            release.set()
+            t.join(10.0)
+            assert not t.is_alive()
+            with r._lock:
+                assert r._inflight.get(wid, 0) == 0
+        finally:
+            srv.shutdown()
+
+    def test_shed_path_refresh_is_single_flight(self):
+        # Concurrent about-to-shed requests must share ONE membership
+        # refresh instead of each dogpiling the coordinator.
+        r = _router_with([])
+        calls = []
+        gate = threading.Event()
+
+        def fake_refresh():
+            calls.append(1)
+            assert gate.wait(5.0)
+            return []
+
+        r._refresh_membership = fake_refresh
+        threads = [threading.Thread(target=r._refresh_membership_shared,
+                                    daemon=True) for _ in range(5)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)  # let the rest pile up on the single-flight lock
+        gate.set()
+        for t in threads:
+            t.join(5.0)
+        assert len(calls) == 1
+
 
 # ------------------------------------------------- replica fault seam
 
@@ -489,6 +571,142 @@ class TestReplicaFaultSeam:
             assert rep.inflight() == 0
         finally:
             rep.server.stop()
+
+
+# ------------------------------------------- reload failure + termination
+
+
+class TestReplicaReloadFailure:
+    def _replica(self, tmp_path):
+        pa = _save(mlp_net(seed=1), tmp_path / "ckpt_a")
+        return pa, ReplicaServer("127.0.0.1:1", path=pa, warm=False,
+                                 handle_sigterm=False)
+
+    def test_bad_checkpoint_restores_old_model_and_rejoins(self, tmp_path):
+        # A failed deploy must NOT leave the replica drained forever: the
+        # old checkpoint comes back and the replica rejoins the fleet.
+        pa, rep = self._replica(tmp_path)
+        try:
+            out = rep.reload(str(tmp_path / "nope"), warm=False)
+            assert out["ok"] is False
+            assert out["restored"] is True
+            assert not rep._draining.is_set()  # back in rotation
+            preds = rep.server.predict([[0.1, 0.2, 0.3]])
+            assert preds.shape == (1, 2)  # old model still answers
+            # A good checkpoint afterwards still deploys.
+            pb = _save(mlp_net(seed=7), tmp_path / "ckpt_b")
+            assert rep.reload(pb, warm=False)["ok"] is True
+        finally:
+            rep.server.stop()
+
+    def test_sigterm_during_reload_defers_then_completes_drain(
+            self, tmp_path):
+        # SIGTERM while a rolling update owns the drained state must not
+        # be dropped: the reload finishes, then performs the real drain
+        # instead of rejoining — the process still exits gracefully.
+        pa, rep = self._replica(tmp_path)
+        pb = _save(mlp_net(seed=2), tmp_path / "ckpt_b")
+        host = rep.server.models
+        entered, release = threading.Event(), threading.Event()
+        real_reload = host._reload
+
+        def slow_reload(model):
+            entered.set()
+            assert release.wait(10.0)
+            return real_reload(model)
+
+        host._reload = slow_reload
+        out = {}
+        t = threading.Thread(
+            target=lambda: out.update(rep.reload(pb, warm=False)),
+            daemon=True)
+        t.start()
+        assert entered.wait(5.0)
+        rep.drain(timeout_s=1.0)  # SIGTERM mid-update: deferred
+        assert not rep._stopped.is_set()
+        release.set()
+        t.join(15.0)
+        assert not t.is_alive()
+        assert out["ok"] is True
+        assert rep._stopped.is_set()  # the reload completed the drain
+        # A terminating replica refuses further reloads.
+        with pytest.raises(ReplicaDrainingError):
+            rep.reload(pb, warm=False)
+
+
+class _StaticTableRouter:
+    def __init__(self, rows):
+        self.rows = rows
+
+    def table(self):
+        return self.rows
+
+
+class TestRollingUpdateAbort:
+    def _rows(self, *servers):
+        return [{"name": f"r{i}", "state": "live",
+                 "url": f"http://127.0.0.1:{s.server_address[1]}"}
+                for i, s in enumerate(servers)]
+
+    def test_aborts_when_a_replica_reports_failed_swap(self, tmp_path):
+        calls = []
+        bad = _fake_replica(lambda p: (calls.append("bad") or 200,
+                                       {"ok": False, "error": "bad ckpt",
+                                        "restored": True}))
+        good = _fake_replica(lambda p: (calls.append("good") or 200,
+                                        {"ok": True}))
+        try:
+            router = _StaticTableRouter(self._rows(bad, good))
+            mgr = FleetManager("127.0.0.1:1", str(tmp_path / "old"))
+            results = mgr.rolling_update(str(tmp_path / "new"), router,
+                                         timeout_s=5.0)
+            assert results["r0"]["ok"] is False
+            assert "r1" not in results  # rollout stopped at the failure
+            assert calls == ["bad"]
+        finally:
+            bad.shutdown()
+            good.shutdown()
+
+    def test_aborts_on_http_error_instead_of_swallowing_it(self, tmp_path):
+        # HTTPError subclasses OSError: a 500 from a failed reload must
+        # abort the rollout, not be mistaken for a dead replica and
+        # walked past onto the next one.
+        calls = []
+        bad = _fake_replica(lambda p: (calls.append("bad") or 500,
+                                       {"error": "reload blew up"}))
+        good = _fake_replica(lambda p: (calls.append("good") or 200,
+                                        {"ok": True}))
+        try:
+            router = _StaticTableRouter(self._rows(bad, good))
+            mgr = FleetManager("127.0.0.1:1", str(tmp_path / "old"))
+            results = mgr.rolling_update(str(tmp_path / "new"), router,
+                                         timeout_s=5.0)
+            assert results["r0"] == {"ok": False, "error": "HTTP 500"}
+            assert "r1" not in results
+            assert calls == ["bad"]
+        finally:
+            bad.shutdown()
+            good.shutdown()
+
+    def test_connection_failure_skips_the_dead_replica(self, tmp_path):
+        # A replica that died between the table snapshot and its turn is
+        # skipped (the router evicts it on its own); the rollout carries
+        # on to the survivors.
+        good = _fake_replica(lambda p: (200, {"ok": True}))
+        try:
+            dead_row = {"name": "r0", "state": "live",
+                        "url": f"http://127.0.0.1:{_free_port()}"}
+            rows = [dead_row] + [
+                {"name": "r1", "state": "live",
+                 "url": f"http://127.0.0.1:{good.server_address[1]}"}]
+            router = _StaticTableRouter(rows)
+            mgr = FleetManager("127.0.0.1:1", str(tmp_path / "old"))
+            results = mgr.rolling_update(str(tmp_path / "new"), router,
+                                         timeout_s=5.0)
+            assert results["r0"]["ok"] is False
+            assert results["r1"]["ok"] is True
+        finally:
+            good.shutdown()
 
 
 # ----------------------------------------------------------- autoscaler
