@@ -1482,6 +1482,159 @@ def bench_fleet_slo(steps, warmup):
     return [head, fo]
 
 
+def bench_obs_federation(steps, warmup):
+    """Observability-plane overhead drill (observability/federation.py):
+    a 2-replica CPU fleet behind the failover router, mean predict
+    latency with NO federation traffic vs with a background aggregator
+    federating every member's /metrics every ~2 seconds (7.5x the
+    Prometheus default scrape cadence) and the merged /api/trace
+    timeline every ~10 seconds (member rings hold ~30s+ of history, so
+    nothing is lost at that cadence).
+
+    Measurement design: single-core VM latency drifts a few percent
+    between arms minutes apart, which would swamp a <= 2% effect — so
+    requests run in PAIRED adjacent blocks (scraper-idle block, then a
+    same-size block containing exactly one federation cycle, which at
+    ~2s per block IS the target cadence; every 5th pair also federates
+    traces). The headline is the median of the paired per-block p50
+    differences; block pairs seconds apart share the same drift, so it
+    cancels. The whole observability plane shares one <= 2% latency
+    budget (PERF.md §15, §22); federation must fit inside it because
+    scrapes are incremental (?since= trace cursors) over keep-alive
+    connections and ride a separate HTTP thread on each replica, never
+    the dispatch path."""
+    import tempfile
+    import threading
+
+    from deeplearning4j_tpu import (MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.checkpoint.manager import CheckpointManager
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.parallel.coordinator import Coordinator
+    from deeplearning4j_tpu.serving import FleetManager, FleetRouter
+
+    net = MultiLayerNetwork(
+        (NeuralNetConfiguration.builder()
+         .seed(1).learning_rate(0.1).weight_init("xavier")
+         .list()
+         .layer(DenseLayer(n_out=4, activation="tanh"))
+         .layer(OutputLayer(n_out=2, activation="softmax",
+                            loss_function="mcxent"))
+         .set_input_type(InputType.feed_forward(3))
+         .build())).init()
+    tmp = tempfile.mkdtemp(prefix="bench-obs-fed-")
+    ckpt = os.path.join(tmp, "ckpt")
+    CheckpointManager(ckpt, async_save=False).save(net)
+
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _HERE + os.pathsep + env.get("PYTHONPATH", "")
+
+    coord = Coordinator(lost_after_s=5.0).start()
+    manager = FleetManager(coord.address, ckpt, heartbeat_s=0.25,
+                           env=env, log_dir=os.path.join(tmp, "logs"))
+    router = FleetRouter(coord.address, poll_interval_s=0.1,
+                         request_timeout_s=10.0, attempt_timeout_s=2.0,
+                         quarantine_s=4.0, http=False).start()
+    x = [[0.1, -0.2, 0.3]]
+    pairs = 8
+    block = max(400, steps * 10)
+
+    def timed(n):
+        lat = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            router.predict(x, timeout_s=10.0)
+            lat.append(time.perf_counter() - t0)
+        lat.sort()
+        return {"mean": sum(lat) / n, "p50": lat[n // 2],
+                "p99": lat[int(0.99 * (n - 1))]}
+
+    def median(vals):
+        vals = sorted(vals)
+        mid = len(vals) // 2
+        return (vals[mid] if len(vals) % 2
+                else (vals[mid - 1] + vals[mid]) / 2.0)
+
+    try:
+        manager.spawn()
+        manager.spawn()
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            if sum(1 for r in router.table()
+                   if r["state"] == "live") == 2:
+                break
+            time.sleep(0.1)
+        else:
+            raise RuntimeError("fleet never reached 2 live replicas")
+        for _ in range(max(10, warmup)):
+            router.predict(x, timeout_s=10.0)
+
+        # Warm the aggregator BEFORE the baseline arm so one-time costs
+        # (coordinator discovery, HTTP connection setup, import of the
+        # merge path) don't land inside the federated measurement.
+        agg = router.aggregator()
+        agg.federate_metrics()
+        agg.federate_trace()
+
+        # One steady-state federation cycle, timed (cursors warm).
+        t0 = time.perf_counter()
+        agg.federate_metrics()
+        agg.federate_trace()
+        scrape_s = time.perf_counter() - t0
+
+        diffs_p50, diffs_mean = [], []
+        offs, ons = [], []
+        for k in range(pairs):
+            off = timed(block)
+
+            def one_cycle(do_trace=(k % 5 == 0)):
+                try:
+                    agg.federate_metrics()
+                    if do_trace:
+                        agg.federate_trace()
+                except Exception:
+                    pass
+
+            th = threading.Thread(target=one_cycle, daemon=True)
+            th.start()
+            on = timed(block)
+            th.join(30.0)
+            offs.append(off)
+            ons.append(on)
+            diffs_p50.append((on["p50"] - off["p50"]) / off["p50"] * 100)
+            diffs_mean.append(
+                (on["mean"] - off["mean"]) / off["mean"] * 100)
+    finally:
+        try:
+            router.stop()
+        finally:
+            manager.stop_all()
+            coord.close()
+
+    overhead_pct = median(diffs_p50)
+    mean_pct = median(diffs_mean)
+    base_p50 = median([o["p50"] for o in offs]) * 1e3
+    fed_p50 = median([o["p50"] for o in ons]) * 1e3
+    base_p99 = median([o["p99"] for o in offs]) * 1e3
+    fed_p99 = median([o["p99"] for o in ons]) * 1e3
+    head = _entry(
+        "obs_federation_overhead_pct", overhead_pct, "percent",
+        note=(f"median paired per-block p50 overhead; 2 CPU replicas, "
+              f"{pairs} pairs x {block} predicts/block, one federation "
+              f"cycle per ON block (metrics every pair, traces every "
+              f"5th); p50 {base_p50:.2f} -> {fed_p50:.2f} ms, mean "
+              f"diff {mean_pct:+.1f}%, p99 {base_p99:.2f} -> "
+              f"{fed_p99:.2f} ms; budget is <= 2%."))
+    scr = _entry(
+        "obs_federation_scrape_seconds", scrape_s, "seconds",
+        note="one steady-state fleet-wide /metrics + /api/trace "
+             "federation (incremental ?since= cursors over keep-alive "
+             "connections; every member scraped + merged).")
+    return [head, scr]
+
+
 def main():
     # Compile-time accounting for the self-attribution snapshot in _emit():
     # every XLA compile during the run lands in dl4j_xla_compile_* counters.
@@ -1497,7 +1650,7 @@ def main():
         "lenet_cold_warm,lenet_pipeline_overlap,word2vec,vgg16,"
         "flash_attn,flash_tri,transformer,"
         "serving_slo,lm_int8_serving,obs_overhead,elastic_recovery,"
-        "fleet_slo"
+        "fleet_slo,obs_federation"
     ).split(",")
 
     head, extra = None, {}
@@ -1570,6 +1723,9 @@ def main():
         extra[e["metric"]] = e
     if "fleet_slo" in configs:
         for e in bench_fleet_slo(steps, warmup):
+            extra[e["metric"]] = e
+    if "obs_federation" in configs:
+        for e in bench_obs_federation(steps, warmup):
             extra[e["metric"]] = e
     if head is None:  # resnet50 excluded: promote the first extra metric
         if not extra:

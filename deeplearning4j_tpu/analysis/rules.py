@@ -1,4 +1,4 @@
-"""tpulint rules JX001-JX008.
+"""tpulint rules JX001-JX013.
 
 Each rule is a class with a stable ``id``; registration is
 registry-driven (`@register_rule`) so satellite PRs add rules without
@@ -875,3 +875,87 @@ class UnboundedBlockingIORule(Rule):
                     "has NO default timeout — a silent hang on a dead "
                     "replica; every serving/parallel HTTP call must carry "
                     "an explicit deadline")
+
+
+@register_rule
+class TracePropagationRule(Rule):
+    """JX013: outbound HTTP on a serving/coordination path that does not
+    forward the trace context.
+
+    A request hop made without the ``X-DL4J-Trace`` header breaks the
+    request's cross-process span tree exactly where it matters — at the
+    process boundary the federated timeline (`observability/federation`)
+    exists to stitch. In `serving/` and `parallel/`, every outbound HTTP
+    call must either route through a propagating helper (`serving/
+    router.py`'s `post_json` reads the thread-current context via
+    `propagate.trace_headers`) or attach the header itself.
+
+    Heuristic: a raw HTTP call (`urlopen` / `Request` /
+    `HTTP(S)Connection` / `requests.<verb>`) is flagged unless its
+    enclosing function shows trace-propagation evidence — any name or
+    attribute containing ``trace`` (e.g. ``trace_headers``,
+    ``TRACE_HEADER``) or the literal header string. Allowlisted by
+    function name: ``get_text`` and anything containing ``scrape`` —
+    metrics scrapes (the router's load poll, the federation aggregator)
+    are trace ROOTS, not request hops; there is no context to forward.
+    """
+
+    id = "JX013"
+    description = ("outbound HTTP in serving/ or parallel/ not forwarding "
+                   "the X-DL4J-Trace context (breaks the cross-process "
+                   "span tree)")
+
+    _OUTBOUND = {"urlopen", "Request", "HTTPConnection", "HTTPSConnection"}
+    _REQUESTS_VERBS = {"get", "post", "put", "delete", "head", "patch",
+                       "request"}
+
+    @staticmethod
+    def _has_trace_evidence(fn_node) -> bool:
+        for sub in walk_body(fn_node):
+            if isinstance(sub, ast.Name) and "trace" in sub.id.lower():
+                return True
+            if isinstance(sub, ast.Attribute) and "trace" in sub.attr.lower():
+                return True
+            if isinstance(sub, ast.Constant) and sub.value == "X-DL4J-Trace":
+                return True
+        return False
+
+    def check(self, ctx):
+        rel = ctx.rel.replace("\\", "/")
+        if "/analysis/" in rel or rel.startswith("analysis/"):
+            return
+        if not any(seg in rel for seg in ("serving/", "parallel/")):
+            return
+        for qual, info in sorted(ctx.functions.items()):
+            fname = info.name
+            if fname == "get_text" or "scrape" in fname.lower():
+                continue  # metrics scrapes are trace roots, not hops
+            evidence = None  # lazily computed per function
+            for node in walk_body(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                name = (f.attr if isinstance(f, ast.Attribute)
+                        else getattr(f, "id", None))
+                if name in self._OUTBOUND:
+                    flagged = True
+                elif (name in self._REQUESTS_VERBS
+                      and isinstance(f, ast.Attribute)
+                      and attr_base(f) == "requests"):
+                    flagged = True
+                else:
+                    flagged = False
+                if not flagged:
+                    continue
+                if evidence is None:
+                    evidence = self._has_trace_evidence(info.node)
+                if evidence:
+                    break  # this function propagates; skip its other calls
+                yield self.finding(
+                    ctx, node,
+                    f"outbound `{name}(...)` in `{fname}` without trace "
+                    "propagation: forward the thread-current context "
+                    "(propagate.trace_headers / the X-DL4J-Trace header) "
+                    "or route through serving/router.py's post_json — a "
+                    "hop without it falls off the request's federated "
+                    "span tree")

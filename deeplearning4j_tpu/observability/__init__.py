@@ -35,6 +35,7 @@ import os
 import threading
 from typing import Any, Dict, Optional
 
+from deeplearning4j_tpu.observability import propagate
 from deeplearning4j_tpu.observability.metrics import (
     DEFAULT_BUCKETS, WIDE_BUCKETS, MetricsRegistry,
     install_builtin_collectors)
@@ -48,6 +49,7 @@ __all__ = [
     "iteration_span", "host_nbytes", "install_jax_compile_hook",
     "bench_snapshot", "prometheus_payload", "chip_peak_flops",
     "estimate_step_flops", "flight", "FlightRecorder", "memory",
+    "propagate", "install_build_info",
 ]
 
 OBS_ENABLED = os.environ.get("DL4J_TPU_OBS", "1").lower() not in (
@@ -73,6 +75,52 @@ config = _Config()
 metrics = MetricsRegistry(enabled=OBS_ENABLED)
 install_builtin_collectors(metrics)
 tracer = Tracer(enabled=OBS_ENABLED)
+
+
+def install_build_info(registry: Optional[MetricsRegistry] = None) -> None:
+    """Register the `dl4j_build_info{version,jax,backend,device_kind}`
+    info-gauge (constant 1). Labels resolve at scrape time — jax is never
+    imported just to report a version, and the series upgrades in place
+    once jax/the backend come up. Federated scrapes read this to spot
+    mixed-version fleets mid-rolling-update."""
+    reg = registry or metrics
+    fam = reg.gauge(
+        "dl4j_build_info",
+        "Build/runtime identity of this process (value is always 1); "
+        "compare worker_id series in a federated scrape to detect "
+        "mixed-version fleets during rolling updates",
+        label_names=("version", "jax", "backend", "device_kind"))
+    state: Dict[str, Any] = {}
+
+    def collect(_reg: MetricsRegistry) -> None:
+        import sys
+
+        import deeplearning4j_tpu as _pkg
+
+        labels = {"version": getattr(_pkg, "__version__", "unknown"),
+                  "jax": "unloaded", "backend": "unknown",
+                  "device_kind": "unknown"}
+        jax = sys.modules.get("jax")  # never import jax just to report it
+        if jax is not None:
+            try:
+                labels["jax"] = jax.__version__
+                labels["backend"] = jax.default_backend()
+                labels["device_kind"] = jax.devices()[0].device_kind
+            except Exception:
+                pass
+        key = tuple(labels.values())
+        if state.get("key") != key:
+            prev = state.get("child")
+            if prev is not None:
+                prev.set(0.0)  # labels upgraded (jax came up): retire old
+            state["key"] = key
+            state["child"] = fam.labels(**labels)
+        state["child"].set(1.0)
+
+    reg.register_collector(collect)
+
+
+install_build_info(metrics)
 
 
 def enable() -> None:
@@ -229,17 +277,23 @@ def install_jax_compile_hook(registry: Optional[MetricsRegistry] = None) -> bool
 
 
 def prometheus_payload(fmt: str = "prometheus",
-                       registry: Optional[MetricsRegistry] = None):
+                       registry: Optional[MetricsRegistry] = None,
+                       names: Optional[Any] = None):
     """One scrape body for every HTTP surface (`UIServer` and the serving
     tier both mount `GET /metrics` on this): returns `(body_bytes,
     content_type)`. `fmt="json"` serves the structured snapshot instead of
-    Prometheus text 0.0.4."""
+    Prometheus text 0.0.4. `names` (iterable of family names, from the
+    `?names=a,b` query param) narrows the body to those families — the
+    needle scrape the fleet router's load poll uses, whose cost must not
+    scale with how many families the process hosts."""
     import json
 
     reg = registry or metrics
     if fmt == "json":
-        return (json.dumps(reg.to_json()).encode(), "application/json")
-    return (reg.to_prometheus().encode(), "text/plain; version=0.0.4")
+        return (json.dumps(reg.to_json(names=names)).encode(),
+                "application/json")
+    return (reg.to_prometheus(names=names).encode(),
+            "text/plain; version=0.0.4")
 
 
 # ------------------------------------------------------------ bench glue

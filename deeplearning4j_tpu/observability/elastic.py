@@ -60,6 +60,12 @@ def record_event(event: str, **fields) -> None:
         flight.record_event(f"elastic:{event}", **fields)
     except Exception:
         pass
+    try:
+        # Mirror onto the trace timeline: recovery events render as
+        # instants next to the request spans in a federated /api/trace.
+        _obs.tracer.instant(f"elastic:{event}", cat="elastic", **fields)
+    except Exception:
+        pass
 
 
 def observe_recovery(seconds: float) -> None:

@@ -1,0 +1,434 @@
+"""Fleet-wide metrics federation and trace merging.
+
+The per-process observability core (`metrics.py`, `tracing.py`) answers
+questions about ONE process; a serving fleet is N replicas + a router +
+a coordinator, each with its own `/metrics` and its own span ring. This
+module is the aggregation half of the cross-process plane
+(`propagate.py` is the wire half):
+
+- `merge_prometheus` — merge N processes' Prometheus text expositions
+  into one, every sample gaining a ``worker_id`` label (exactly what a
+  Prometheus federation endpoint does), HELP/TYPE kept once per family.
+- `merge_traces` — merge N processes' Chrome trace rings (the
+  `Tracer.export_chrome` dicts) onto ONE timeline: per-process
+  monotonic timestamps are aligned via each ring's ``epochUnixUs``
+  wall-clock anchor, processes are named with ``process_name`` metadata
+  events, and every event keeps its ``trace_id``/``span_id`` args — so
+  a request propagated with `propagate.py` renders in Perfetto as one
+  parent-child tree spanning the router, two failover replicas, and the
+  coordinator.
+- `FleetAggregator` — discovers live members from the coordinator's
+  `status` op (the same membership the router routes on), scrapes each
+  member's `/metrics` and `/api/trace`, and serves the merged results
+  (`serve()`) as fleet-wide ``GET /metrics`` / ``GET /api/trace``.
+
+Member discovery rides the worker-id convention the serving fleet
+already uses (``name@host:port`` with an HTTP server at ``host:port``);
+the coordinator itself is discovered via the ``metrics_url`` it
+advertises in `status`. A member that fails to answer within
+`scrape_timeout_s` is skipped and reported as
+``dl4j_federation_up{worker_id=...} 0`` — one dead replica must never
+take down the fleet view.
+
+The scrape loop here is the intentional JX013 allowlist: federation
+scrapes are trace ROOTS, not request hops — they forward no context.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+import urllib.parse
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from deeplearning4j_tpu import observability as _obs
+from deeplearning4j_tpu.util.retry import Backoff
+
+#: Synthetic family reporting per-member scrape health in the federated
+#: exposition (1 = answered within the timeout, 0 = skipped).
+UP_FAMILY = "dl4j_federation_up"
+
+
+# ------------------------------------------------------- prometheus merge
+
+
+def _merged_sample(line: str, worker_id: str) -> str:
+    """Rewrite one sample line so ``worker_id`` is its first label."""
+    # `name{labels} value`  |  `name value`
+    brace = line.find("{")
+    if brace != -1:
+        return (line[:brace] + '{worker_id="' + worker_id + '",'
+                + line[brace + 1:])
+    name, _, rest = line.partition(" ")
+    return f'{name}{{worker_id="{worker_id}"}} {rest}'
+
+
+def merge_prometheus(texts: Dict[str, str]) -> str:
+    """Merge per-worker Prometheus text expositions into one, injecting
+    ``worker_id`` into every sample. Families keep first-seen order and
+    ONE HELP/TYPE header (exposition validity requires all of a family's
+    samples grouped under a single TYPE line)."""
+    order: List[str] = []
+    helps: Dict[str, str] = {}
+    types: Dict[str, str] = {}
+    samples: Dict[str, List[str]] = {}
+    for worker_id, text in texts.items():
+        fam = None
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            if line.startswith("# TYPE "):
+                parts = line.split(None, 3)
+                if len(parts) >= 4:
+                    fam = parts[2]
+                    if fam not in types:
+                        types[fam] = parts[3]
+                        order.append(fam)
+                        samples.setdefault(fam, [])
+                continue
+            if line.startswith("# HELP "):
+                parts = line.split(None, 3)
+                if len(parts) >= 3:
+                    helps.setdefault(parts[2],
+                                     parts[3] if len(parts) > 3 else "")
+                continue
+            if line.startswith("#"):
+                continue
+            if fam is None:
+                # Headerless sample (foreign exposition): family = the
+                # metric name itself, typed as untyped.
+                name = line.split("{", 1)[0].split(" ", 1)[0]
+                fam = name
+                if fam not in types:
+                    types[fam] = "untyped"
+                    order.append(fam)
+                    samples.setdefault(fam, [])
+            samples[fam].append(_merged_sample(line, worker_id))
+    out: List[str] = []
+    for fam in order:
+        if not samples.get(fam):
+            continue
+        if helps.get(fam):
+            out.append(f"# HELP {fam} {helps[fam]}")
+        out.append(f"# TYPE {fam} {types[fam]}")
+        out.extend(samples[fam])
+    return "\n".join(out) + "\n"
+
+
+# ------------------------------------------------------------ trace merge
+
+
+def merge_traces(docs: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge per-worker `Tracer.export_chrome` dicts onto one timeline.
+
+    Each ring's ``ts`` values are relative to its own perf_counter
+    epoch; the ``epochUnixUs`` anchor shifts them onto a shared clock
+    (earliest epoch = 0). Every event gains ``args.worker_id`` and a
+    ``process_name`` metadata row labels the pid in Perfetto's track
+    list. The result is a standard Chrome trace: json.dump and load it
+    at ui.perfetto.dev."""
+    epochs = {wid: float(doc.get("epochUnixUs", 0.0))
+              for wid, doc in docs.items()}
+    base = min(epochs.values()) if epochs else 0.0
+    events: List[dict] = []
+    meta: List[dict] = []
+    for wid, doc in docs.items():
+        shift = epochs[wid] - base
+        pid = doc.get("pid", 0)
+        seen_pids = set()
+        for ev in doc.get("traceEvents", []):
+            ev = dict(ev)
+            ev["ts"] = float(ev.get("ts", 0.0)) + shift
+            ev.setdefault("pid", pid)
+            args = dict(ev.get("args") or {})
+            args.setdefault("worker_id", wid)
+            ev["args"] = args
+            seen_pids.add(ev["pid"])
+            events.append(ev)
+        for p in sorted(seen_pids) or [pid]:
+            meta.append({"name": "process_name", "ph": "M", "pid": p,
+                         "tid": 0, "args": {"name": wid}})
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+# ------------------------------------------------------------- aggregator
+
+
+class FleetAggregator:
+    """Scrape every live fleet member and serve the merged view.
+
+    Membership comes from the coordinator's `status` op — the same
+    table the router routes on — so the aggregator tracks joins,
+    drains, and evictions with zero extra registration machinery.
+    Replicas are scraped at the HTTP address embedded in their
+    ``name@host:port`` worker id; the coordinator at the
+    ``metrics_url`` it advertises. The local process (typically the
+    router hosting the aggregator) is merged directly from the
+    in-process registry/tracer under ``local_worker_id``."""
+
+    def __init__(self, coordinator_address: str,
+                 scrape_timeout_s: float = 1.0,
+                 local_worker_id: Optional[str] = None,
+                 registry=None, tracer=None,
+                 retention_events: int = 16384):
+        from deeplearning4j_tpu.parallel.coordinator import CoordinatorClient
+
+        self.scrape_timeout_s = float(scrape_timeout_s)
+        self.local_worker_id = local_worker_id
+        self._registry = registry or _obs.metrics
+        self._tracer = tracer or _obs.tracer
+        # Per-worker accumulated trace state for incremental scraping
+        # (`/api/trace?since=<seq>`): steady-state polls ship only the
+        # delta, and a member that is momentarily unreachable (hung,
+        # draining) keeps its already-collected spans on the timeline.
+        # {wid: {"events": deque, "epoch": float, "pid": int,
+        #        "cursor": Optional[int]}}
+        self._retention_events = max(16, int(retention_events))
+        self._trace_state: Dict[str, Dict[str, Any]] = {}
+        self._trace_lock = threading.Lock()
+        # Persistent keep-alive connections, one per member netloc: a
+        # scrape cycle is 2 GETs x N members — re-dialing TCP for each
+        # is the dominant per-poll cost on loopback. Guarded by a lock
+        # (http.client connections are not thread-safe).
+        self._conns: Dict[str, Any] = {}
+        self._conn_lock = threading.Lock()
+        # One membership lookup serves a whole metrics+trace cycle.
+        self._members_ttl_s = 0.5
+        self._members_cache: Tuple[float, Dict[str, str]] = (0.0, {})
+        # Status-only client: never joins, tight backoff — a dead
+        # coordinator should fail the fleet view fast, not hang it.
+        self._client = CoordinatorClient(
+            coordinator_address, worker_id="fleet-aggregator",
+            rpc_timeout_s=self.scrape_timeout_s,
+            backoff=Backoff(base_s=0.05, max_s=0.2, tries=2))
+        self._http = None
+        self.url: Optional[str] = None
+
+    # ---------------------------------------------------------- discovery
+
+    def members(self) -> Dict[str, str]:
+        """``{worker_id: base_url}`` for every scrapeable member.
+        Cached briefly (`_members_ttl_s`) so one status RPC serves a
+        whole metrics+trace scrape cycle."""
+        now = time.monotonic()
+        stamp, cached = self._members_cache
+        if cached and now - stamp < self._members_ttl_s:
+            return dict(cached)
+        doc = self._client.status()
+        out: Dict[str, str] = {}
+        for wid, d in doc.get("detail", {}).items():
+            role = str(d.get("role", ""))
+            if not role.startswith("replica") or "@" not in wid:
+                continue
+            addr = wid.rsplit("@", 1)[1]
+            out[wid] = f"http://{addr}"
+        murl = doc.get("metrics_url")
+        if murl:
+            out[f"coordinator@{self._client.host}:{self._client.port}"] = \
+                str(murl)
+        if self.local_worker_id is not None:
+            out.pop(self.local_worker_id, None)  # merged in-process
+        self._members_cache = (now, dict(out))
+        return out
+
+    # ------------------------------------------------------------ scraping
+
+    def _scrape_text(self, url: str) -> str:
+        """GET over a persistent per-member connection; one silent
+        re-dial absorbs a server-side keep-alive close or a member
+        restart on the same address."""
+        u = urllib.parse.urlsplit(url)
+        path = u.path + (f"?{u.query}" if u.query else "")
+        with self._conn_lock:
+            for attempt in (0, 1):
+                conn = self._conns.get(u.netloc)
+                if conn is None:
+                    conn = http.client.HTTPConnection(
+                        u.hostname, u.port, timeout=self.scrape_timeout_s)
+                    self._conns[u.netloc] = conn
+                try:
+                    conn.request("GET", path)
+                    resp = conn.getresponse()
+                    body = resp.read()
+                    if resp.status != 200:
+                        raise OSError(f"HTTP {resp.status} from {url}")
+                    return body.decode("utf-8")
+                except Exception:
+                    conn.close()
+                    self._conns.pop(u.netloc, None)
+                    if attempt:
+                        raise
+            raise OSError(f"unreachable: {url}")  # not reached
+
+    def federate_metrics(self) -> str:
+        """One fleet-wide Prometheus exposition: every member's families
+        merged under ``worker_id``, plus `UP_FAMILY` marking members
+        that failed to answer."""
+        texts: Dict[str, str] = {}
+        up: List[Tuple[str, int]] = []
+        if self.local_worker_id is not None:
+            texts[self.local_worker_id] = self._registry.to_prometheus()
+            up.append((self.local_worker_id, 1))
+        for wid, base in self.members().items():
+            try:
+                texts[wid] = self._scrape_text(base + "/metrics")
+                up.append((wid, 1))
+            except Exception:
+                up.append((wid, 0))
+        merged = merge_prometheus(texts)
+        lines = [f"# TYPE {UP_FAMILY} gauge"]
+        lines += [f'{UP_FAMILY}{{worker_id="{w}"}} {v}' for w, v in up]
+        return merged + "\n".join(lines) + "\n"
+
+    def _ingest_trace(self, wid: str, doc: Dict[str, Any]) -> None:
+        """Fold one `/api/trace` response into the accumulated per-worker
+        state. A response carrying ``seq`` is an incremental ring export:
+        its events append behind the stored ones. A response without
+        ``seq`` (foreign exporter) replaces the state wholesale. A
+        changed (epoch, pid) means the worker restarted — the old
+        incarnation's ring is gone, so start over.
+
+        Ingest does ALL per-event work (epoch alignment onto absolute
+        wall-clock microseconds, ``worker_id``/``pid`` tagging) exactly
+        once, so a federate_trace poll is concat + sort over ready
+        events — O(new events) of real work, not O(everything retained)
+        re-merged on every poll."""
+        epoch = float(doc.get("epochUnixUs", 0.0))
+        pid = doc.get("pid", 0)
+        seq = doc.get("seq")
+        st = self._trace_state.get(wid)
+        if (st is None or st["epoch"] != epoch or st["pid"] != pid
+                or seq is None):
+            st = {"events": deque(maxlen=self._retention_events),
+                  "epoch": epoch, "pid": pid, "cursor": None,
+                  "pids": set()}
+            self._trace_state[wid] = st
+        for ev in doc.get("traceEvents", []):
+            ev = dict(ev)
+            ev["ts"] = float(ev.get("ts", 0.0)) + epoch
+            ev.setdefault("pid", pid)
+            args = dict(ev.get("args") or {})
+            args.setdefault("worker_id", wid)
+            ev["args"] = args
+            st["pids"].add(ev["pid"])
+            st["events"].append(ev)
+        st["pids"].add(pid)
+        st["cursor"] = seq
+
+    def _scrape_trace(self, wid: str, base: str) -> None:
+        st = self._trace_state.get(wid)
+        cursor = st["cursor"] if st else None
+        url = base + "/api/trace"
+        if cursor is not None:
+            url += f"?since={cursor}"
+        doc = json.loads(self._scrape_text(url))
+        if isinstance(doc, dict):
+            self._ingest_trace(wid, doc)
+
+    def federate_trace(self) -> Dict[str, Any]:
+        """One fleet-wide Chrome trace on one wall-clock timeline (``ts``
+        in absolute unix microseconds — Perfetto-loadable like the
+        `merge_traces` output). Scrapes are incremental
+        (``?since=<seq>`` cursors), so a steady-state poll ships only
+        events recorded since the previous poll. Members that fail to
+        answer keep whatever spans were already collected — a hung
+        replica's history stays on the timeline and its late spans
+        appear once it answers again."""
+        with self._trace_lock:
+            if self.local_worker_id is not None:
+                st = self._trace_state.get(self.local_worker_id)
+                self._ingest_trace(
+                    self.local_worker_id,
+                    self._tracer.export_chrome(
+                        since=st["cursor"] if st else None))
+            for wid, base in self.members().items():
+                try:
+                    self._scrape_trace(wid, base)
+                except Exception:
+                    continue
+            meta: List[dict] = []
+            events: List[dict] = []
+            for wid, st in self._trace_state.items():
+                for p in sorted(st["pids"]):
+                    meta.append({"name": "process_name", "ph": "M",
+                                 "pid": p, "tid": 0, "args": {"name": wid}})
+                events.extend(st["events"])
+        events.sort(key=lambda e: e.get("ts", 0.0))
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+    # -------------------------------------------------------------- serve
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        """Mount the fleet view on its own HTTP port:
+
+        - ``GET /metrics``   federated Prometheus exposition
+        - ``GET /api/trace`` merged Chrome trace (Perfetto-loadable)
+        - ``GET /members``   current scrape targets
+        - ``GET /health``    aggregator liveness
+
+        Returns the base URL; `close()` stops it."""
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        agg = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # Keep-alive for the dashboards polling the fleet view.
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _send(self, body: bytes, ctype: str, code: int = 200):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                try:
+                    if self.path.startswith("/metrics"):
+                        self._send(agg.federate_metrics().encode(),
+                                   "text/plain; version=0.0.4")
+                    elif self.path.startswith("/api/trace"):
+                        self._send(
+                            json.dumps(agg.federate_trace()).encode(),
+                            "application/json")
+                    elif self.path.startswith("/members"):
+                        self._send(json.dumps(agg.members()).encode(),
+                                   "application/json")
+                    elif self.path.startswith("/health"):
+                        self._send(b'{"status": "ok"}', "application/json")
+                    else:
+                        self._send(b'{"error": "not found"}',
+                                   "application/json", 404)
+                except Exception as e:
+                    self._send(json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode(),
+                        "application/json", 502)
+
+        class Server(ThreadingHTTPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._http = Server((host, int(port)), Handler)
+        h, p = self._http.server_address[:2]
+        self.url = f"http://{h}:{p}"
+        threading.Thread(target=self._http.serve_forever,
+                         name="dl4j-fleet-aggregator", daemon=True).start()
+        return self.url
+
+    def close(self) -> None:
+        with self._conn_lock:
+            for conn in self._conns.values():
+                conn.close()
+            self._conns.clear()
+        if self._http is not None:
+            self._http.shutdown()
+            self._http.server_close()
+            self._http = None

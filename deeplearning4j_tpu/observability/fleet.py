@@ -47,3 +47,9 @@ def record_event(event: str, **fields) -> None:
         flight.record_event(f"fleet:{event}", **fields)
     except Exception:
         pass
+    try:
+        # Mirror onto the trace timeline: failovers and rolling updates
+        # render as instants next to request spans in a federated trace.
+        _obs.tracer.instant(f"fleet:{event}", cat="fleet", **fields)
+    except Exception:
+        pass

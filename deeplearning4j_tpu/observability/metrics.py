@@ -299,12 +299,19 @@ class MetricsRegistry:
             except Exception:
                 pass
 
-    def to_prometheus(self) -> str:
-        """Prometheus text format 0.0.4."""
-        self._run_collectors()
+    def to_prometheus(self, names: Optional[Sequence[str]] = None) -> str:
+        """Prometheus text format 0.0.4. `names` narrows the exposition
+        to the listed families — a needle scrape (the fleet router's load
+        poll) then costs O(requested families), not O(all families), and
+        skips the scrape-time collectors entirely."""
+        if names is None:
+            self._run_collectors()
         lines: List[str] = []
         with self._lock:
             fams = sorted(self._families.values(), key=lambda f: f.name)
+        if names is not None:
+            wanted = frozenset(names)
+            fams = [f for f in fams if f.name in wanted]
         for fam in fams:
             children = fam.children()
             if not children:
@@ -333,12 +340,18 @@ class MetricsRegistry:
                         f"{_fmt(child.get())}")
         return "\n".join(lines) + "\n"
 
-    def to_json(self) -> Dict[str, Any]:
-        """Structured snapshot (BENCH_out.json embedding, /metrics?format=json)."""
-        self._run_collectors()
+    def to_json(self, names: Optional[Sequence[str]] = None) -> Dict[str, Any]:
+        """Structured snapshot (BENCH_out.json embedding, /metrics?format=json).
+        `names` narrows to the listed families and skips collectors (see
+        `to_prometheus`)."""
+        if names is None:
+            self._run_collectors()
         out: Dict[str, Any] = {}
         with self._lock:
             fams = list(self._families.values())
+        if names is not None:
+            wanted = frozenset(names)
+            fams = [f for f in fams if f.name in wanted]
         for fam in fams:
             series = []
             for child in fam.children():

@@ -51,7 +51,9 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu import observability as _obs
 from deeplearning4j_tpu.observability import elastic as _ev
+from deeplearning4j_tpu.observability import propagate as _prop
 from deeplearning4j_tpu.util.retry import Backoff, RetryError
 
 
@@ -67,6 +69,27 @@ LOST_AFTER_S = _env_float("DL4J_TPU_ELASTIC_LOST_AFTER_S", 3 * HEARTBEAT_S)
 RPC_TIMEOUT_S = _env_float("DL4J_TPU_ELASTIC_RPC_TIMEOUT_S", 10.0)
 BARRIER_TIMEOUT_S = _env_float("DL4J_TPU_ELASTIC_BARRIER_TIMEOUT_S", 60.0)
 JOIN_GRACE_S = _env_float("DL4J_TPU_ELASTIC_JOIN_GRACE_S", 30.0)
+
+
+# The coordinator's own exposition (satellite of the observability
+# plane): fleet membership by role, lease-age distribution at heartbeat
+# refresh, and the generation — the three numbers that tell an operator
+# whether the cluster is stable without reading logs. Families are
+# process-global; each Coordinator refreshes them via a scrape-time
+# collector gated on its own liveness (the newest live coordinator wins,
+# which is the common one-coordinator-per-process case).
+_M_MEMBERS = _obs.metrics.gauge(
+    "dl4j_coordinator_members",
+    "Live coordinator members by declared role",
+    label_names=("role",))
+_M_LEASE_AGE = _obs.metrics.histogram(
+    "dl4j_coordinator_lease_age_seconds",
+    "Member lease age observed at each heartbeat refresh (a distribution "
+    "creeping toward lost_after_s means heartbeats barely outrun the "
+    "reaper)")
+_M_GENERATION = _obs.metrics.counter(
+    "dl4j_coordinator_generation",
+    "Current membership generation (bumps on every join/leave/eviction)")
 
 
 class ClusterChanged(Exception):
@@ -121,7 +144,8 @@ class Coordinator:
     collectives)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 lost_after_s: float = LOST_AFTER_S):
+                 lost_after_s: float = LOST_AFTER_S,
+                 metrics_port: Optional[int] = 0):
         self._cond = threading.Condition()
         self._members: Dict[str, float] = {}  # worker_id -> last_seen
         self._roles: Dict[str, str] = {}      # worker_id -> declared role
@@ -154,6 +178,10 @@ class Coordinator:
         self._server = Server((host, port), Handler)
         self.address = "%s:%d" % self._server.server_address[:2]
         self._threads: List[threading.Thread] = []
+        self._metrics_port = metrics_port
+        self._metrics_server = None
+        self.metrics_url: Optional[str] = None
+        self._metric_roles: set = set()
 
     # ------------------------------------------------------------ lifecycle
 
@@ -165,6 +193,9 @@ class Coordinator:
                              name="dl4j-coordinator-reaper", daemon=True)
         r.start()
         self._threads = [t, r]
+        _obs.metrics.register_collector(self._collect_metrics)
+        if self._metrics_port is not None:
+            self._start_metrics_http(self._metrics_port)
         return self
 
     def close(self) -> None:
@@ -173,6 +204,88 @@ class Coordinator:
             self._cond.notify_all()
         self._server.shutdown()
         self._server.server_close()
+        if self._metrics_server is not None:
+            self._metrics_server.shutdown()
+            self._metrics_server.server_close()
+            self._metrics_server = None
+
+    def _collect_metrics(self, reg) -> None:
+        """Scrape-time refresh of the coordinator families (registered
+        from `start()`; exits fast once this coordinator is closed)."""
+        if self._closed:
+            return
+        with self._cond:
+            roles = [self._roles.get(w, "trainer") for w in self._members]
+            gen = self._generation
+        counts: Dict[str, int] = {}
+        for r in roles:
+            counts[r] = counts.get(r, 0) + 1
+        # Zero out roles whose last member left, so a stale series never
+        # reports a phantom member.
+        for role in self._metric_roles | set(counts):
+            _M_MEMBERS.labels(role=role).set(float(counts.get(role, 0)))
+        self._metric_roles |= set(counts)
+        _M_GENERATION.set(float(gen))
+
+    def _start_metrics_http(self, port: int) -> None:
+        """The coordinator's own HTTP exposition (`/metrics`,
+        `/api/trace`): the JSON-line RPC port is not scrapeable by
+        Prometheus or the federation aggregator, this is. The URL is
+        advertised in every `status` response (`metrics_url`)."""
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        from urllib.parse import parse_qs, urlparse
+
+        class MetricsHandler(BaseHTTPRequestHandler):
+            # Keep-alive (see serving/http.py): the aggregator holds one
+            # persistent connection instead of a dial per poll.
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _send(self, body: bytes, ctype: str, code: int = 200):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                url = urlparse(self.path)
+                if url.path == "/metrics":
+                    q = parse_qs(url.query)
+                    fmt = (q.get("format") or ["prometheus"])[0]
+                    names = (q["names"][0].split(",") if q.get("names")
+                             else None)
+                    body, ctype = _obs.prometheus_payload(fmt, names=names)
+                    self._send(body, ctype)
+                elif url.path == "/api/trace":
+                    q = parse_qs(url.query)
+                    since = (int(q["since"][0]) if q.get("since")
+                             else None)
+                    self._send(
+                        json.dumps(
+                            _obs.tracer.export_chrome(since=since)
+                        ).encode(),
+                        "application/json")
+                elif url.path == "/health":
+                    self._send(b'{"status": "ok"}', "application/json")
+                else:
+                    self._send(b'{"error": "not found"}',
+                               "application/json", 404)
+
+        class MetricsServer(ThreadingHTTPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        host = self._server.server_address[0]
+        self._metrics_server = MetricsServer((host, int(port)),
+                                             MetricsHandler)
+        mhost, mport = self._metrics_server.server_address[:2]
+        self.metrics_url = f"http://{mhost}:{mport}"
+        threading.Thread(target=self._metrics_server.serve_forever,
+                         name="dl4j-coordinator-metrics",
+                         daemon=True).start()
 
     # ------------------------------------------------------------- faults
 
@@ -235,10 +348,19 @@ class Coordinator:
                 break
             time.sleep(min(remaining, 0.05))
         op = req.get("op")
+        # Remote-parent trace context: clients attach their thread-current
+        # context as a `trace` field, so coordinator ops nest under the
+        # caller's span in the federated timeline.
+        tctx = _prop.parse(req.pop(_prop.TRACE_FIELD, None))
         fn = getattr(self, "_op_" + str(op), None)
         if fn is None:
             return {"ok": False, "error": f"unknown op {op!r}"}
         try:
+            if tctx is not None:
+                with _obs.tracer.span(f"coordinator.{op}",
+                                      cat="coordinator", parent_ctx=tctx,
+                                      worker=req.get("worker")):
+                    return fn(req)
             return fn(req)
         except Exception as e:  # surface, don't kill the handler thread
             return {"ok": False, "error": f"{type(e).__name__}: {e}"}
@@ -292,7 +414,11 @@ class Coordinator:
         with self._cond:
             known = worker in self._members
             if known:
-                self._members[worker] = time.monotonic()
+                now = time.monotonic()
+                _M_LEASE_AGE.observe(
+                    max(0.0, now - max(self._members[worker],
+                                       self._hang_until)))
+                self._members[worker] = now
             doc = self._member_doc()
         doc.update(ok=True, known=known,
                    regen=int(req.get("gen", -1)) != doc["gen"])
@@ -323,6 +449,8 @@ class Coordinator:
                     "lease_age_s": round(max(0.0, now - max(seen, floor)), 4)}
                 for w, seen in self._members.items()}
             doc["lost_after_s"] = self.lost_after_s
+        if self.metrics_url is not None:
+            doc["metrics_url"] = self.metrics_url
         doc.update(ok=True)
         return doc
 
@@ -440,6 +568,12 @@ class CoordinatorClient:
 
     def _rpc_once(self, doc: Dict[str, Any],
                   timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        ctx = _prop.current()
+        if ctx is not None:
+            # The RPC-document twin of the X-DL4J-Trace header: the
+            # coordinator parents its op span under the caller's context.
+            doc = dict(doc)
+            doc[_prop.TRACE_FIELD] = ctx.to_header()
         with socket.create_connection(
                 (self.host, self.port),
                 timeout=timeout_s or self.rpc_timeout_s) as s:

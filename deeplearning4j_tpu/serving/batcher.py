@@ -32,6 +32,7 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from deeplearning4j_tpu import observability as _obs
+from deeplearning4j_tpu.observability import propagate as _prop
 from deeplearning4j_tpu.serving import metrics as _m
 from deeplearning4j_tpu.serving.errors import (
     InputValidationError,
@@ -126,7 +127,7 @@ def serving_feature_spec(net, warmup_shape=None):
 
 class _Pending:
     __slots__ = ("array", "event", "result", "error", "deadline",
-                 "cancelled")
+                 "cancelled", "ctx", "t_submit_ns")
 
     def __init__(self, array: np.ndarray,
                  deadline: Optional[float] = None):
@@ -136,6 +137,10 @@ class _Pending:
         self.error: Optional[str] = None
         self.deadline = deadline          # time.monotonic() instant or None
         self.cancelled = False            # set by an abandoning caller
+        # Trace context rides the queue item: the batch loop runs on its
+        # own thread, where the submitter's thread-local binding is gone.
+        self.ctx = _prop.current()
+        self.t_submit_ns = time.perf_counter_ns()
 
 
 class ShapeBucketBatcher:
@@ -260,6 +265,16 @@ class ShapeBucketBatcher:
 
     def _run_group(self, live: List[_Pending]) -> None:
         counts = [p.array.shape[0] for p in live]
+        # Traced requests get retroactive queue-wait spans (submit ->
+        # batch build) and a per-request device-dispatch span parented to
+        # the replica request span — untraced traffic skips all of it.
+        traced = [p for p in live if p.ctx is not None]
+        now_ns = time.perf_counter_ns()
+        for p in traced:
+            _obs.tracer.complete(
+                "serving.queue_wait", p.t_submit_ns,
+                now_ns - p.t_submit_ns, cat="serving",
+                parent_ctx=p.ctx, model=self.model_name)
         try:
             x = np.concatenate([p.array for p in live], axis=0)
             n = x.shape[0]
@@ -268,10 +283,17 @@ class ShapeBucketBatcher:
             if n < bucket:
                 pad = np.zeros((bucket - n,) + x.shape[1:], x.dtype)
                 x = np.concatenate([x, pad], axis=0)
+            t_fwd = time.perf_counter_ns()
             with _obs.tracer.span("serving.batch", cat="serving",
                                   model=self.model_name, requests=len(live),
                                   rows=n, padded_to=bucket):
                 preds = self._forward(x)[:n]
+            dur_fwd = time.perf_counter_ns() - t_fwd
+            for p in traced:
+                _obs.tracer.complete(
+                    "serving.device_dispatch", t_fwd, dur_fwd,
+                    cat="serving", parent_ctx=p.ctx,
+                    model=self.model_name, rows=n, padded_to=bucket)
             off = 0
             for p, c in zip(live, counts):
                 p.result = preds[off:off + c]

@@ -269,6 +269,12 @@ class ReplicaServer:
         self._finish_drain(timeout_s)
 
     def _finish_drain(self, timeout_s: Optional[float] = None) -> None:
+        with _obs.tracer.span("fleet.drain", cat="fleet",
+                              replica=self.name):
+            self._finish_drain_inner(timeout_s)
+
+    def _finish_drain_inner(self,
+                            timeout_s: Optional[float] = None) -> None:
         _fev.record_event("replica_draining", replica=self.name)
         if self.client is not None:
             try:
@@ -293,6 +299,11 @@ class ReplicaServer:
         failed swap restores the previous checkpoint and rejoins, so a
         bad deploy never takes the replica out of rotation; the result
         carries ``ok=False`` so the rollout can abort."""
+        with _obs.tracer.span("fleet.reload", cat="fleet",
+                              replica=self.name, path=str(path)):
+            return self._reload_inner(path, warm=warm)
+
+    def _reload_inner(self, path, warm: bool = True) -> Dict[str, Any]:
         t0 = time.monotonic()
         c0 = compiles_total()
         with self._cond:

@@ -35,6 +35,7 @@ from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
 from deeplearning4j_tpu import observability as _obs
+from deeplearning4j_tpu.observability import propagate as _prop
 from deeplearning4j_tpu.serving.errors import ServingError
 
 
@@ -42,6 +43,13 @@ def make_handler(server):
     """Build the request-handler class bound to one `InferenceServer`."""
 
     class Handler(BaseHTTPRequestHandler):
+        # Keep-alive: the federation aggregator (and the router's load
+        # poll) scrape this surface continuously — re-dialing TCP and
+        # spawning a fresh handler thread per poll is pure overhead.
+        # Every response path sets Content-Length, which HTTP/1.1
+        # persistence requires.
+        protocol_version = "HTTP/1.1"
+
         def log_message(self, *args):
             pass
 
@@ -84,19 +92,29 @@ def make_handler(server):
             elif url.path == "/metrics":
                 q = parse_qs(url.query)
                 fmt = (q.get("format") or ["prometheus"])[0]
-                body, ctype = _obs.prometheus_payload(fmt)
+                names = (q["names"][0].split(",") if q.get("names")
+                         else None)
+                body, ctype = _obs.prometheus_payload(fmt, names=names)
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+            elif url.path == "/api/trace":
+                # This process's span ring, scrape-able by the federation
+                # aggregator (same shape the UIServer exports). `since`
+                # is the incremental cursor: only events recorded after
+                # that `seq` are shipped.
+                q = parse_qs(url.query)
+                since = int(q["since"][0]) if q.get("since") else None
+                self._json(_obs.tracer.export_chrome(since=since))
             elif url.path == "/v1/models":
                 self._json({"models": server.models.snapshot()})
             else:
                 self._json({"error": "not found",
                             "routes": ["/health", "/healthz", "/metrics",
-                                       "/v1/models", "/predict",
-                                       "/generate"]}, 404)
+                                       "/api/trace", "/v1/models",
+                                       "/predict", "/generate"]}, 404)
 
         # ------------------------------------------------------------ POST
 
@@ -146,49 +164,59 @@ def make_handler(server):
             replica.on_request(route)
             return replica
 
+        def _trace_span(self, route: str):
+            """Replica-side request span, parented to the caller's context
+            when the request carried an ``X-DL4J-Trace`` header (the
+            router attaches one per attempt)."""
+            rctx = _prop.parse(self.headers.get(_prop.TRACE_HEADER))
+            return _obs.tracer.span(f"replica.{route}", cat="serving",
+                                    parent_ctx=rctx, route=route)
+
         def _post_predict(self):
-            admitted = None
-            try:
-                payload = self._payload()
-                name = payload.get("model")
-                warming = self._check_ready(name)
-                if warming is not None:
-                    return self._json(warming, 503,
-                                      headers={"Retry-After": "1"})
-                admitted = self._admit("predict")
-                preds = server.predict(payload["data"], model=name,
-                                       timeout_s=self._timeout_s(payload))
-            except Exception as e:
-                return self._error(e)
-            finally:
-                if admitted is not None:
-                    admitted.request_done()
-            self._json({"predictions": preds.tolist()})
+            with self._trace_span("predict") as sp, _prop.bound(sp.ctx()):
+                admitted = None
+                try:
+                    payload = self._payload()
+                    name = payload.get("model")
+                    warming = self._check_ready(name)
+                    if warming is not None:
+                        return self._json(warming, 503,
+                                          headers={"Retry-After": "1"})
+                    admitted = self._admit("predict")
+                    preds = server.predict(
+                        payload["data"], model=name,
+                        timeout_s=self._timeout_s(payload))
+                except Exception as e:
+                    return self._error(e)
+                finally:
+                    if admitted is not None:
+                        admitted.request_done()
+                self._json({"predictions": preds.tolist()})
 
         def _post_generate(self):
-            admitted = None
-            try:
-                payload = self._payload()
-                name = payload.get("model")
-                warming = self._check_ready(name)
-                if warming is not None:
-                    return self._json(warming, 503,
-                                      headers={"Retry-After": "1"})
-                sampling = {k: payload[k] for k in
-                            ("temperature", "top_k", "top_p", "seed",
-                             "eos_id") if k in payload}
-                admitted = self._admit("generate")
-                ids = server.generate(payload["prompt_ids"],
-                                      int(payload["n_steps"]),
-                                      model=name,
-                                      timeout_s=self._timeout_s(payload),
-                                      **sampling)
-            except Exception as e:
-                return self._error(e)
-            finally:
-                if admitted is not None:
-                    admitted.request_done()
-            self._json({"ids": [int(t) for t in ids]})
+            with self._trace_span("generate") as sp, _prop.bound(sp.ctx()):
+                admitted = None
+                try:
+                    payload = self._payload()
+                    name = payload.get("model")
+                    warming = self._check_ready(name)
+                    if warming is not None:
+                        return self._json(warming, 503,
+                                          headers={"Retry-After": "1"})
+                    sampling = {k: payload[k] for k in
+                                ("temperature", "top_k", "top_p", "seed",
+                                 "eos_id") if k in payload}
+                    admitted = self._admit("generate")
+                    ids = server.generate(
+                        payload["prompt_ids"], int(payload["n_steps"]),
+                        model=name, timeout_s=self._timeout_s(payload),
+                        **sampling)
+                except Exception as e:
+                    return self._error(e)
+                finally:
+                    if admitted is not None:
+                        admitted.request_done()
+                self._json({"ids": [int(t) for t in ids]})
 
         # ----------------------------------------------------------- admin
 

@@ -54,6 +54,7 @@ import numpy as np
 
 from deeplearning4j_tpu import observability as _obs
 from deeplearning4j_tpu.observability import fleet as _fev
+from deeplearning4j_tpu.observability import propagate as _prop
 from deeplearning4j_tpu.parallel.coordinator import CoordinatorClient
 from deeplearning4j_tpu.serving import metrics as _m
 from deeplearning4j_tpu.serving.errors import (
@@ -100,13 +101,18 @@ class _Failover(Exception):
 # ------------------------------------------------------------- http utils
 
 
-def post_json(url: str, payload: dict, timeout_s: float) -> dict:
+def post_json(url: str, payload: dict, timeout_s: float,
+              headers: Optional[Dict[str, str]] = None) -> dict:
     """POST JSON -> parsed JSON body, with an EXPLICIT socket timeout on
     every call (JX012: an unbounded request path turns one hung replica
-    into a hung fleet)."""
+    into a hung fleet). The thread-current trace context is forwarded on
+    the X-DL4J-Trace header automatically (JX013), so every hop made
+    through this helper stays on the request's cross-process timeline."""
+    all_headers = _prop.trace_headers(headers)
+    all_headers.setdefault("Content-Type", "application/json")
     req = urllib.request.Request(
         url, data=json.dumps(payload).encode("utf-8"),
-        headers={"Content-Type": "application/json"}, method="POST")
+        headers=all_headers, method="POST")
     with urllib.request.urlopen(req, timeout=timeout_s) as resp:
         return json.loads(resp.read().decode("utf-8"))
 
@@ -131,6 +137,24 @@ def _unwrap(e: BaseException) -> BaseException:
             and isinstance(e.reason, BaseException):
         return e.reason
     return e
+
+
+def sum_metric_snapshot(doc: dict, names) -> float:
+    """Sum every series value of the named families out of a
+    `/metrics?format=json` snapshot (the narrow-scrape fast path: the
+    replica serialized ONLY the requested families, so neither side's
+    cost scales with how many families the process hosts)."""
+    total = 0.0
+    for name in names:
+        fam = doc.get(name)
+        if not isinstance(fam, dict):
+            continue
+        for series in fam.get("series", ()):
+            try:
+                total += float(series.get("value", 0.0))
+            except (TypeError, ValueError):
+                pass
+    return total
 
 
 def sum_metric_families(text: str, names) -> float:
@@ -233,6 +257,22 @@ class FleetRouter:
         self._poll_thread: Optional[threading.Thread] = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._http_thread: Optional[threading.Thread] = None
+        self._aggregator = None
+
+    # ----------------------------------------------------------- federation
+
+    def aggregator(self):
+        """The fleet-wide observability aggregator, built lazily on the
+        router's own coordinator membership (`observability/federation`).
+        Backs the HTTP front's `/fleet/metrics` and `/api/trace`."""
+        if self._aggregator is None:
+            from deeplearning4j_tpu.observability import federation as _fed
+
+            self._aggregator = _fed.FleetAggregator(
+                self.coordinator_address,
+                scrape_timeout_s=self.scrape_timeout_s,
+                local_worker_id=f"fleet-router@{self.host}:{self.port}")
+        return self._aggregator
 
     # ------------------------------------------------------------ lifecycle
 
@@ -285,22 +325,37 @@ class FleetRouter:
                 # may still be serving; the request path finds out.
                 pass
 
+    # The two SLO gauges one load score is computed from. The poll asks
+    # the replica for ONLY these (narrow JSON snapshot) — scraping and
+    # re-parsing the full exposition per poll made poll cost scale with
+    # every metric family any subsystem ever registered.
+    _LOAD_FAMILIES = ("dl4j_serving_model_queue_depth",
+                      "dl4j_serving_decode_slots_busy")
+    _LOAD_QUERY = "/metrics?format=json&names=" + ",".join(_LOAD_FAMILIES)
+
     def poll_once(self) -> None:
         """Rebuild the routing table from coordinator membership, then
         refresh each live replica's load score from its own /metrics."""
         live = self._refresh_membership()
         for info in live:
             try:
-                text = get_text(info.url + "/metrics",
-                                timeout_s=self.scrape_timeout_s)
-                info.load = sum_metric_families(
-                    text, ("dl4j_serving_model_queue_depth",
-                           "dl4j_serving_decode_slots_busy"))
+                info.load = self._scrape_load(info)
                 info.scrape_ok = True
             except Exception:
                 # Keep the stale score; the request path (timeout +
                 # quarantine) is the authority on a broken replica.
                 info.scrape_ok = False
+
+    def _scrape_load(self, info: ReplicaInfo) -> float:
+        text = get_text(info.url + self._LOAD_QUERY,
+                        timeout_s=self.scrape_timeout_s)
+        try:
+            doc = json.loads(text)
+        except ValueError:
+            # A /metrics that ignored the query params (non-registry
+            # endpoint): fall back to the full-exposition parse.
+            return sum_metric_families(text, self._LOAD_FAMILIES)
+        return sum_metric_snapshot(doc, self._LOAD_FAMILIES)
 
     def _refresh_membership(self) -> List[ReplicaInfo]:
         """One coordinator status RPC -> new routing table; returns the
@@ -431,6 +486,19 @@ class FleetRouter:
 
     def _request(self, route: str, payload: dict,
                  timeout_s: Optional[float], idempotent: bool) -> dict:
+        # Mint the request's trace context: this span is the ROOT of the
+        # cross-process tree, its (trace_id, span_id) travel to every
+        # replica attempt on the X-DL4J-Trace header (post_json reads the
+        # binding), and replica-side spans parent to it in the federated
+        # timeline — across failover, across processes.
+        ctx = _prop.mint()
+        with _obs.tracer.span(f"router.{route}", cat="fleet",
+                              span_ctx=ctx, route=route), _prop.bound(ctx):
+            return self._request_inner(route, payload, timeout_s,
+                                       idempotent)
+
+    def _request_inner(self, route: str, payload: dict,
+                       timeout_s: Optional[float], idempotent: bool) -> dict:
         budget = (self.request_timeout_s if timeout_s is None
                   else float(timeout_s))
         t0 = time.monotonic()
@@ -471,8 +539,13 @@ class FleetRouter:
             with self._lock:
                 self._inflight[wid] = self._inflight.get(wid, 0) + 1
             try:
-                return post_json(rep.url + "/" + route, payload,
-                                 timeout_s=attempt_budget)
+                # Each attempt is its own child span: a failover renders
+                # as N attempt spans (the failed ones carry `error`)
+                # under one router.<route> root.
+                with _obs.tracer.span("router.attempt", cat="fleet",
+                                      replica=rep.name):
+                    return post_json(rep.url + "/" + route, payload,
+                                     timeout_s=attempt_budget)
             except urllib.error.HTTPError as e:
                 body = _error_body(e)
                 if e.code == 503:
@@ -571,6 +644,10 @@ def _make_router_handler(router: FleetRouter):
     replica exposes, so clients can't tell they moved behind a fleet."""
 
     class Handler(BaseHTTPRequestHandler):
+        # Keep-alive (see serving/http.py): scrapers hold one persistent
+        # connection instead of a dial per poll.
+        protocol_version = "HTTP/1.1"
+
         def log_message(self, *args):
             pass
 
@@ -608,15 +685,39 @@ def _make_router_handler(router: FleetRouter):
             elif url.path == "/metrics":
                 q = parse_qs(url.query)
                 fmt = (q.get("format") or ["prometheus"])[0]
-                body, ctype = _obs.prometheus_payload(fmt)
+                names = (q["names"][0].split(",") if q.get("names")
+                         else None)
+                body, ctype = _obs.prometheus_payload(fmt, names=names)
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+            elif url.path == "/fleet/metrics":
+                # Fleet-wide federation: every live member's families
+                # merged under a worker_id label.
+                try:
+                    body = router.aggregator().federate_metrics().encode()
+                except Exception as e:
+                    return self._json(
+                        {"error": f"{type(e).__name__}: {e}"}, 502)
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif url.path == "/api/trace":
+                # Merged fleet timeline (Perfetto-loadable): the router's
+                # own span ring plus every member's.
+                try:
+                    self._json(router.aggregator().federate_trace())
+                except Exception as e:
+                    self._json({"error": f"{type(e).__name__}: {e}"}, 502)
             else:
                 self._json({"error": "not found",
                             "routes": ["/health", "/fleet", "/metrics",
+                                       "/fleet/metrics", "/api/trace",
                                        "/predict", "/generate"]}, 404)
 
         def _payload(self) -> dict:

@@ -28,6 +28,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from deeplearning4j_tpu import observability as _obs
+from deeplearning4j_tpu.observability import propagate as _prop
 from deeplearning4j_tpu.serving import metrics as _m
 from deeplearning4j_tpu.serving.errors import (
     InputValidationError,
@@ -59,7 +60,7 @@ def prompt_bucket_ladder(capacity: int,
 class GenerationRequest:
     __slots__ = ("prompt", "n_steps", "temperature", "top_k", "top_p",
                  "seed", "eos_id", "ids", "error", "deadline", "cancelled",
-                 "event", "t_submit", "rng")
+                 "event", "t_submit", "rng", "ctx", "t_submit_ns")
 
     def __init__(self, prompt, n_steps, *, temperature=1.0, top_k=0,
                  top_p=0.0, seed=0, eos_id=None, deadline=None):
@@ -77,6 +78,10 @@ class GenerationRequest:
         self.event = threading.Event()
         self.t_submit = time.monotonic()
         self.rng = np.random.RandomState(self.seed)
+        # Trace context rides the request object into the decode-loop
+        # thread (the submitter's thread-local binding stops at submit).
+        self.ctx = _prop.current()
+        self.t_submit_ns = time.perf_counter_ns()
 
     @property
     def done(self) -> bool:
@@ -211,10 +216,22 @@ class GenerationScheduler:
         stays active in `slot` (False: finished or failed at admission)."""
         pad_to = next(b for b in self.prompt_buckets
                       if len(req.prompt) <= b)
+        if req.ctx is not None:
+            # Retroactive admission-wait span: submit -> this step
+            # boundary, parented to the replica request span.
+            _obs.tracer.complete(
+                "serving.admission_wait", req.t_submit_ns,
+                time.perf_counter_ns() - req.t_submit_ns, cat="serving",
+                parent_ctx=req.ctx, model=self.model_name)
         try:
-            probs, slot_state, n = self.stepper.prefill(req.prompt,
-                                                        pad_to=pad_to)
-            self.stepper.install(slot, slot_state, n)
+            # parent_ctx is explicit: the decode-loop thread has no
+            # enclosing span stack to inherit from.
+            with _obs.tracer.span("serving.prefill", cat="serving",
+                                  parent_ctx=req.ctx,
+                                  model=self.model_name, pad_to=pad_to):
+                probs, slot_state, n = self.stepper.prefill(req.prompt,
+                                                            pad_to=pad_to)
+                self.stepper.install(slot, slot_state, n)
         except Exception as e:
             req.error = f"{type(e).__name__}: {e}"
             req.event.set()
@@ -283,9 +300,16 @@ class GenerationScheduler:
                 continue
             tokens = [active[s].ids[-1] if s in active else 0
                       for s in range(self.slots)]
-            t0 = time.perf_counter()
+            t0_ns = time.perf_counter_ns()
             probs = self.stepper.step(tokens)
-            step_hist.observe(time.perf_counter() - t0)
+            dur_ns = time.perf_counter_ns() - t0_ns
+            step_hist.observe(dur_ns / 1e9)
+            for req in active.values():
+                if req.ctx is not None:
+                    _obs.tracer.complete(
+                        "serving.decode_step", t0_ns, dur_ns,
+                        cat="serving", parent_ctx=req.ctx,
+                        model=self.model_name)
             now = time.monotonic()
             for slot, req in list(active.items()):
                 if req.cancelled or (req.deadline is not None

@@ -488,6 +488,18 @@ class _Handler(BaseHTTPRequestHandler):
     storage: Optional[StatsStorage] = None
     enable_remote: bool = False
     tsne_data: Optional[dict] = None  # latest uploaded t-SNE coords
+    coordinator_address: Optional[str] = None  # fleet federation source
+    _fleet_agg = None  # lazily built FleetAggregator
+
+    @classmethod
+    def _fleet_aggregator(cls):
+        if cls.coordinator_address is None:
+            return None
+        if cls._fleet_agg is None:
+            from deeplearning4j_tpu.observability import federation as _fed
+
+            cls._fleet_agg = _fed.FleetAggregator(cls.coordinator_address)
+        return cls._fleet_agg
 
     def log_message(self, *args):  # quiet
         pass
@@ -596,6 +608,25 @@ class _Handler(BaseHTTPRequestHandler):
             from deeplearning4j_tpu import observability as obs
 
             self._json(obs.tracer.export_chrome())
+        elif url.path in ("/api/fleet/metrics", "/api/fleet/trace"):
+            agg = type(self)._fleet_aggregator()
+            if agg is None:
+                return self._json(
+                    {"error": "no coordinator attached "
+                              "(UIServer(coordinator_address=...))"}, 503)
+            try:
+                if url.path.endswith("/metrics"):
+                    body = agg.federate_metrics().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self._json(agg.federate_trace())
+            except Exception as e:
+                self._json({"error": f"{type(e).__name__}: {e}"}, 502)
         elif url.path == "/api/flight":
             from deeplearning4j_tpu import observability as obs
 
@@ -615,6 +646,7 @@ _ROUTES = [
     "/", "/histogram", "/model", "/system", "/flow", "/tsne",
     "/activations", "/metrics", "/api", "/api/sessions", "/api/static",
     "/api/updates", "/api/tsne", "/api/trace", "/api/flight", "/api/memory",
+    "/api/fleet/metrics", "/api/fleet/trace",
     "POST /remote", "POST /api/tsne",
 ]
 
@@ -623,13 +655,16 @@ class UIServer:
     """Reference: `PlayUIServer` / `UIServer.getInstance()`."""
 
     def __init__(self, port: int = 9000, host: str = "127.0.0.1",
-                 enable_remote: bool = False):
+                 enable_remote: bool = False,
+                 coordinator_address: Optional[str] = None):
         self.host = host
         self.port = port
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._handler = type("BoundHandler", (_Handler,),
-                             {"enable_remote": bool(enable_remote)})
+                             {"enable_remote": bool(enable_remote),
+                              "coordinator_address": coordinator_address,
+                              "_fleet_agg": None})
 
     def attach(self, storage: StatsStorage) -> "UIServer":
         self._handler.storage = storage

@@ -1,0 +1,514 @@
+"""Cross-process observability plane (`observability/propagate.py` +
+`observability/federation.py`).
+
+Acceptance coverage for the observability-plane PR:
+
+- trace-context propagation: W3C-style header mint/parse roundtrip,
+  thread-local binding, remote-parent spans in the tracer;
+- metrics federation: per-worker expositions merge under `worker_id`
+  with valid family grouping; trace rings merge onto one wall-clock
+  aligned Perfetto timeline;
+- the coordinator exposes its own membership/lease/generation families
+  and an HTTP `/metrics` advertised via `status.metrics_url`;
+- the router's narrow load scrape (`?names=`) costs O(requested
+  families) — its payload must not change as unrelated families are
+  added, and scrape-time collectors must not run;
+- `dl4j_build_info` identifies every process in a federated scrape;
+- the real-fleet drill: a 3-process fleet (router in-proc + two replica
+  subprocesses) under a hang fault produces ONE merged trace in which a
+  single router request span parents replica spans from two DIFFERENT
+  replica PIDs (the failover), and one federated scrape carries
+  `dl4j_requests_total` from every replica worker_id.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from deeplearning4j_tpu import (MultiLayerNetwork, NeuralNetConfiguration,
+                                observability as obs)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.observability import federation as fed
+from deeplearning4j_tpu.observability import propagate as prop
+from deeplearning4j_tpu.observability.metrics import MetricsRegistry
+from deeplearning4j_tpu.observability.tracing import Tracer
+from deeplearning4j_tpu.parallel.coordinator import (
+    Coordinator,
+    CoordinatorClient,
+)
+from deeplearning4j_tpu.serving import FleetManager, FleetRouter
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def mlp_net(seed=1, n_in=3, n_out=2):
+    return MultiLayerNetwork(
+        (NeuralNetConfiguration.builder()
+         .seed(seed).learning_rate(0.1).weight_init("xavier")
+         .list()
+         .layer(DenseLayer(n_out=4, activation="tanh"))
+         .layer(OutputLayer(n_out=n_out, activation="softmax",
+                            loss_function="mcxent"))
+         .set_input_type(InputType.feed_forward(n_in))
+         .build())).init()
+
+
+def _save(net, path):
+    from deeplearning4j_tpu.checkpoint.manager import CheckpointManager
+
+    CheckpointManager(str(path), async_save=False).save(net)
+    return str(path)
+
+
+def _sub_env(plan=None):
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    if plan is not None:
+        env["DL4J_TPU_FAULT_PLAN"] = json.dumps(plan)
+    return env
+
+
+def _wait(predicate, timeout_s, every_s=0.1, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(every_s)
+    raise AssertionError(f"timed out after {timeout_s}s waiting for {what}")
+
+
+# ------------------------------------------------------------ propagation
+
+
+class TestTraceContext:
+    def test_header_roundtrip(self):
+        ctx = prop.mint()
+        assert len(ctx.trace_id) == 32 and len(ctx.span_id) == 16
+        parsed = prop.parse(ctx.to_header())
+        assert parsed == ctx
+
+    def test_parse_rejects_garbage(self):
+        assert prop.parse(None) is None
+        assert prop.parse("") is None
+        assert prop.parse("nonsense") is None
+        assert prop.parse("00-xyz-abc-01") is None
+        # all-zero ids are invalid per the W3C traceparent grammar
+        assert prop.parse("00-" + "0" * 32 + "-" + "a" * 16 + "-01") is None
+        assert prop.parse("00-" + "a" * 32 + "-" + "0" * 16 + "-01") is None
+
+    def test_child_keeps_trace_id(self):
+        ctx = prop.mint()
+        child = ctx.child()
+        assert child.trace_id == ctx.trace_id
+        assert child.span_id != ctx.span_id
+
+    def test_bound_installs_and_restores(self):
+        assert prop.current() is None
+        outer, inner = prop.mint(), prop.mint()
+        with prop.bound(outer):
+            assert prop.current() == outer
+            with prop.bound(inner):
+                assert prop.current() == inner
+            assert prop.current() == outer
+            with prop.bound(None):  # explicit clear for a block
+                assert prop.current() is None
+            assert prop.current() == outer
+        assert prop.current() is None
+
+    def test_trace_headers_reads_binding(self):
+        assert prop.trace_headers() == {}
+        ctx = prop.mint()
+        with prop.bound(ctx):
+            h = prop.trace_headers({"Content-Type": "application/json"})
+            assert h[prop.TRACE_HEADER] == ctx.to_header()
+            assert h["Content-Type"] == "application/json"
+
+    def test_context_crosses_threads_via_explicit_capture(self):
+        # The binding is thread-local: a worker thread sees None unless
+        # the queue item carried the context (the batcher/scheduler
+        # pattern).
+        ctx = prop.mint()
+        seen = {}
+
+        def worker(captured):
+            seen["current"] = prop.current()
+            seen["captured"] = captured
+
+        with prop.bound(ctx):
+            t = threading.Thread(target=worker, args=(prop.current(),))
+            t.start()
+            t.join()
+        assert seen["current"] is None
+        assert seen["captured"] == ctx
+
+
+class TestRemoteParentSpans:
+    def test_span_ctx_fixes_identity(self):
+        tr = Tracer(max_events=64)
+        ctx = prop.mint()
+        with tr.span("root", span_ctx=ctx):
+            pass
+        ev = tr.events()[-1]
+        assert ev["args"]["trace_id"] == ctx.trace_id
+        assert ev["args"]["span_id"] == ctx.span_id
+        assert "parent_span_id" not in ev["args"]
+
+    def test_parent_ctx_mints_child_under_remote_parent(self):
+        tr = Tracer(max_events=64)
+        remote = prop.mint()
+        with tr.span("child", parent_ctx=remote) as sp:
+            child_ctx = sp.ctx()
+        ev = tr.events()[-1]
+        assert ev["args"]["trace_id"] == remote.trace_id
+        assert ev["args"]["parent_span_id"] == remote.span_id
+        assert ev["args"]["span_id"] == child_ctx.span_id
+        assert child_ctx.span_id != remote.span_id
+
+    def test_nested_local_span_inherits_trace(self):
+        tr = Tracer(max_events=64)
+        ctx = prop.mint()
+        with tr.span("outer", span_ctx=ctx):
+            with tr.span("inner"):
+                pass
+        inner, outer = tr.events()[-2], tr.events()[-1]
+        assert inner["name"] == "inner" and outer["name"] == "outer"
+        assert inner["args"]["trace_id"] == ctx.trace_id
+        assert inner["args"]["parent_span_id"] == ctx.span_id
+        assert inner["args"]["parent"] == "outer"  # name back-compat
+
+    def test_complete_records_retroactive_span(self):
+        tr = Tracer(max_events=64)
+        remote = prop.mint()
+        t0 = time.perf_counter_ns()
+        tr.complete("queue_wait", t0, 2_000_000, parent_ctx=remote,
+                    model="m")
+        ev = tr.events()[-1]
+        assert ev["ph"] == "X"
+        assert abs(ev["dur"] - 2000.0) < 1e-6  # 2ms in µs
+        assert ev["args"]["trace_id"] == remote.trace_id
+        assert ev["args"]["parent_span_id"] == remote.span_id
+
+    def test_export_carries_merge_keys(self):
+        tr = Tracer(max_events=64)
+        doc = tr.export_chrome()
+        assert doc["pid"] == os.getpid()
+        # epoch anchor is wall-clock microseconds, sane magnitude
+        assert doc["epochUnixUs"] > 1e15
+
+
+# -------------------------------------------------------------- federation
+
+
+class TestMergePrometheus:
+    def test_worker_id_injected_and_families_grouped(self):
+        merged = fed.merge_prometheus({
+            "w1@h:1": ("# HELP dl4j_x total\n# TYPE dl4j_x counter\n"
+                       'dl4j_x{route="a"} 3\ndl4j_x 1\n'),
+            "w2@h:2": ("# TYPE dl4j_x counter\ndl4j_x{route=\"a\"} 5\n"
+                       "# TYPE dl4j_y gauge\ndl4j_y 2\n"),
+        })
+        lines = merged.strip().splitlines()
+        assert lines.count("# TYPE dl4j_x counter") == 1
+        assert lines.count("# HELP dl4j_x total") == 1
+        assert 'dl4j_x{worker_id="w1@h:1",route="a"} 3' in lines
+        assert 'dl4j_x{worker_id="w1@h:1"} 1' in lines
+        assert 'dl4j_x{worker_id="w2@h:2",route="a"} 5' in lines
+        assert 'dl4j_y{worker_id="w2@h:2"} 2' in lines
+        # exposition validity: all of a family's samples are contiguous
+        # under its single TYPE line
+        x_type = lines.index("# TYPE dl4j_x counter")
+        y_type = lines.index("# TYPE dl4j_y gauge")
+        x_samples = [i for i, l in enumerate(lines)
+                     if l.startswith("dl4j_x")]
+        assert all(x_type < i < y_type for i in x_samples)
+
+    def test_histogram_suffixes_stay_in_family(self):
+        reg = MetricsRegistry()
+        reg.histogram("dl4j_t_seconds", "t", buckets=(0.1, 1.0)).observe(0.5)
+        merged = fed.merge_prometheus({"w@h:1": reg.to_prometheus()})
+        assert 'dl4j_t_seconds_bucket{worker_id="w@h:1",le="1"} 1' in merged
+        assert 'dl4j_t_seconds_count{worker_id="w@h:1"} 1' in merged
+        assert merged.count("# TYPE dl4j_t_seconds histogram") == 1
+
+
+class TestMergeTraces:
+    def test_timelines_align_on_epoch(self):
+        docs = {
+            "w1": {"traceEvents": [
+                {"name": "a", "ph": "X", "ts": 5.0, "dur": 1.0,
+                 "pid": 10, "tid": 1, "args": {}}],
+                "epochUnixUs": 1000.0, "pid": 10},
+            "w2": {"traceEvents": [
+                {"name": "b", "ph": "X", "ts": 5.0, "dur": 1.0,
+                 "pid": 20, "tid": 1, "args": {}}],
+                "epochUnixUs": 1500.0, "pid": 20},
+        }
+        merged = fed.merge_traces(docs)
+        evs = {e["name"]: e for e in merged["traceEvents"]
+               if e.get("ph") == "X"}
+        # earliest epoch is the shared zero; w2 shifts +500µs
+        assert evs["a"]["ts"] == 5.0
+        assert evs["b"]["ts"] == 505.0
+        assert evs["a"]["args"]["worker_id"] == "w1"
+        names = {(e["pid"], e["args"]["name"])
+                 for e in merged["traceEvents"] if e.get("ph") == "M"}
+        assert names == {(10, "w1"), (20, "w2")}
+
+    def test_merged_doc_is_chrome_loadable(self):
+        tr = Tracer(max_events=64)
+        with tr.span("x"):
+            pass
+        merged = fed.merge_traces({"w": tr.export_chrome()})
+        # loadable: serializes, and every event has the required keys
+        body = json.loads(json.dumps(merged))
+        assert body["traceEvents"]
+        for ev in body["traceEvents"]:
+            assert "name" in ev and "ph" in ev and "pid" in ev
+
+
+# ----------------------------------------------- coordinator /metrics
+
+
+class TestCoordinatorMetrics:
+    def test_families_and_http_exposition(self):
+        coord = Coordinator(lost_after_s=10.0).start()
+        try:
+            c1 = CoordinatorClient(coord.address, "t1@h:1", role="trainer")
+            c2 = CoordinatorClient(coord.address, "r1@h:2", role="replica")
+            c1.join()
+            c2.join()
+            c2.heartbeat()  # lease-age observation
+            st = c1.status()
+            assert st["metrics_url"] == coord.metrics_url
+            import urllib.request
+
+            text = urllib.request.urlopen(
+                coord.metrics_url + "/metrics", timeout=2).read().decode()
+            assert 'dl4j_coordinator_members{role="trainer"} 1' in text
+            assert 'dl4j_coordinator_members{role="replica"} 1' in text
+            assert "dl4j_coordinator_generation 2" in text
+            assert "dl4j_coordinator_lease_age_seconds_count" in text
+            # the narrow form works on the coordinator surface too
+            narrow = urllib.request.urlopen(
+                coord.metrics_url
+                + "/metrics?names=dl4j_coordinator_generation",
+                timeout=2).read().decode()
+            assert narrow.strip().splitlines() == [
+                "# HELP dl4j_coordinator_generation Current membership "
+                "generation (bumps on every join/leave/eviction)",
+                "# TYPE dl4j_coordinator_generation counter",
+                "dl4j_coordinator_generation 2"]
+            doc = json.loads(urllib.request.urlopen(
+                coord.metrics_url + "/api/trace", timeout=2).read())
+            assert "traceEvents" in doc
+        finally:
+            coord.close()
+
+    def test_role_series_zeroes_when_member_leaves(self):
+        coord = Coordinator(lost_after_s=10.0).start()
+        try:
+            c = CoordinatorClient(coord.address, "r1@h:2", role="replica")
+            c.join()
+            obs.metrics.to_prometheus()  # scrape: role seen
+            c.leave()
+            text = obs.metrics.to_prometheus()
+            assert 'dl4j_coordinator_members{role="replica"} 0' in text
+        finally:
+            coord.close()
+
+
+# ------------------------------------------------- narrow scrape cost
+
+
+class TestNarrowScrapeRegression:
+    def test_payload_does_not_scale_with_family_count(self):
+        """The router's load poll must cost O(polled families): its
+        payload is byte-identical before and after hundreds of unrelated
+        families appear, and scrape-time collectors never run."""
+        reg = MetricsRegistry()
+        depth = reg.gauge("dl4j_serving_model_queue_depth", "q",
+                          label_names=("model", "route"))
+        busy = reg.gauge("dl4j_serving_decode_slots_busy", "b",
+                         label_names=("model",))
+        depth.labels(model="m", route="predict").set(3)
+        busy.labels(model="m").set(2)
+        names = ("dl4j_serving_model_queue_depth",
+                 "dl4j_serving_decode_slots_busy")
+        collector_ran = []
+        reg.register_collector(lambda r: collector_ran.append(1))
+        before = reg.to_prometheus(names=names)
+        for i in range(300):
+            fam = reg.counter(f"dl4j_noise_{i}_total", "n",
+                              label_names=("k",))
+            for j in range(3):
+                fam.labels(k=str(j)).inc()
+        after = reg.to_prometheus(names=names)
+        assert after == before
+        assert collector_ran == []  # narrow scrape skips collectors
+        assert len(before.splitlines()) == 6  # 2 x (HELP, TYPE, sample)
+        # the full scrape still sees everything (and runs collectors)
+        full = reg.to_prometheus()
+        assert "dl4j_noise_299_total" in full
+        assert collector_ran == [1]
+
+    def test_json_snapshot_narrowing_matches(self):
+        reg = MetricsRegistry()
+        reg.gauge("dl4j_serving_decode_slots_busy", "b",
+                  label_names=("model",)).labels(model="m").set(4)
+        reg.counter("dl4j_other_total", "o").inc()
+        doc = reg.to_json(names=("dl4j_serving_decode_slots_busy",))
+        assert set(doc) == {"dl4j_serving_decode_slots_busy"}
+        assert doc["dl4j_serving_decode_slots_busy"]["series"][0][
+            "value"] == 4.0
+
+    def test_router_sums_json_snapshot(self):
+        from deeplearning4j_tpu.serving.router import sum_metric_snapshot
+
+        doc = {"dl4j_serving_model_queue_depth": {
+                   "type": "gauge", "help": "",
+                   "series": [{"labels": {"model": "a"}, "value": 2.0},
+                              {"labels": {"model": "b"}, "value": 1.0}]},
+               "dl4j_serving_decode_slots_busy": {
+                   "type": "gauge", "help": "",
+                   "series": [{"labels": {"model": "a"}, "value": 3.0}]},
+               "dl4j_unrelated": {
+                   "type": "counter", "help": "",
+                   "series": [{"labels": {}, "value": 99.0}]}}
+        got = sum_metric_snapshot(
+            doc, ("dl4j_serving_model_queue_depth",
+                  "dl4j_serving_decode_slots_busy"))
+        assert got == 6.0
+
+
+class TestBuildInfo:
+    def test_build_info_in_exposition(self):
+        text = obs.metrics.to_prometheus()
+        line = next(l for l in text.splitlines()
+                    if l.startswith("dl4j_build_info{"))
+        assert 'version="' in line
+        assert 'jax="' in line and 'backend="' in line
+        assert 'device_kind="' in line
+        assert line.endswith(" 1")
+
+
+# --------------------------------------------------- the 3-process drill
+
+
+class TestFleetTraceAndFederation:
+    def test_failover_trace_and_federated_scrape(self, tmp_path):
+        """One request's failover renders as ONE tree across processes:
+        the router's root span parents `replica.predict` spans from two
+        DIFFERENT replica PIDs (the hung replica records its span late —
+        after the hang — so the merged view is polled). The federated
+        scrape carries `dl4j_requests_total` from every replica."""
+        ckpt = _save(mlp_net(seed=1), tmp_path / "ckpt")
+        # Replica 0 hangs 2s at admission of its 3rd request; the
+        # router's 0.75s attempt cap turns that into a failover onto
+        # replica 1 while replica 0 SURVIVES (scrapeable afterwards).
+        plan = [{"kind": "hang_replica", "step": 3, "worker": 0,
+                 "seconds": 2.0}]
+        coord = Coordinator(lost_after_s=5.0).start()
+        manager = FleetManager(coord.address, ckpt, heartbeat_s=0.25,
+                               env=_sub_env(plan),
+                               log_dir=str(tmp_path / "logs"))
+        manager.spawn()
+        manager.spawn()
+        router = FleetRouter(coord.address, poll_interval_s=0.1,
+                             request_timeout_s=10.0,
+                             attempt_timeout_s=0.75, quarantine_s=1.0,
+                             http=False).start()
+        try:
+            _wait(lambda: sum(1 for r in router.table()
+                              if r["state"] == "live") == 2,
+                  120.0, what="2 live replicas")
+            x = [[0.3, -0.1, 0.7]]
+            for _ in range(12):
+                router.predict(x, timeout_s=10.0)
+                if router.counts()["failover"] >= 1:
+                    break
+            assert router.counts()["failover"] >= 1, router.counts()
+
+            agg = router.aggregator()
+            replica_wids = [r["worker_id"] for r in router.table()]
+            assert len(replica_wids) == 2
+
+            # ---- federated metrics: dl4j_requests_total from every wid
+            text = agg.federate_metrics()
+            for wid in replica_wids:
+                assert f'worker_id="{wid}"' in text
+                assert any(
+                    l.startswith(f'dl4j_requests_total{{worker_id="{wid}"')
+                    for l in text.splitlines()), wid
+                assert f'dl4j_federation_up{{worker_id="{wid}"}} 1' in text
+            # the coordinator's families federate too
+            assert "dl4j_coordinator_members" in text
+            # build identity from the replicas rides along
+            assert any(l.startswith("dl4j_build_info{")
+                       for l in text.splitlines())
+
+            # ---- merged trace: one router span, two replica PIDs.
+            # The hung replica records its span only after its 2s sleep,
+            # so poll the merged view.
+            found = {}
+
+            def failover_tree_present():
+                doc = agg.federate_trace()
+                events = doc["traceEvents"]
+                replica_spans = [e for e in events
+                                 if e.get("name") == "replica.predict"
+                                 and "parent_span_id" in e.get("args", {})]
+                roots = {}
+                for e in events:
+                    a = e.get("args", {})
+                    if (e.get("name") == "router.predict"
+                            and "span_id" in a):
+                        roots[a["span_id"]] = e
+                for span_id, root in roots.items():
+                    pids = {e["pid"] for e in replica_spans
+                            if e["args"]["parent_span_id"] == span_id}
+                    if len(pids) >= 2:
+                        found["root"] = root
+                        found["pids"] = pids
+                        found["doc"] = doc
+                        return True
+                return False
+
+            _wait(failover_tree_present, 20.0, every_s=0.5,
+                  what="router span parenting 2 replica PIDs")
+            assert len(found["pids"]) == 2
+            # distinct OS processes, neither of them the router's
+            assert os.getpid() not in found["pids"]
+            # Perfetto-loadable: serializes; process_name metadata
+            # labels both replica pids; every X event has ts+dur
+            doc = json.loads(json.dumps(found["doc"]))
+            meta_pids = {e["pid"] for e in doc["traceEvents"]
+                         if e.get("ph") == "M"
+                         and e.get("name") == "process_name"}
+            assert found["pids"] <= meta_pids
+            for e in doc["traceEvents"]:
+                if e.get("ph") == "X":
+                    assert "ts" in e and "dur" in e
+            # the router-side attempt spans recorded the failover: at
+            # least two attempts under one request
+            attempts = [e for e in doc["traceEvents"]
+                        if e.get("name") == "router.attempt"]
+            assert len(attempts) >= 2
+            # replica-side pipeline spans joined the same timeline
+            names = {e.get("name") for e in doc["traceEvents"]}
+            assert "serving.queue_wait" in names
+            assert "serving.device_dispatch" in names
+        finally:
+            router.stop()
+            manager.stop_all()
+            coord.close()

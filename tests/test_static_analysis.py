@@ -696,13 +696,101 @@ def fetch(url):
         assert [f for f in lint_package(rules=["JX012"])] == []
 
 
+# --------------------------------------------------------------- JX013
+
+class TestJX013TracePropagation:
+    # JX013 is path-scoped like JX012: serving/ and parallel/ are the
+    # layers whose outbound hops must stay on the request's span tree.
+    def _lint(self, src, path="serving/fake_hop.py"):
+        return lint_source(src, path, rules=["JX013"])
+
+    def test_raw_outbound_call_fires(self):
+        src = """
+import urllib.request
+
+def forward(url, data):
+    with urllib.request.urlopen(url, data=data, timeout=1.0) as r:
+        return r.read()
+"""
+        fs = self._lint(src)
+        assert rules_of(fs) == {"JX013"}
+        assert any("X-DL4J-Trace" in f.message for f in fs)
+
+    def test_requests_verb_fires(self):
+        src = """
+import requests
+
+def forward(url, doc):
+    return requests.post(url, json=doc, timeout=1.0)
+"""
+        fs = self._lint(src, path="parallel/fake_rpc.py")
+        assert rules_of(fs) == {"JX013"}
+
+    def test_trace_evidence_is_clean(self):
+        src = """
+import urllib.request
+from deeplearning4j_tpu.observability import propagate as _prop
+
+def forward(url, data):
+    req = urllib.request.Request(
+        url, data=data, headers=_prop.trace_headers())
+    with urllib.request.urlopen(req, timeout=1.0) as r:
+        return r.read()
+"""
+        assert self._lint(src) == []
+
+    def test_header_literal_is_clean(self):
+        src = """
+import urllib.request
+
+def forward(url, data, header_value):
+    req = urllib.request.Request(
+        url, data=data, headers={"X-DL4J-Trace": header_value})
+    with urllib.request.urlopen(req, timeout=1.0) as r:
+        return r.read()
+"""
+        assert self._lint(src) == []
+
+    def test_scrape_allowlist_is_clean(self):
+        # Metrics scrapes (router load poll, federation aggregator) are
+        # trace roots, not request hops: nothing to forward.
+        src = """
+import urllib.request
+
+def scrape_member(url):
+    with urllib.request.urlopen(url, timeout=1.0) as r:
+        return r.read()
+
+def get_text(url, timeout_s):
+    with urllib.request.urlopen(url, timeout=timeout_s) as r:
+        return r.read().decode()
+"""
+        assert self._lint(src) == []
+
+    def test_out_of_scope_path_is_clean(self):
+        src = """
+import urllib.request
+
+def forward(url):
+    return urllib.request.urlopen(url, timeout=1.0)
+"""
+        assert self._lint(src, path="datasets/fake_fetch.py") == []
+
+    def test_package_is_jx013_clean(self):
+        # Every outbound hop in serving/ and parallel/ must propagate
+        # (post_json) or be a legitimate scrape (get_text, _scrape_*).
+        from deeplearning4j_tpu.analysis.linter import lint_package
+        assert [f for f in lint_package(rules=["JX013"])] == []
+
+
 # ------------------------------------------------------------ framework
 
 class TestLinterFramework:
     def test_registry_has_all_rules(self):
         assert set(ALL_RULES) >= {"JX001", "JX002", "JX003", "JX004",
                                   "JX005", "JX006", "JX007", "JX008",
-                                  "JX009", "JX010", "JX011", "JX012"}
+                                  "JX009", "JX010", "JX011", "JX012",
+                                  "JX013"}
 
     def test_findings_are_typed_and_sorted(self):
         src = """
