@@ -1,4 +1,4 @@
-"""tpulint rules JX001-JX013.
+"""tpulint rules JX001-JX014.
 
 Each rule is a class with a stable ``id``; registration is
 registry-driven (`@register_rule`) so satellite PRs add rules without
@@ -959,3 +959,79 @@ class TracePropagationRule(Rule):
                     "or route through serving/router.py's post_json — a "
                     "hop without it falls off the request's federated "
                     "span tree")
+
+
+@register_rule
+class DenseKVAllocationRule(Rule):
+    """JX014: dense full-length KV buffer allocation outside the paged
+    pool.
+
+    `jnp.zeros((..., decode_cache_length, ...))` pins `slots x capacity`
+    KV rows per layer whether a sequence is two tokens deep or two
+    hundred — the padding/duplication HBM the paged pool
+    (`models/kv_pool.py` + `models.zoo.PagedDecodeStepper`) exists to
+    reclaim. Any new decode-cache state should be page-granular: sized by
+    the pool's `(pages, page_size)` geometry, addressed through the
+    per-slot page table.
+
+    Heuristic: an array-allocation call (`zeros` / `ones` / `empty` /
+    `full` on `jnp` / `jax.numpy` / `np` / `numpy`) whose arguments
+    reference ``decode_cache_length`` anywhere in their expression trees —
+    directly, or through one level of local aliasing
+    (``L = conf.decode_cache_length`` then ``jnp.zeros((..., L, ...))``).
+    The pool module itself and `analysis/` are exempt; the attention
+    layer's cache priming uses `jnp.pad` (sized by the incoming block,
+    not a fresh full-length allocation) and stays clean by construction.
+    """
+
+    id = "JX014"
+    description = ("dense full-length KV buffer (jnp.zeros sized by "
+                   "decode_cache_length) allocated outside the paged "
+                   "pool module")
+
+    _ALLOCS = {"zeros", "ones", "empty", "full"}
+    _MODULES = {"jnp", "jax", "np", "numpy"}
+
+    @staticmethod
+    def _mentions(node, aliases) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and (
+                    sub.id == "decode_cache_length" or sub.id in aliases):
+                return True
+            if (isinstance(sub, ast.Attribute)
+                    and sub.attr == "decode_cache_length"):
+                return True
+        return False
+
+    def check(self, ctx):
+        rel = ctx.rel.replace("\\", "/")
+        if "/analysis/" in rel or rel.startswith("analysis/"):
+            return
+        if "kv_pool" in rel:
+            return  # the pool module owns page-granular allocation
+        # One aliasing hop: names assigned from an expression that
+        # mentions decode_cache_length taint the allocation check.
+        aliases = set()
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and self._mentions(node.value, ())):
+                aliases.add(node.targets[0].id)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (isinstance(f, ast.Attribute) and f.attr in self._ALLOCS
+                    and attr_base(f) in self._MODULES):
+                continue
+            if any(self._mentions(a, aliases)
+                   for a in list(node.args)
+                   + [kw.value for kw in node.keywords]):
+                yield self.finding(
+                    ctx, node,
+                    f"dense KV allocation `{attr_base(f)}.{f.attr}(...)` "
+                    "sized by decode_cache_length: full-length per-slot "
+                    "buffers pin capacity x slots HBM rows regardless of "
+                    "sequence depth — back decode state with "
+                    "models/kv_pool.py pages (pages x page_size geometry "
+                    "through the per-slot page table) instead")

@@ -133,6 +133,11 @@ def build_fingerprint_doc(net, kind: str, static: Dict[str, Any],
         # resolves different kernel impls inside the traced program, so a
         # cached executable from another config must not be served.
         "kernels": _kernels_registry.config_fingerprint(),
+        # Paged-KV pool geometry (models/zoo.PagedDecodeStepper stamps
+        # this on the engine): the page size / pool depth shape the decode
+        # program's state arrays, so warmup must ship the real paged
+        # executable, never a dense-geometry one. None for dense decode.
+        "decode_pool": getattr(net, "_decode_pool_geometry", None),
         "jax": jax.__version__,
         "jaxlib": jaxlib.__version__,
         "backend": str(dev[0].platform) if dev else "none",

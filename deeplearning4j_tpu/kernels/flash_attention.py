@@ -700,3 +700,156 @@ def _flash_bwd_stream_bhtd(q, k, v, do, o, lse, causal, scale, block_q,
         interpret=not _on_tpu(),
     )(jnp.asarray(ic), jnp.asarray(jc), k, v, q, do, lse, d_row)
     return dq, dk, dv
+
+
+# ------------------------------------------------------------------ paged
+# Decode-step attention against the paged KV pool (PagedAttention, Kwon
+# et al. SOSP 2023): k/v live as [P, page, H, D] pools, each batch row
+# reads through its [NP] row of the int32 page table. Inference-only and
+# deliberately VJP-EXEMPT: the decode path never differentiates (the
+# engines refuse training with decode caches), so no custom_vjp is
+# defined — differentiating through it is a loud error, not a silent
+# dense fallback.
+
+
+def _paged_gather_dense(q, k_pages, v_pages, page_table, pos, causal):
+    """XLA fallback: gather the pages into the dense [B, L, H, D] cache
+    layout and reuse `_cached_decode_attention` VERBATIM. Bit-identical
+    to the dense stepper: garbage rows (zero page, pad/CoW tails) land
+    exactly on masked key positions, where the softmax weight underflows
+    to exactly 0.0 and contributes +0.0 to the same-order contraction."""
+    from deeplearning4j_tpu.nn.layers.attention import (
+        _cached_decode_attention,
+    )
+
+    B = q.shape[0]
+    NP = page_table.shape[1]
+    _, page, H, D = k_pages.shape
+    kc = k_pages[page_table].reshape(B, NP * page, H, D)
+    vc = v_pages[page_table].reshape(B, NP * page, H, D)
+    return _cached_decode_attention(q, kc, vc, pos, causal)
+
+
+def _paged_flash_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                        acc_ref, m_ref, l_ref, *, page, n_pages, causal,
+                        scale):
+    """One (batch, head, logical-page) step: the page table is scalar-
+    prefetched, so the k/v BlockSpec index maps DMA exactly the physical
+    page this slot's logical page j resolves to — no dense gather ever
+    materializes. VMEM scratch (acc, m, l) carries the online softmax
+    across the NP sequential grid steps."""
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    T = q_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0, :, 0, :].astype(jnp.float32) * scale
+    k = k_ref[0, :, 0, :].astype(jnp.float32)
+    v = v_ref[0, :, 0, :].astype(jnp.float32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)  # [T, page]
+    kpos = j * page + jax.lax.broadcasted_iota(jnp.int32, (T, page), 1)
+    if causal:
+        limit = (pos_ref[b] + 1
+                 + jax.lax.broadcasted_iota(jnp.int32, (T, page), 0))
+    else:
+        limit = pos_ref[b] + T
+    s = jnp.where(kpos < limit, s, _NEG)
+    blk_max = jnp.max(s, axis=1, keepdims=True)
+    new_m = jnp.maximum(m_ref[...], blk_max)
+    p = jnp.exp(s - new_m)
+    corr = jnp.exp(m_ref[...] - new_m)
+    l_ref[...] = l_ref[...] * corr + jnp.sum(p, axis=1, keepdims=True)
+    acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    m_ref[...] = new_m
+
+    @pl.when(j == n_pages - 1)
+    def _finish():
+        o_ref[0, :, 0, :] = (
+            acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal",))
+def _paged_flash(q, k_pages, v_pages, page_table, pos, causal):
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, T, H, D = q.shape
+    page = k_pages.shape[1]
+    NP = page_table.shape[1]
+    scale = D ** -0.5
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, H, NP),
+        in_specs=[
+            pl.BlockSpec((1, T, 1, D),
+                         lambda b, h, j, pt, pos: (b, 0, h, 0)),
+            pl.BlockSpec((1, page, 1, D),
+                         lambda b, h, j, pt, pos: (pt[b, j], 0, h, 0)),
+            pl.BlockSpec((1, page, 1, D),
+                         lambda b, h, j, pt, pos: (pt[b, j], 0, h, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, T, 1, D),
+                               lambda b, h, j, pt, pos: (b, 0, h, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((T, D), jnp.float32),
+            pltpu.VMEM((T, 1), jnp.float32),
+            pltpu.VMEM((T, 1), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_paged_flash_kernel, page=page, n_pages=NP,
+                          causal=causal, scale=scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=not _on_tpu(),
+    )(page_table, jnp.reshape(pos, (-1,)).astype(jnp.int32),
+      q, k_pages, v_pages)
+
+
+def paged_decode_attention(q, k_pages, v_pages, page_table, pos, causal):
+    """Decode attention through the paged KV pool. q: [B, T, H, D] (the
+    new positions, globally at [pos, pos+T) per row); k_pages/v_pages:
+    [P, page, H, D]; page_table: [B, NP] int32 (0 = the zero page);
+    pos: [B] int32 cursors.
+
+    Resolves `flash_attention_paged` through the kernel registry: the
+    Pallas paged-gather kernel on TPU (or when forced — interpret mode,
+    float-close), else the XLA dense-gather composite, which is
+    bit-identical to the dense stepper's `_cached_decode_attention`.
+    Inference-only: no VJP is defined (see module note above)."""
+    res = _registry.resolve(
+        "flash_attention_paged",
+        shapes=(tuple(q.shape), tuple(k_pages.shape),
+                tuple(page_table.shape)),
+        dtypes=(str(q.dtype),), meta=(bool(causal),))
+    if res.impl == "pallas":
+        return _paged_flash(q, k_pages, v_pages, page_table, pos, causal)
+    return _paged_gather_dense(q, k_pages, v_pages, page_table, pos, causal)
+
+
+def _paged_pallas_available(backend, shapes, dtypes, meta=(), forced=False):
+    if backend == "tpu":
+        return True, ("TPU paged-gather flash kernel (scalar-prefetched "
+                      "page table)")
+    if forced:
+        return True, ("interpret mode off-TPU (float-close parity tests "
+                      "only)")
+    return False, ("auto off-TPU keeps the XLA dense-gather composite — "
+                   "bit-identical to the dense stepper")
+
+
+def _paged_xla_available(backend, shapes, dtypes, meta=(), forced=False):
+    return True, ("XLA dense-gather + _cached_decode_attention "
+                  "(bit-identical fallback)")
+
+
+_registry.register("flash_attention_paged", [
+    _registry.KernelImpl("pallas", _paged_pallas_available),
+    _registry.KernelImpl("xla", _paged_xla_available),
+])

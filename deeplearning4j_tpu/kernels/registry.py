@@ -52,6 +52,10 @@ KERNEL_MODULES = {
     "fused_update": "deeplearning4j_tpu.kernels.fused_update",
     "norm_act": "deeplearning4j_tpu.kernels.norm_act",
     "flash_attention": "deeplearning4j_tpu.kernels.flash_attention",
+    # Paged decode-attention gather variant (PR 15): registered by the
+    # same module; auto off-TPU resolves to the XLA dense-gather
+    # composite, which is bit-identical to the dense stepper.
+    "flash_attention_paged": "deeplearning4j_tpu.kernels.flash_attention",
 }
 
 

@@ -1,0 +1,309 @@
+"""Host-side paged KV-cache pool + prompt prefix cache.
+
+The dense `DecodeStepper` pins `slots x capacity` KV rows per attention
+layer whether a slot is two tokens deep or two hundred — HBM spent on
+padding directly caps slots-per-replica, and N slots decoding from the
+same system prompt hold N copies of its KV. This module is the
+bookkeeping half of the paged replacement (vLLM's PagedAttention, Kwon
+et al., SOSP 2023): KV lives in fixed-size PAGES shared by all slots,
+each sequence maps logical page indices to physical pages through a
+per-slot int32 row of `table`, and pages are REFCOUNTED so a shared
+prefix is resident once.
+
+Division of labor:
+
+- this module is pure host-side metadata — refcounts, the free list,
+  per-slot page lists, the `[slots, pages_per_seq]` page table, and
+  copy-on-write PLANNING (`plan_appends` returns the `(src, dst)` page
+  copies the device must perform before the next append);
+- the device arrays (`k_pages`/`v_pages` per attention layer) and the
+  jitted scatter/gather live in `models.zoo.PagedDecodeStepper` and
+  `nn/layers/attention.py`; the attention read goes through the
+  `flash_attention_paged` kernel seam.
+
+Invariants:
+
+- physical page 0 is the reserved ZERO page: unmapped table entries
+  point at it, so free slots riding a decode dispatch scatter their
+  dummy-token KV there and never corrupt a live page. It is never
+  allocated and never freed.
+- a page in any slot's WRITE RANGE has refcount 1 at dispatch time:
+  `plan_appends` copies-on-write every shared page an append would
+  touch, so concurrent slots can never scatter into the same physical
+  row. Garbage rows (pad tails, CoW'd tails, rejected speculative
+  tokens) sit at key positions >= the cursor, where the attention
+  mask's `exp(-1e30 - m)` underflows to exactly 0.0 — which is why the
+  paged read is bit-identical to the dense one.
+- `PrefixCache` holds +1 ref on every page of an admitted prompt, so a
+  cached prefix survives its slot's retirement; a hit re-refs the pages
+  and replays the STORED next-token distribution (zero dispatches —
+  TTFT on a repeat prompt is pure sampling). The first divergent append
+  CoWs the tail page because its refcount is >= 2.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class PoolExhaustedError(RuntimeError):
+    """No free page and the reclaim hook (prefix-cache eviction) could
+    not surrender one. The default pool sizing (`slots * capacity /
+    page_size + 1`) can never hit this even with zero sharing."""
+
+
+class KVPagePool:
+    """Refcounted fixed-size-page allocator for the paged decode path.
+
+    `table` is the host-authoritative `[slots, pages_per_seq]` int32
+    page table the stepper ships to the device before every dispatch;
+    unmapped entries are 0 (the zero page).
+    """
+
+    def __init__(self, slots: int, capacity: int, page_size: int,
+                 pages: Optional[int] = None,
+                 reclaim: Optional[Callable[[], bool]] = None):
+        if page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        if capacity % page_size:
+            raise ValueError(
+                f"decode cache capacity {capacity} must be a multiple of "
+                f"page_size {page_size}")
+        self.slots = int(slots)
+        self.capacity = int(capacity)
+        self.page_size = int(page_size)
+        self.pages_per_seq = self.capacity // self.page_size
+        if pages is None:
+            # Worst case (zero sharing): every slot fully deep, + page 0.
+            pages = self.slots * self.pages_per_seq + 1
+        self.num_pages = int(pages)
+        if self.num_pages < 2:
+            raise ValueError("pool needs >= 2 pages (page 0 is reserved)")
+        # LIFO free list keeps recently-freed (cache-warm) pages hot.
+        self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
+        self._ref = np.zeros(self.num_pages, np.int64)
+        self._seq: Dict[int, List[int]] = {}   # slot -> physical pages
+        self._len: Dict[int, int] = {}         # slot -> token length
+        self.table = np.zeros((self.slots, self.pages_per_seq), np.int32)
+        # Called when the free list runs dry; returns True if it freed
+        # >= 1 page (the scheduler wires PrefixCache.evict_one here).
+        self.reclaim = reclaim
+
+    # ------------------------------------------------------------ queries
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def counts(self) -> Dict[str, int]:
+        """Page states for the `dl4j_kv_pages` gauges: free / used
+        (refcount 1) / shared (refcount >= 2). Page 0 is none of them."""
+        return {
+            "free": len(self._free),
+            "used": int(np.count_nonzero(self._ref == 1)),
+            "shared": int(np.count_nonzero(self._ref >= 2)),
+        }
+
+    def tracked(self) -> Tuple[int, ...]:
+        return tuple(sorted(self._seq))
+
+    def length_of(self, slot: int) -> int:
+        return self._len.get(slot, 0)
+
+    def pages_of(self, slot: int) -> Tuple[int, ...]:
+        return tuple(self._seq.get(slot, ()))
+
+    # --------------------------------------------------------- refcounting
+
+    def _alloc_one(self) -> int:
+        while not self._free:
+            if self.reclaim is None or not self.reclaim():
+                raise PoolExhaustedError(
+                    f"KV page pool exhausted ({self.num_pages - 1} usable "
+                    f"pages of {self.page_size} tokens; "
+                    f"{len(self._seq)} resident sequences)")
+        p = self._free.pop()
+        self._ref[p] = 1
+        return p
+
+    def _reserve(self, need: int) -> None:
+        """Fail-before-mutate: make sure `need` pages are allocatable,
+        reclaiming from the prefix cache if necessary."""
+        while len(self._free) < need:
+            if self.reclaim is None or not self.reclaim():
+                raise PoolExhaustedError(
+                    f"KV page pool exhausted: need {need} pages, "
+                    f"{len(self._free)} free of {self.num_pages - 1} usable")
+
+    def ref(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            if p == 0:
+                raise ValueError("page 0 is the reserved zero page")
+            self._ref[p] += 1
+
+    def unref(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            if self._ref[p] <= 0:
+                raise ValueError(f"unref of unallocated page {p}")
+            self._ref[p] -= 1
+            if self._ref[p] == 0:
+                self._free.append(p)
+
+    # ---------------------------------------------------- slot lifecycle
+
+    def install_slot(self, slot: int, length: int) -> List[int]:
+        """Allocate fresh pages covering `length` tokens for `slot`
+        (prefill-miss install). Returns the physical page list."""
+        self.free_slot(slot)
+        need = -(-int(length) // self.page_size)  # ceil
+        if need > self.pages_per_seq:
+            raise ValueError(
+                f"sequence length {length} exceeds capacity {self.capacity}")
+        self._reserve(need)
+        pages = [self._alloc_one() for _ in range(need)]
+        self._seq[slot] = pages
+        self._len[slot] = int(length)
+        self.table[slot, :] = 0
+        self.table[slot, :need] = pages
+        return pages
+
+    def install_shared(self, slot: int, pages: Sequence[int],
+                       length: int) -> None:
+        """Point `slot` at already-resident pages (prefix-cache hit):
+        +1 ref each, no allocation, no device writes needed."""
+        self.free_slot(slot)
+        pages = list(pages)
+        self.ref(pages)
+        self._seq[slot] = pages
+        self._len[slot] = int(length)
+        self.table[slot, :] = 0
+        self.table[slot, :len(pages)] = pages
+
+    def free_slot(self, slot: int) -> None:
+        """Retire a slot: unref its pages (freed at refcount 0 — a
+        prefix-cache ref keeps shared prefix pages resident) and zero
+        its table row so future rides write to the zero page."""
+        pages = self._seq.pop(slot, None)
+        self._len.pop(slot, None)
+        self.table[slot, :] = 0
+        if pages:
+            self.unref(pages)
+
+    def rewind(self, slot: int, length: int) -> None:
+        """Truncate a slot to `length` tokens (speculative-decoding
+        rejection): pages wholly beyond the new length are unref'd.
+        No-op for untracked slots."""
+        if slot not in self._seq:
+            return
+        length = int(length)
+        keep = -(-length // self.page_size)
+        pages = self._seq[slot]
+        drop = pages[keep:]
+        if drop:
+            self._seq[slot] = pages[:keep]
+            self.table[slot, keep:len(pages)] = 0
+            self.unref(drop)
+        self._len[slot] = length
+
+    # ------------------------------------------------------------ appends
+
+    def plan_appends(self, t: int) -> List[Tuple[int, int]]:
+        """Advance every tracked slot's length by `t` tokens, allocating
+        pages the append crosses into and copy-on-writing shared pages in
+        the write range. Returns the `(src, dst)` physical page copies the
+        device must perform BEFORE the dispatch. Atomic: page need is
+        counted (and reclaimed) up front, so exhaustion raises before any
+        state mutates."""
+        t = int(t)
+        plans = []  # (slot, [page indices to fix])
+        need = 0
+        for slot, pages in self._seq.items():
+            n = self._len[slot]
+            first, last = n // self.page_size, (n + t - 1) // self.page_size
+            todo = []
+            for pi in range(first, min(last, self.pages_per_seq - 1) + 1):
+                if pi >= len(pages) or self._ref[pages[pi]] >= 2:
+                    todo.append(pi)
+                    need += 1
+            plans.append((slot, todo))
+        self._reserve(need)
+        copies: List[Tuple[int, int]] = []
+        for slot, todo in plans:
+            pages = self._seq[slot]
+            for pi in todo:
+                new = self._alloc_one()
+                if pi < len(pages):
+                    copies.append((pages[pi], new))   # CoW: shared page
+                    self.unref([pages[pi]])
+                    pages[pi] = new
+                else:
+                    pages.append(new)
+                self.table[slot, pi] = new
+            self._len[slot] += t
+        return copies
+
+
+class PrefixCache:
+    """LRU prompt -> primed-KV cache over pool pages.
+
+    Keyed on the exact prompt token tuple (the dict hash IS the
+    prompt-token hash; exact-match lookup, so a collision can never
+    serve the wrong prefix). An entry holds the prompt's physical
+    pages (+1 pool ref each, so they survive slot retirement), the
+    prompt length, and the next-token distribution the prefill
+    produced — a hit installs the pages by reference and replays the
+    stored distribution, skipping prefill entirely.
+    """
+
+    def __init__(self, pool: KVPagePool, max_entries: int = 32):
+        self.pool = pool
+        self.max_entries = int(max_entries)
+        # key -> (pages, length, probs)
+        self._entries: "collections.OrderedDict[Tuple[int, ...], tuple]" = \
+            collections.OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def get(self, prompt: Sequence[int]):
+        """`(pages, length, probs)` for an exact prompt match (LRU
+        refresh), else None. Counts hits/misses."""
+        key = tuple(int(i) for i in prompt)
+        ent = self._entries.get(key)
+        if ent is None:
+            self.misses += 1
+            return None
+        self._entries.move_to_end(key)
+        self.hits += 1
+        return ent
+
+    def admit(self, prompt: Sequence[int], pages: Sequence[int],
+              length: int, probs) -> None:
+        """Cache a freshly-prefilled prompt: +1 ref on its pages, store
+        the next-token distribution, LRU-evict beyond `max_entries`."""
+        key = tuple(int(i) for i in prompt)
+        if key in self._entries or not pages:
+            return
+        self.pool.ref(pages)
+        self._entries[key] = (tuple(int(p) for p in pages), int(length),
+                              np.array(probs, copy=True))
+        while len(self._entries) > self.max_entries:
+            self.evict_one()
+
+    def evict_one(self) -> bool:
+        """Drop the least-recently-used entry (the pool's reclaim hook
+        under page pressure). Returns True when something was evicted."""
+        if not self._entries:
+            return False
+        _, (pages, _, _) = self._entries.popitem(last=False)
+        self.pool.unref(pages)
+        return True
+
+    def clear(self) -> None:
+        while self.evict_one():
+            pass

@@ -448,7 +448,12 @@ def generate_lm_batch(cg, prompts, n_steps: int, *, temperature: float = 1.0,
 def decode_cache_capacity(cg) -> int:
     """Smallest `decode_cache_length` across the graph's attention layers —
     the hard per-sequence step budget. Raises when the model was built
-    without a KV cache."""
+    without a KV cache.
+
+    Both decode layouts share this budget: the dense `DecodeStepper`
+    allocates it up front per slot, while `PagedDecodeStepper` backs it
+    with pool pages (`models/kv_pool.py`) allocated as a sequence
+    deepens — capacity must then be a multiple of the page size."""
     caps = [v.layer.decode_cache_length
             for v in cg.layer_vertices.values()
             if type(v.layer).__name__ == "SelfAttentionLayer"]
@@ -522,8 +527,8 @@ class DecodeStepper:
             raise ValueError(f"pad_to ({pad_to}) < prompt length ({n})")
         if pad_to > self.capacity:
             raise ValueError(
-                f"prompt bucket {pad_to} exceeds decode cache capacity "
-                f"{self.capacity}")
+                f"prompt bucket {pad_to} (prompt length {n}) exceeds the "
+                f"decode cache capacity {self.capacity}")
         x = np.zeros((1, pad_to, 1), np.float32)
         x[0, :n, 0] = ids
         fn = self.cg._get_jit("output", train=False, keep_rnn_state=True)
@@ -578,21 +583,206 @@ class DecodeStepper:
 
     # -- decode path ------------------------------------------------------
 
-    def step(self, tokens):
-        """Advance every slot one token. `tokens` is [slots] ints (free
-        slots take any dummy value). Returns [slots, V] next-token
-        distributions."""
+    def _before_dispatch(self, t: int):
+        """Hook run before every decode dispatch with the step width.
+        The paged stepper allocates/CoWs pool pages here."""
+
+    def _dispatch(self, x):
+        """One jitted decode dispatch: x is [slots, T, 1] token ids.
+        Returns [slots, T, V] distributions (one per fed token)."""
         import numpy as np
         import jax.numpy as jnp
         from deeplearning4j_tpu.nn import rnn_state as rnn_mod
 
         if self._state is None:
             raise RuntimeError("no sequence installed; call prefill/install")
-        x = np.asarray(tokens, np.float32).reshape(self.slots, 1, 1)
         fn = self.cg._get_jit("output", train=False, keep_rnn_state=True)
         state = rnn_mod.merge_rnn_state(self.cg.state, self._state)
         outs, new_state = fn(self.cg.params_tree, state,
                              [jnp.asarray(x)], None, self._rng0)
         self._state = rnn_mod.split_rnn_state(new_state, self._declared)
         out = np.asarray(outs[0])
-        return out[:, -1] if out.ndim == 3 else out
+        return out if out.ndim == 3 else out[:, None, :]
+
+    def step(self, tokens):
+        """Advance every slot one token. `tokens` is [slots] ints (free
+        slots take any dummy value). Returns [slots, V] next-token
+        distributions."""
+        import numpy as np
+
+        x = np.asarray(tokens, np.float32).reshape(self.slots, 1, 1)
+        self._before_dispatch(1)
+        return self._dispatch(x)[:, -1]
+
+    def step_k(self, tokens):
+        """Advance every slot T tokens in ONE dispatch — the speculative
+        verify shape: `tokens` is [slots, T] ints and the return is
+        [slots, T, V], the distribution AFTER each fed token (row j is
+        conditioned on tokens[:, :j+1]). Rows whose later tokens turn out
+        wrong are discarded by `rewind_all`; their cache rows sit beyond
+        the rewound cursor, masked until overwritten."""
+        import numpy as np
+
+        tok = np.asarray(tokens)
+        if tok.ndim != 2 or tok.shape[0] != self.slots:
+            raise ValueError(
+                f"tokens must be [slots={self.slots}, T]; got {tok.shape}")
+        x = tok.astype(np.float32)[:, :, None]
+        self._before_dispatch(tok.shape[1])
+        return self._dispatch(x)
+
+    def rewind_all(self, lengths):
+        """Set EVERY slot's cursors (KV + positional) to `lengths[slot]`
+        in one batched update per layer — the speculative-decoding
+        truncation after a verify step: rejected rows stay in the cache
+        beyond the cursor, masked until the next append overwrites them."""
+        import numpy as np
+        import jax.numpy as jnp
+
+        if self._state is None:
+            return
+        cur = jnp.asarray(np.asarray(lengths, np.int32).reshape(self.slots))
+        for s in self._state.values():
+            for k, v in s.items():
+                if v.ndim == 1 and jnp.issubdtype(v.dtype, jnp.integer):
+                    s[k] = cur
+
+
+class PagedDecodeStepper(DecodeStepper):
+    """`DecodeStepper` over a paged KV pool (vLLM-style PagedAttention).
+
+    Same contract as the dense stepper — `prefill` / `install` / `step` /
+    `step_k` / `clear` — but the per-slot [capacity] KV rows are replaced
+    by fixed-size pages from one shared `models.kv_pool.KVPagePool`:
+
+    - every attention layer's overlay holds `k_pages`/`v_pages`
+      ([pages, page_size, H, D]) plus the [slots] `kv_pos` cursors; the
+      int32 page table ([slots, pages_per_seq], host-authoritative in the
+      pool) is shipped as ONE device array shared by all layers before
+      each dispatch;
+    - `install` allocates pages for the prefilled prompt and scatters the
+      dense batch-1 cache into them (the prefill program itself is
+      unchanged — same warmable buckets);
+    - `install_shared` points a slot at already-resident pages (prefix
+      cache hit): +1 ref per page, cursor writes only, zero dispatches;
+    - `_before_dispatch` advances the pool (page allocation + CoW of
+      shared pages in the write range) and applies the planned page
+      copies on device, so the in-jit scatter never collides.
+
+    HBM: dense pins `slots * capacity` rows/layer; the pool holds
+    `pages * page_size` rows/layer where shared prefixes are resident
+    ONCE — the bench's slots-at-equal-HBM multiplier.
+    """
+
+    def __init__(self, cg, slots: int, page_size: int = 64,
+                 pages: int = None):
+        from deeplearning4j_tpu.models.kv_pool import KVPagePool
+
+        super().__init__(cg, slots)
+        self.pool = KVPagePool(slots=self.slots, capacity=self.capacity,
+                               page_size=page_size, pages=pages)
+        self.page_size = self.pool.page_size
+        self._attn_layers = None  # discovered from the first template
+        # Folded into the AOT fingerprint document
+        # (compilation/store.py::build_fingerprint_doc) so warmup ships
+        # the real paged program, never a dense-geometry executable.
+        cg._decode_pool_geometry = {
+            "kv": "paged", "page_size": self.page_size,
+            "pages": self.pool.num_pages, "slots": self.slots,
+        }
+
+    def _alloc(self, template):
+        import jax.numpy as jnp
+
+        page, P = self.page_size, self.pool.num_pages
+        self._state, self._attn_layers = {}, []
+        for layer, s in template.items():
+            if "k_cache" in s:
+                k, v = s["k_cache"], s["v_cache"]
+                self._state[layer] = {
+                    "k_pages": jnp.zeros((P, page) + k.shape[2:], k.dtype),
+                    "v_pages": jnp.zeros((P, page) + v.shape[2:], v.dtype),
+                    "kv_pos": jnp.zeros((self.slots,), jnp.int32),
+                }
+                self._attn_layers.append(layer)
+            else:
+                self._state[layer] = {
+                    kk: jnp.zeros((self.slots,), jnp.int32)
+                    if jnp.ndim(vv) == 0
+                    else jnp.zeros((self.slots,) + vv.shape[1:], vv.dtype)
+                    for kk, vv in s.items()
+                }
+
+    def install(self, slot: int, slot_state, length: int):
+        """Allocate pages for a freshly-prefilled prompt and scatter its
+        dense batch-1 cache into them. The tail page's rows beyond
+        `length` carry prefill-pad garbage — masked until overwritten."""
+        import numpy as np
+        import jax.numpy as jnp
+
+        if self._state is None:
+            self._alloc(slot_state)
+        pages = self.pool.install_slot(slot, length)
+        idx = jnp.asarray(np.asarray(pages, np.int32))
+        page, npg = self.page_size, len(pages)
+        for layer, s in slot_state.items():
+            dst = self._state[layer]
+            if "k_cache" in s:
+                for src_k, dst_k in (("k_cache", "k_pages"),
+                                     ("v_cache", "v_pages")):
+                    blk = s[src_k][0, :npg * page].reshape(
+                        (npg, page) + s[src_k].shape[2:])
+                    dst[dst_k] = dst[dst_k].at[idx].set(blk)
+                dst["kv_pos"] = dst["kv_pos"].at[slot].set(jnp.int32(length))
+            else:
+                for kk, vv in s.items():
+                    if jnp.ndim(vv) == 0:
+                        dst[kk] = dst[kk].at[slot].set(jnp.int32(length))
+                    else:
+                        dst[kk] = dst[kk].at[slot].set(vv[0])
+
+    def install_shared(self, slot: int, pages, length: int):
+        """Prefix-cache hit: point `slot` at resident pages (+1 ref each)
+        and set its cursors — no prefill, no KV writes. The first
+        divergent append CoWs the shared tail page (refcount >= 2)."""
+        import jax.numpy as jnp
+
+        if self._state is None:
+            raise RuntimeError(
+                "no paged state allocated yet; the first prompt must go "
+                "through prefill/install")
+        self.pool.install_shared(slot, pages, length)
+        for s in self._state.values():
+            for kk, vv in s.items():
+                if vv.ndim == 1 and jnp.issubdtype(vv.dtype, jnp.integer):
+                    s[kk] = vv.at[slot].set(jnp.int32(length))
+
+    def clear(self, slot: int):
+        self.pool.free_slot(slot)
+        super().clear(slot)
+
+    def rewind_all(self, lengths):
+        import numpy as np
+
+        for slot, n in enumerate(np.asarray(lengths).reshape(self.slots)):
+            self.pool.rewind(slot, int(n))
+        super().rewind_all(lengths)
+
+    def _before_dispatch(self, t: int):
+        """Advance the pool by `t` tokens for every tracked slot, apply
+        the planned CoW page copies on device, and refresh the device
+        page table (one array shared by every attention layer)."""
+        import numpy as np
+        import jax.numpy as jnp
+
+        copies = self.pool.plan_appends(t)
+        if copies:
+            src = jnp.asarray(np.asarray([c[0] for c in copies], np.int32))
+            dst = jnp.asarray(np.asarray([c[1] for c in copies], np.int32))
+            for layer in self._attn_layers:
+                s = self._state[layer]
+                s["k_pages"] = s["k_pages"].at[dst].set(s["k_pages"][src])
+                s["v_pages"] = s["v_pages"].at[dst].set(s["v_pages"][src])
+        pt = jnp.asarray(self.pool.table)
+        for layer in self._attn_layers:
+            self._state[layer]["page_table"] = pt

@@ -122,6 +122,40 @@ def self_attention_apply(conf, params, state, x, *, rng=None, train=False,
     scale = Dh ** -0.5
 
     L = conf.decode_cache_length
+    if L and "k_pages" in state:
+        # Paged decode step: KV lives in a pool of fixed-size pages shared
+        # by all slots (`models/kv_pool.py` owns the refcounts/CoW); this
+        # branch scatters the new k/v rows through the per-slot page table
+        # and reads through the `flash_attention_paged` kernel seam. The
+        # pool guarantees every page in a slot's write range has refcount 1
+        # (CoW before dispatch), so rows never collide; free slots' table
+        # rows are all-zero, landing their writes on the reserved zero
+        # page. Garbage rows (pad tails, zero page) sit at masked key
+        # positions whose softmax weight underflows to exactly 0.0, which
+        # keeps this path bit-identical to the dense cache under the XLA
+        # dense-gather fallback.
+        from deeplearning4j_tpu.kernels import flash_attention as _fa
+
+        pos = state["kv_pos"]                       # [B] int32 cursors
+        pt = state["page_table"]                    # [B, NP] int32
+        kp, vp = state["k_pages"], state["v_pages"]
+        page = kp.shape[1]
+        gpos = pos[:, None] + jnp.arange(T)[None, :]           # [B, T]
+        # Free slots' cursors grow unbounded; clip keeps the gather legal
+        # and their writes stay on the zero page regardless.
+        phys = jnp.take_along_axis(pt, jnp.clip(gpos // page, 0,
+                                                pt.shape[1] - 1), axis=1)
+        off = gpos % page
+        kp = kp.at[phys.reshape(-1), off.reshape(-1)].set(
+            k.reshape(B * T, H, Dh))
+        vp = vp.at[phys.reshape(-1), off.reshape(-1)].set(
+            v.reshape(B * T, H, Dh))
+        o = _fa.paged_decode_attention(q, kp, vp, pt, pos, conf.causal)
+        out = o.reshape(B, T, conf.n_out) @ params["Wo"] + params["oB"]
+        out = activations.resolve(conf.activation)(out)
+        return out, {"k_pages": kp, "v_pages": vp, "page_table": pt,
+                     "kv_pos": pos + jnp.int32(T)}, mask
+
     if L and "kv_pos" in state:
         # Stateful decode step: fold the new k/v into the cache at the
         # cursor, attend against the valid prefix.

@@ -83,6 +83,35 @@ DECODE_SLOTS_BUSY = _obs.metrics.gauge(
     "Generation scheduler slots currently holding an active sequence",
     label_names=("model",))
 
+# ------------------------------------------------------------- paged decode
+# Paged-KV / prefix-cache / speculative-decoding families (PR 15). Same
+# JX008 shape as everything above: family registered at import, children
+# created once at scheduler construction, scrape-time gauges via
+# set_function.
+KV_PAGES = _obs.metrics.gauge(
+    "dl4j_kv_pages",
+    "KV page-pool pages by state: free (allocatable), used (refcount 1), "
+    "shared (refcount >= 2 — prefix pages resident once for N readers). "
+    "The reserved zero page is none of them",
+    label_names=("model", "state"))
+PREFIX_CACHE_HITS = _obs.metrics.counter(
+    "dl4j_prefix_cache_hits_total",
+    "Generation admissions that reused a cached prompt prefix (prefill "
+    "skipped entirely; TTFT ~ one decode step)",
+    label_names=("model",))
+PREFIX_CACHE_MISSES = _obs.metrics.counter(
+    "dl4j_prefix_cache_misses_total",
+    "Generation admissions that prefilled from scratch (prompt not in the "
+    "prefix cache)",
+    label_names=("model",))
+SPECULATIVE_TOKENS = _obs.metrics.counter(
+    "dl4j_speculative_tokens_total",
+    "Draft-model speculative proposals by outcome: accepted (target's "
+    "greedy argmax agreed — token emitted without its own target step) or "
+    "rejected (disagreed — rewound). accepted/(accepted+rejected) is the "
+    "measured accept rate alpha in PERF.md §23",
+    label_names=("model", "outcome"))
+
 # ------------------------------------------------------------------ fleet
 # Router/fleet SLO families: same one-scrape registry, so a single
 # `GET /metrics` on the router shows fleet membership, request outcomes

@@ -97,16 +97,57 @@ class GenerationScheduler:
 
     def __init__(self, cg, model_name: str = "default", slots: int = 4,
                  prompt_buckets: Optional[Sequence[int]] = None,
-                 queue_depth: int = 64, mode: str = "continuous"):
-        from deeplearning4j_tpu.models.zoo import DecodeStepper
+                 queue_depth: int = 64, mode: str = "continuous",
+                 kv: str = "dense", page_size: int = 64,
+                 kv_pages: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None,
+                 prefix_cache_entries: int = 32,
+                 draft=None, spec_k: int = 4):
+        from deeplearning4j_tpu.models.zoo import (DecodeStepper,
+                                                   PagedDecodeStepper)
 
         if mode not in ("continuous", "drain"):
             raise ValueError(f"unknown scheduler mode {mode!r}")
+        if kv not in ("dense", "paged"):
+            raise ValueError(f"unknown kv cache layout {kv!r}; "
+                             "want 'dense' or 'paged'")
+        if kv == "dense" and prefix_cache:
+            raise ValueError(
+                "prefix_cache requires kv='paged' (a hit installs pool "
+                "pages by reference; the dense stepper has none to share)")
         self.model_name = model_name
         self.mode = mode
-        self.stepper = DecodeStepper(cg, slots)
+        self.kv = kv
+        if kv == "paged":
+            self.stepper = PagedDecodeStepper(cg, slots,
+                                              page_size=page_size,
+                                              pages=kv_pages)
+        else:
+            self.stepper = DecodeStepper(cg, slots)
         self.slots = self.stepper.slots
         self.capacity = self.stepper.capacity
+        # Draft-model speculative decoding: a second (small) stepper
+        # proposes spec_k tokens per round; the target verifies them in
+        # ONE step_k dispatch. Both steppers advance in lockstep, so the
+        # effective capacity is the smaller of the two caches.
+        self._draft_stepper = None
+        self._spec_k = int(spec_k)
+        if draft is not None:
+            if self._spec_k < 1:
+                raise ValueError("spec_k must be >= 1 with a draft model")
+            self._draft_stepper = DecodeStepper(draft, self.slots)
+            self.capacity = min(self.capacity,
+                                self._draft_stepper.capacity)
+        # Prefix cache rides the page pool (default on for paged): repeat
+        # prompts install shared pages + replay the stored first-token
+        # distribution instead of prefilling.
+        self._prefix_cache = None
+        if kv == "paged" and (prefix_cache is None or prefix_cache):
+            from deeplearning4j_tpu.models.kv_pool import PrefixCache
+
+            self._prefix_cache = PrefixCache(
+                self.stepper.pool, max_entries=prefix_cache_entries)
+            self.stepper.pool.reclaim = self._prefix_cache.evict_one
         self.prompt_buckets = prompt_bucket_ladder(self.capacity,
                                                    prompt_buckets)
         self._queue: "queue.Queue[Optional[GenerationRequest]]" = queue.Queue(
@@ -114,6 +155,11 @@ class GenerationScheduler:
         self._thread: Optional[threading.Thread] = None
         _m.MODEL_QUEUE_DEPTH.labels(
             model=model_name, route="generate").set_function(self._queue.qsize)
+        if kv == "paged":
+            pool = self.stepper.pool
+            for st in ("free", "used", "shared"):
+                _m.KV_PAGES.labels(model=model_name, state=st).set_function(
+                    lambda s=st, p=pool: p.counts()[s])
 
     # ------------------------------------------------------------ control
 
@@ -144,11 +190,23 @@ class GenerationScheduler:
 
     def warmup(self) -> None:
         """Compile every prefill bucket + the step program into the AOT
-        store before traffic (one short throwaway generation per bucket)."""
+        store before traffic (one short throwaway generation per bucket).
+        With a draft model, also warms the draft's programs and every
+        speculative verify width (k_round shrinks from spec_k to 0 near
+        capacity, and each T is its own traced program)."""
         for b in self.prompt_buckets:
             probs, slot_state, n = self.stepper.prefill([0], pad_to=b)
         self.stepper.install(0, slot_state, n)
         self.stepper.step([0] * self.slots)
+        if self._draft_stepper is not None:
+            for t in range(2, self._spec_k + 2):
+                self.stepper.rewind_all([n] + [0] * (self.slots - 1))
+                self.stepper.step_k(np.zeros((self.slots, t), np.int64))
+            for b in self.prompt_buckets:
+                _, dstate, dn = self._draft_stepper.prefill([0], pad_to=b)
+            self._draft_stepper.install(0, dstate, dn)
+            self._draft_stepper.step([0] * self.slots)
+            self._draft_stepper.clear(0)
         self.stepper.clear(0)
 
     # ---------------------------------------------------------- admission
@@ -211,6 +269,41 @@ class GenerationScheduler:
             req.error = "__deadline__"
         req.event.set()
 
+    def _install_prompt(self, slot: int, req: GenerationRequest,
+                        pad_to: int):
+        """Get `slot` holding `req.prompt`'s KV and return the first-token
+        distribution. Prefix-cache hit: point the slot at the resident
+        pages and replay the STORED distribution — zero model dispatches,
+        so TTFT on a repeat prompt is pure sampling. Miss: prefill,
+        install, and admit the fresh pages into the cache."""
+        cache = self._prefix_cache
+        hit = cache.get(req.prompt) if cache is not None else None
+        if hit is not None:
+            pages, n, probs = hit
+            self.stepper.install_shared(slot, pages, n)
+            _m.PREFIX_CACHE_HITS.labels(model=self.model_name).inc()
+        else:
+            # parent_ctx is explicit: the decode-loop thread has no
+            # enclosing span stack to inherit from.
+            with _obs.tracer.span("serving.prefill", cat="serving",
+                                  parent_ctx=req.ctx,
+                                  model=self.model_name, pad_to=pad_to):
+                probs, slot_state, n = self.stepper.prefill(req.prompt,
+                                                            pad_to=pad_to)
+                self.stepper.install(slot, slot_state, n)
+            if cache is not None:
+                _m.PREFIX_CACHE_MISSES.labels(model=self.model_name).inc()
+                cache.admit(req.prompt, self.stepper.pool.pages_of(slot),
+                            n, probs)
+        if self._draft_stepper is not None:
+            # The draft always prefills (its dense cache has no pages to
+            # share) — it is the small model, so a prefix hit still skips
+            # the expensive target prefill.
+            _, dstate, dn = self._draft_stepper.prefill(req.prompt,
+                                                        pad_to=pad_to)
+            self._draft_stepper.install(slot, dstate, dn)
+        return probs
+
     def _admit(self, slot: int, req: GenerationRequest) -> bool:
         """Prefill + install + first token. Returns True when the request
         stays active in `slot` (False: finished or failed at admission)."""
@@ -224,14 +317,7 @@ class GenerationScheduler:
                 time.perf_counter_ns() - req.t_submit_ns, cat="serving",
                 parent_ctx=req.ctx, model=self.model_name)
         try:
-            # parent_ctx is explicit: the decode-loop thread has no
-            # enclosing span stack to inherit from.
-            with _obs.tracer.span("serving.prefill", cat="serving",
-                                  parent_ctx=req.ctx,
-                                  model=self.model_name, pad_to=pad_to):
-                probs, slot_state, n = self.stepper.prefill(req.prompt,
-                                                            pad_to=pad_to)
-                self.stepper.install(slot, slot_state, n)
+            probs = self._install_prompt(slot, req, pad_to)
         except Exception as e:
             req.error = f"{type(e).__name__}: {e}"
             req.event.set()
@@ -240,14 +326,19 @@ class GenerationScheduler:
             time.monotonic() - req.t_submit)
         self._sample(req, probs)
         if req.done:
-            self.stepper.clear(slot)
+            self._clear_slot(slot)
             req.event.set()
             return False
         return True
 
+    def _clear_slot(self, slot: int) -> None:
+        self.stepper.clear(slot)
+        if self._draft_stepper is not None:
+            self._draft_stepper.clear(slot)
+
     def _retire(self, slot: int, req: GenerationRequest,
                 timed_out: bool = False) -> None:
-        self.stepper.clear(slot)
+        self._clear_slot(slot)
         if timed_out:
             self._finish_timeout(req)
         else:
@@ -298,6 +389,9 @@ class GenerationScheduler:
             busy_gauge.set(len(active))
             if not active:
                 continue
+            if self._draft_stepper is not None:
+                self._spec_round(active, free, step_hist)
+                continue
             tokens = [active[s].ids[-1] if s in active else 0
                       for s in range(self.slots)]
             t0_ns = time.perf_counter_ns()
@@ -323,6 +417,93 @@ class GenerationScheduler:
                     self._retire(slot, req)
                     del active[slot]
                     free.append(slot)
+
+    def _spec_round(self, active: Dict[int, GenerationRequest],
+                    free: List[int], step_hist) -> None:
+        """One speculative decode round (Leviathan et al., ICML 2023,
+        greedy acceptance).
+
+        Invariant at entry: BOTH steppers have consumed exactly
+        `ids[:-1]` for every active slot (the last sampled token has not
+        been fed yet). The round feeds `[x, d1..dk]` — the pending token
+        plus k draft proposals — through ONE target `step_k` dispatch;
+        row j of the result is the target's distribution after
+        `ids + d1..dj`, so a greedy slot emits tokens left to right while
+        the target's argmax keeps agreeing with the draft (+1 bonus token
+        from the first disagreeing row: that sample is still drawn from a
+        correctly-conditioned target distribution). Both steppers are then
+        REWOUND to `len(ids) - 1`, restoring the invariant regardless of
+        how many rows were accepted — rejected rows stay in the caches
+        beyond the cursor, masked until overwritten. Greedy output is
+        therefore bit-identical to the non-speculative scheduler; the
+        only thing speculation changes is how many target dispatches the
+        same token sequence costs.
+
+        Non-greedy slots emit one token per round from row 0 (exactly the
+        distribution a plain `step` would have produced), so sampled
+        requests stay correct — they just don't accelerate.
+        """
+        draft = self._draft_stepper
+        # Clamp k so target writes (positions len(ids)-1 .. len(ids)+k-1)
+        # never cross capacity — a clamped page index would corrupt the
+        # last page.
+        k = max(0, min(self._spec_k,
+                       min(self.capacity - len(r.ids)
+                           for r in active.values())))
+        x = [active[s].ids[-1] if s in active else 0
+             for s in range(self.slots)]
+        tok = np.zeros((self.slots, k + 1), np.int64)
+        tok[:, 0] = x
+        t0_ns = time.perf_counter_ns()
+        for j in range(k):
+            dprobs = draft.step(tok[:, j])
+            tok[:, j + 1] = dprobs.argmax(axis=-1)
+        if k:
+            # Feed the last proposal so the draft has consumed tok[:, :k+1]
+            # too; the result is unused (rewound below either way).
+            draft.step(tok[:, k])
+        probs = self.stepper.step_k(tok)
+        dur_ns = time.perf_counter_ns() - t0_ns
+        step_hist.observe(dur_ns / 1e9)
+        for req in active.values():
+            if req.ctx is not None:
+                _obs.tracer.complete(
+                    "serving.decode_step", t0_ns, dur_ns, cat="serving",
+                    parent_ctx=req.ctx, model=self.model_name)
+        spec_acc = _m.SPECULATIVE_TOKENS.labels(model=self.model_name,
+                                                outcome="accepted")
+        spec_rej = _m.SPECULATIVE_TOKENS.labels(model=self.model_name,
+                                                outcome="rejected")
+        now = time.monotonic()
+        for slot, req in list(active.items()):
+            if req.cancelled or (req.deadline is not None
+                                 and now > req.deadline):
+                self._retire(slot, req, timed_out=True)
+                del active[slot]
+                free.append(slot)
+                continue
+            greedy = req.temperature <= 0
+            accepted = 0
+            for j in range(k + 1):
+                t = self._sample(req, probs[slot, j])
+                if (req.done or not greedy or j >= k
+                        or t != int(tok[slot, j + 1])):
+                    break
+                accepted += 1
+            if greedy and k:
+                spec_acc.inc(accepted)
+                spec_rej.inc(k - accepted)
+            if req.done:
+                self._retire(slot, req)
+                del active[slot]
+                free.append(slot)
+        # Restore the invariant: truncate both caches back to the tokens
+        # actually kept (retired slots to 0 — their pool pages are
+        # already freed and their table rows zeroed).
+        lengths = [len(active[s].ids) - 1 if s in active else 0
+                   for s in range(self.slots)]
+        self.stepper.rewind_all(lengths)
+        draft.rewind_all(lengths)
 
     def _shutdown(self, active: Dict[int, GenerationRequest]) -> None:
         for slot, req in active.items():

@@ -70,6 +70,11 @@ class InferenceServer:
                  prompt_buckets: Optional[Sequence[int]] = None,
                  generate_queue_depth: int = 64,
                  scheduler_mode: str = "continuous",
+                 kv_cache: str = "dense",
+                 kv_page_size: int = 64,
+                 kv_pages: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None,
+                 draft=None, spec_k: int = 4,
                  default_model: str = "default"):
         self.host = host
         self.port = port
@@ -88,6 +93,16 @@ class InferenceServer:
         self.prompt_buckets = prompt_buckets
         self.generate_queue_depth = int(generate_queue_depth)
         self.scheduler_mode = scheduler_mode
+        # Paged-KV / prefix-cache / speculative-decoding defaults
+        # (per-model overrides in add_model). kv_cache="paged" swaps the
+        # dense DecodeStepper for the page-pool stepper; `draft` is a
+        # small zoo LM proposing spec_k tokens per decode round.
+        self.kv_cache = kv_cache
+        self.kv_page_size = int(kv_page_size)
+        self.kv_pages = kv_pages
+        self.prefix_cache = prefix_cache
+        self.draft = draft
+        self.spec_k = int(spec_k)
         self.default_model = default_model
         self.models = ModelHost(hbm_budget_bytes=hbm_budget_bytes,
                                 on_load=self._attach)
@@ -131,6 +146,12 @@ class InferenceServer:
                   prompt_buckets: Optional[Sequence[int]] = None,
                   generate_queue_depth: Optional[int] = None,
                   scheduler_mode: Optional[str] = None,
+                  kv_cache: Optional[str] = None,
+                  kv_page_size: Optional[int] = None,
+                  kv_pages: object = _UNSET,
+                  prefix_cache: object = _UNSET,
+                  draft: object = _UNSET,
+                  spec_k: Optional[int] = None,
                   pinned: Optional[bool] = None):
         """Host another model (server-level knobs are the defaults). With
         `path`, the checkpoint loads now and can be LRU-evicted/reloaded
@@ -162,6 +183,14 @@ class InferenceServer:
                 else int(generate_queue_depth)),
             "scheduler_mode": (self.scheduler_mode if scheduler_mode is None
                                else scheduler_mode),
+            "kv_cache": (self.kv_cache if kv_cache is None else kv_cache),
+            "kv_page_size": (self.kv_page_size if kv_page_size is None
+                             else int(kv_page_size)),
+            "kv_pages": (self.kv_pages if kv_pages is _UNSET else kv_pages),
+            "prefix_cache": (self.prefix_cache if prefix_cache is _UNSET
+                             else prefix_cache),
+            "draft": (self.draft if draft is _UNSET else draft),
+            "spec_k": (self.spec_k if spec_k is None else int(spec_k)),
         }
         return self.models.add(name, net=net, path=path, pinned=pinned,
                                **opts)
@@ -182,7 +211,11 @@ class InferenceServer:
                     slots=o["decode_slots"],
                     prompt_buckets=o["prompt_buckets"],
                     queue_depth=o["generate_queue_depth"],
-                    mode=o["scheduler_mode"]).start()
+                    mode=o["scheduler_mode"],
+                    kv=o["kv_cache"], page_size=o["kv_page_size"],
+                    kv_pages=o["kv_pages"],
+                    prefix_cache=o["prefix_cache"],
+                    draft=o["draft"], spec_k=o["spec_k"]).start()
             except Exception:
                 # lm="auto" probes: a model without a KV-cached decode path
                 # simply doesn't serve /generate.

@@ -349,7 +349,8 @@ class TestDispatchMetric:
 
 # The gate below fails when a kernel is added to the registry without a
 # parity test here (or, for flash_attention, in test_flash_attention.py).
-PARITY_COVERED = {"lstm_cell", "fused_update", "norm_act", "flash_attention"}
+PARITY_COVERED = {"lstm_cell", "fused_update", "norm_act", "flash_attention",
+                  "flash_attention_paged"}
 
 
 def test_every_kernel_has_parity_coverage():
@@ -514,6 +515,28 @@ class TestParity:
 
         np.testing.assert_allclose(np.asarray(run(None)),  # auto: pallas
                                    np.asarray(run("xla")),  # dense reference
+                                   rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("t", [1, 3])
+    def test_flash_attention_paged_pallas_matches_xla(self, monkeypatch, t):
+        # Paged gather over a pool with pad tail, zero-page rows, and a
+        # multi-token (speculative verify) query width.
+        rng = np.random.RandomState(9)
+        B, H, D, page, P, NP = 3, 2, 8, 4, 7, 4
+        q = jnp.asarray(rng.randn(B, t, H, D), jnp.float32)
+        kp = jnp.asarray(rng.randn(P, page, H, D), jnp.float32)
+        vp = jnp.asarray(rng.randn(P, page, H, D), jnp.float32)
+        table = jnp.asarray([[1, 2, 3, 0], [4, 0, 0, 0], [0, 0, 0, 0]],
+                            jnp.int32)
+        pos = jnp.asarray([9, 2, 0], jnp.int32)  # row 2: empty slot
+
+        def run(mode):
+            monkeypatch.setenv("DL4J_TPU_KERNEL_FLASH_ATTENTION_PAGED", mode)
+            registry.clear_cache()
+            return kflash.paged_decode_attention(q, kp, vp, table, pos, True)
+
+        np.testing.assert_allclose(np.asarray(run("pallas")),
+                                   np.asarray(run("xla")),
                                    rtol=1e-5, atol=1e-5)
 
 

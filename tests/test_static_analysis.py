@@ -783,6 +783,62 @@ def forward(url):
         assert [f for f in lint_package(rules=["JX013"])] == []
 
 
+# --------------------------------------------------------------- JX014
+
+class TestJX014DenseKVAllocation:
+    def _lint(self, src, path="deeplearning4j_tpu/nn/fake_layer.py"):
+        return lint_source(src, path, rules=["JX014"])
+
+    def test_direct_allocation_fires(self):
+        src = """
+import jax.numpy as jnp
+
+def alloc_cache(conf, slots, H, D):
+    return jnp.zeros((slots, conf.decode_cache_length, H, D))
+"""
+        fs = self._lint(src)
+        assert rules_of(fs) == {"JX014"}
+        assert "kv_pool" in fs[0].message
+
+    def test_aliased_allocation_fires(self):
+        # One aliasing hop: L = conf.decode_cache_length, then zeros((L,))
+        src = """
+import jax.numpy as jnp
+
+def alloc_cache(conf, slots, H, D):
+    L = conf.decode_cache_length
+    return jnp.zeros((slots, L, H, D))
+"""
+        fs = self._lint(src)
+        assert rules_of(fs) == {"JX014"}
+
+    def test_page_granular_allocation_is_clean(self):
+        src = """
+import jax.numpy as jnp
+
+def alloc_pages(pool, H, D):
+    return jnp.zeros((pool.num_pages, pool.page_size, H, D))
+"""
+        assert self._lint(src) == []
+
+    def test_pool_module_is_exempt(self):
+        src = """
+import numpy as np
+
+def table(conf, slots):
+    per = conf.decode_cache_length // 64
+    return np.zeros((slots, per), np.int32)
+"""
+        assert self._lint(
+            src, path="deeplearning4j_tpu/models/kv_pool.py") == []
+        assert rules_of(self._lint(src)) == {"JX014"}
+
+    def test_package_is_clean(self):
+        # The shipped decode path is page-granular (attention primes via
+        # jnp.pad; the steppers size state from templates / pool geometry).
+        assert [f for f in lint_package(rules=["JX014"])] == []
+
+
 # ------------------------------------------------------------ framework
 
 class TestLinterFramework:
@@ -790,7 +846,7 @@ class TestLinterFramework:
         assert set(ALL_RULES) >= {"JX001", "JX002", "JX003", "JX004",
                                   "JX005", "JX006", "JX007", "JX008",
                                   "JX009", "JX010", "JX011", "JX012",
-                                  "JX013"}
+                                  "JX013", "JX014"}
 
     def test_findings_are_typed_and_sorted(self):
         src = """
