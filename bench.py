@@ -1363,6 +1363,133 @@ def bench_lm_int8_serving(steps, warmup):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_lora_multitenant(steps, warmup):
+    """Multi-tenant LoRA serving (nn/transfer.py + the serving adapter
+    plumbing): ONE resident transformer-LM base + N rank-8 adapters
+    served over HTTP. Reports per-adapter predict p50/p99 (client-side
+    wall clock, worst tenant headline), the adapters-at-equal-HBM ratio
+    (how many tenants fit in the HBM one more full base replica would
+    cost — the number PERF.md §24 derives), and the compiles-after-warmup
+    counter, which MUST be 0: adapter switches ride the same compiled
+    programs."""
+    import threading
+    import urllib.request
+
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.zoo import transformer_lm
+    from deeplearning4j_tpu.nn import lora as lora_mod
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.transfer import TransferLearning
+    from deeplearning4j_tpu.serving import InferenceServer
+    from deeplearning4j_tpu.serving.fleet import compiles_total
+    from deeplearning4j_tpu.serving.host import estimate_hbm_bytes
+
+    V, T, N_TENANTS = 256, 64, 4
+    base = ComputationGraph(transformer_lm(
+        vocab_size=V, t=T, d_model=128, n_heads=4, n_blocks=2,
+        decode_cache_length=128)).init()
+
+    server = InferenceServer(base, default_model="lm_lora", warmup=True,
+                             max_batch_size=8, max_delay_ms=1.0,
+                             decode_slots=4, kv_cache="paged",
+                             kv_page_size=16)
+    rng = np.random.RandomState(0)
+    tenants = [f"tenant_{i}" for i in range(N_TENANTS)]
+    for name in tenants:
+        tuned = TransferLearning(base).add_lora(rank=8, alpha=16).build()
+        for lp in tuned.params_tree.values():
+            for pname in list(lp if isinstance(lp, dict) else ()):
+                if pname.endswith(lora_mod.LORA_B):
+                    lp[pname] = jnp.asarray(rng.normal(
+                        0.0, 0.02, lp[pname].shape).astype(np.float32))
+        server.load_adapter(name, net=tuned)
+    server.start()
+    try:
+        if not server.wait_ready(600):
+            raise RuntimeError("lora_multitenant bench: warmup timed out")
+        adapter_bytes = max(
+            r["bytes"] for r in server.models.get("lm_lora").adapter_rows())
+        base_hbm = estimate_hbm_bytes(base)
+
+        c0 = compiles_total()
+        rows = rng.randint(1, V, (8, 8)).tolist()
+        per_tenant = max(16, steps)
+        lats = {name: [] for name in tenants}
+        errors = []
+
+        def client(name, i):
+            body = json.dumps({"data": [rows[i % len(rows)]],
+                               "adapter": name}).encode()
+            req = urllib.request.Request(
+                server.url + "/predict", body,
+                {"Content-Type": "application/json"})
+            t0 = time.perf_counter()
+            try:
+                with urllib.request.urlopen(req, timeout=120) as r:
+                    r.read()
+                lats[name].append(time.perf_counter() - t0)
+            except Exception as e:
+                errors.append(f"{name}: {type(e).__name__}: {e}")
+
+        # Bounded client pool: the stdlib HTTP server's accept backlog
+        # drops connections under a full thundering herd.
+        work = [(name, i) for i in range(per_tenant) for name in tenants]
+        lock = threading.Lock()
+
+        def worker():
+            while True:
+                with lock:
+                    if not work:
+                        return
+                    name, i = work.pop()
+                client(name, i)
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        # One paged generate per tenant: the decode path must also ride
+        # the warmed programs (grouped multi-adapter decode rounds).
+        for name in tenants:
+            body = json.dumps({"prompt_ids": [1, 2, 3], "n_steps": 8,
+                               "temperature": 0.0,
+                               "adapter": name}).encode()
+            req = urllib.request.Request(
+                server.url + "/generate", body,
+                {"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=120) as r:
+                r.read()
+        compiles = compiles_total() - c0
+        if errors:
+            raise RuntimeError(f"lora_multitenant bench: {errors[:3]}")
+        if compiles:
+            raise RuntimeError(
+                f"lora_multitenant bench: {compiles} serving-path compiles "
+                "after warmup (must be 0 — adapter switches may not "
+                "recompile)")
+
+        p99s = {n: float(np.percentile(ls, 99) * 1e3)
+                for n, ls in lats.items()}
+        p50s = {n: float(np.percentile(ls, 50) * 1e3)
+                for n, ls in lats.items()}
+        head = _entry("lora_multitenant_predict_p99_ms",
+                      max(p99s.values()), "ms",
+                      note=f"{N_TENANTS} tenants x {per_tenant} reqs, "
+                           "worst tenant")
+        head["p50_ms"] = round(max(p50s.values()), 2)
+        head["adapters_resident"] = N_TENANTS
+        head["adapter_bytes"] = int(adapter_bytes)
+        head["adapters_per_base_hbm"] = int(base_hbm // adapter_bytes)
+        head["adapter_hbm_ratio"] = round(
+            N_TENANTS * adapter_bytes / max(base_hbm, 1), 4)
+        head["compiles_after_warmup"] = int(compiles)
+        return head
+    finally:
+        server.stop()
+
+
 _ELASTIC_WORKER = """
 import json, os, sys
 wid = sys.argv[1]; addr = sys.argv[2]; root = sys.argv[3]; out = sys.argv[4]
@@ -1748,7 +1875,8 @@ def main():
         "lenet_step,lenet_superstep,fused_update_superstep,"
         "lenet_cold_warm,lenet_pipeline_overlap,word2vec,vgg16,"
         "flash_attn,flash_tri,transformer,"
-        "serving_slo,lm_int8_serving,obs_overhead,elastic_recovery,"
+        "serving_slo,lm_int8_serving,lora_multitenant,obs_overhead,"
+        "elastic_recovery,"
         "fleet_slo,obs_federation,decode_paged"
     ).split(",")
 
@@ -1829,6 +1957,9 @@ def main():
     if "decode_paged" in configs:
         for e in bench_decode_paged(steps, warmup):
             extra[e["metric"]] = e
+    if "lora_multitenant" in configs:
+        e = bench_lora_multitenant(steps, warmup)
+        extra[e["metric"]] = e
     if head is None:  # resnet50 excluded: promote the first extra metric
         if not extra:
             _emit({

@@ -1,4 +1,4 @@
-"""tpulint rules JX001-JX014.
+"""tpulint rules JX001-JX015.
 
 Each rule is a class with a stable ``id``; registration is
 registry-driven (`@register_rule`) so satellite PRs add rules without
@@ -1035,3 +1035,98 @@ class DenseKVAllocationRule(Rule):
                     "sequence depth — back decode state with "
                     "models/kv_pool.py pages (pages x page_size geometry "
                     "through the per-slot page table) instead")
+
+
+@register_rule
+class FrozenLeafTrainingRule(Rule):
+    """JX015: grad/updater work over frozen or LoRA-base leaves outside
+    the transfer-learning seam.
+
+    The freeze contract (`nn/transfer.py`) is that frozen leaves get NO
+    updater state and NO gradient: `frozen_spec` names them,
+    `split_tree` carves the trainable subtree, and both engines build
+    their Adam moments and `jax.value_and_grad` closures over that
+    subtree only. Code that handles frozen/LoRA leaves by hand AND
+    allocates updater state or differentiates in the same function is
+    re-implementing that seam — it will silently pay updater HBM for
+    leaves that never move, and `jax.grad` hard-fails on int8 base
+    leaves the spec would have excluded.
+
+    Heuristic: within one function body, a frozen/LoRA *marker* (a
+    string literal containing ``__lora_``, or an attribute access
+    ``.frozen`` / ``.lora_rank``) co-occurring with a *training op* (a
+    `jax.grad` / `jax.value_and_grad` call, or an ``.init(...)`` call
+    whose receiver mentions an updater). `nn/transfer.py` and
+    `nn/lora.py` ARE the seam and are exempt; the engines stay clean by
+    construction because they consume the spec through
+    `transfer.frozen_spec` / `split_tree` and never spell the marker
+    names.
+    """
+
+    id = "JX015"
+    description = ("updater-state allocation or grad computation over "
+                   "frozen/LoRA leaves outside nn/transfer.py + "
+                   "nn/lora.py")
+
+    _ALLOW = ("nn/transfer.py", "nn/lora.py")
+    _GRAD_FNS = {"grad", "value_and_grad"}
+    _MARKER_ATTRS = {"frozen", "lora_rank"}
+
+    @classmethod
+    def _is_marker(cls, node) -> bool:
+        if (isinstance(node, ast.Constant) and isinstance(node.value, str)
+                and "__lora_" in node.value):
+            return True
+        return (isinstance(node, ast.Attribute)
+                and node.attr in cls._MARKER_ATTRS)
+
+    @staticmethod
+    def _mentions_updater(node) -> bool:
+        for sub in ast.walk(node):
+            name = (sub.id if isinstance(sub, ast.Name)
+                    else sub.attr if isinstance(sub, ast.Attribute)
+                    else None)
+            if name is not None and "updater" in name.lower():
+                return True
+        return False
+
+    def _train_op(self, node):
+        """Label of the grad/updater op a Call node performs, else None."""
+        if not isinstance(node, ast.Call):
+            return None
+        f = node.func
+        if (isinstance(f, ast.Attribute) and f.attr in self._GRAD_FNS
+                and attr_base(f) == "jax"):
+            return f"jax.{f.attr}(...)"
+        if isinstance(f, ast.Name) and f.id in self._GRAD_FNS:
+            return f"{f.id}(...)"
+        if (isinstance(f, ast.Attribute) and f.attr == "init"
+                and self._mentions_updater(f.value)):
+            return "updater-state .init(...)"
+        return None
+
+    def check(self, ctx):
+        rel = ctx.rel.replace("\\", "/")
+        if "/analysis/" in rel or rel.startswith("analysis/"):
+            return
+        if any(rel.endswith(a) for a in self._ALLOW):
+            return
+        for info in ctx.functions.values():
+            ops = []
+            marked = False
+            for node in walk_body(info.node):
+                if self._is_marker(node):
+                    marked = True
+                op = self._train_op(node)
+                if op is not None:
+                    ops.append((node, op))
+            if not (marked and ops):
+                continue
+            for node, op in ops:
+                yield self.finding(
+                    ctx, node,
+                    f"`{op}` in a function that handles frozen/LoRA "
+                    "leaves by hand: frozen leaves must get no updater "
+                    "state and no grad — compute the exclusion with "
+                    "nn/transfer.frozen_spec and build the op over "
+                    "split_tree's trainable half instead")

@@ -15,8 +15,17 @@ Three layers:
 
 `legacy.load_any` opens either this format or the old `model_serializer`
 ZIPs; `legacy.migrate_zip` converts old checkpoints forward.
+`adapters` persists LoRA deltas (`nn/lora.py`) as tiny base-fingerprint-
+pinned checkpoints in the same atomic format.
 """
 
+from deeplearning4j_tpu.checkpoint.adapters import (
+    adapter_meta,
+    base_fingerprint,
+    is_adapter_checkpoint,
+    load_adapter,
+    save_adapter,
+)
 from deeplearning4j_tpu.checkpoint.array_store import (
     CheckpointCorruptError,
     CheckpointError,
@@ -38,6 +47,11 @@ __all__ = [
     "CheckpointCorruptError",
     "CheckpointError",
     "CheckpointManager",
+    "adapter_meta",
+    "base_fingerprint",
+    "is_adapter_checkpoint",
+    "load_adapter",
+    "save_adapter",
     "is_sharded_checkpoint",
     "load_any",
     "migrate_zip",

@@ -1,0 +1,163 @@
+"""Adapter checkpoints: LoRA deltas persisted as tiny sharded snapshots.
+
+A fine-tuned tenant is not a model — it's a rank-r delta over a shared
+base (`nn/lora.py`). This module persists EXACTLY that delta: the
+`__lora_*` leaves, written through the same atomic commit protocol as
+full checkpoints (`store.write_snapshot` — tmp dir + fsync + COMMIT +
+rename), typically a few hundred KB against a multi-GB base.
+
+Every adapter save is pinned to `base_fingerprint(net)` — a content hash
+of the base (non-LoRA) param leaves. `load_adapter` refuses a mismatched
+base: an adapter is only meaningful against the exact weights it was
+trained over, and silently merging it onto a different base produces a
+plausibly-wrong model rather than an error anywhere else.
+
+The serving side (`serving/host.py`) loads many adapters next to ONE
+resident base and merges per request via `lora.merge_adapter` — the
+hundreds-of-tenants-per-base layout.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.checkpoint import store
+from deeplearning4j_tpu.checkpoint.array_store import (
+    CheckpointError,
+    leaf_chunks,
+    read_full,
+)
+from deeplearning4j_tpu.nn import lora as lora_mod
+
+ADAPTER_FORMAT = "deeplearning4j_tpu/lora-adapter"
+ADAPTER_VERSION = 1
+
+_PREFIX = "adapter"
+
+
+def _params_of(net_or_tree) -> Dict[str, Any]:
+    tree = getattr(net_or_tree, "params_tree", net_or_tree)
+    if tree is None:
+        raise CheckpointError("net is not initialized (params_tree is None)")
+    return tree
+
+
+def base_fingerprint(net_or_tree) -> str:
+    """Content hash of the BASE param leaves (LoRA leaves excluded, so a
+    net with resident adapters fingerprints identically to its bare
+    base). Covers key paths, shapes, dtypes and raw bytes — any retrain,
+    quantization or surgery of the base changes it."""
+    base = lora_mod.strip_adapter(_params_of(net_or_tree))
+    h = hashlib.sha256()
+    for key, leaf in sorted(store._flat_items(base, store._PARAMS)):
+        a = np.asarray(leaf)
+        h.update(key.encode())
+        h.update(str(a.shape).encode())
+        h.update(str(a.dtype).encode())
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()[:32]
+
+
+def save_adapter(net, path: str, *, name: Optional[str] = None) -> str:
+    """Write `net`'s LoRA leaves as a committed adapter checkpoint at
+    `path`. The meta records the adapter's name/rank/alpha knobs plus the
+    base fingerprint the delta was trained against."""
+    adapter = lora_mod.extract_adapter(_params_of(net))
+    if not adapter:
+        raise CheckpointError(
+            "net has no LoRA adapter leaves to save (use "
+            "TransferLearning(...).add_lora(...) first)")
+    leaves = []
+    for key, leaf in store._flat_items(adapter, _PREFIX):
+        chunks = list(leaf_chunks(leaf))
+        leaves.append({
+            "key": key,
+            "shape": tuple(np.shape(leaf)),
+            "dtype": str(chunks[0][1].dtype),
+            "chunks": chunks,
+        })
+    alphas = {
+        float(getattr(l, "lora_alpha", None) or 0.0)
+        for l in _conf_layers(net) if getattr(l, "lora_rank", None)
+    } - {0.0}
+    meta = {
+        "format": ADAPTER_FORMAT,
+        "version": ADAPTER_VERSION,
+        "name": name or os.path.basename(os.path.normpath(path)),
+        "rank": lora_mod.adapter_rank(adapter),
+        "alpha": max(alphas) if alphas else None,
+        "base_fingerprint": base_fingerprint(net),
+        "engine": type(net).__name__,
+    }
+    return store.write_snapshot({"leaves": leaves, "meta": meta}, str(path))
+
+
+def _conf_layers(net):
+    conf = getattr(net, "conf", None)
+    if conf is None:
+        return []
+    if hasattr(conf, "vertices"):
+        return [v.layer for v in conf.vertices.values()
+                if getattr(v, "layer", None) is not None]
+    return list(getattr(conf, "layers", []) or [])
+
+
+def is_adapter_checkpoint(path) -> bool:
+    """True for a COMMITTED adapter checkpoint directory (cheap: reads
+    meta only after the COMMIT marker exists)."""
+    if not store.is_sharded_checkpoint(path):
+        return False
+    try:
+        return store.read_meta(str(path)).get("format") == ADAPTER_FORMAT
+    except (OSError, ValueError):
+        return False
+
+
+def adapter_meta(path: str) -> dict:
+    """Validated meta of an adapter checkpoint (verifies the commit
+    manifest and the format tag; no array data read)."""
+    path = str(path)
+    store.verify_checkpoint(path)
+    meta = store.read_meta(path)
+    if meta.get("format") != ADAPTER_FORMAT:
+        raise CheckpointError(
+            f"{path} is a {meta.get('format')!r} checkpoint, not a LoRA "
+            f"adapter ({ADAPTER_FORMAT!r})")
+    return meta
+
+
+def load_adapter(path: str, base_net=None) -> Dict[str, Dict[str, Any]]:
+    """Read an adapter checkpoint back into a delta-only tree
+    (`{layer: {W__lora_*: array}}`, ready for `lora.merge_adapter`).
+
+    When `base_net` is given, the stored base fingerprint is checked
+    against it and a mismatch REFUSES to load — the delta was trained
+    against different base weights and merging it would silently corrupt
+    outputs."""
+    import jax.numpy as jnp
+
+    path = str(path)
+    meta = adapter_meta(path)
+    if base_net is not None:
+        fp = base_fingerprint(base_net)
+        want = meta.get("base_fingerprint")
+        if fp != want:
+            raise CheckpointError(
+                f"adapter {meta.get('name')!r} at {path} was trained "
+                f"against base {want}, but the resident base fingerprints "
+                f"as {fp} — refusing to merge a delta onto different "
+                "weights")
+    index = store.read_index(path)
+    out: Dict[str, Dict[str, Any]] = {}
+    for key, entry in index["leaves"].items():
+        parts = key.split("/")
+        if parts[0] != _PREFIX or len(parts) != 3:
+            raise CheckpointError(f"{path}: unexpected adapter leaf {key!r}")
+        _, lk, leaf_name = parts
+        out.setdefault(lk, {})[leaf_name] = jnp.asarray(
+            read_full(path, entry))
+    return out

@@ -103,13 +103,21 @@ def synthetic_dataset(net, batch_size: int,
 
 def warmup_buckets(net, batch_sizes: Sequence[int],
                    shape: Optional[Sequence[int]] = None,
-                   dtype=np.float32) -> Dict[int, Dict[str, Any]]:
+                   dtype=np.float32,
+                   param_variants: Optional[Sequence[Any]] = None
+                   ) -> Dict[int, Dict[str, Any]]:
     """Bucket-ladder warmup for the serving tier: warm the inference
     program (`output`, train=False — the exact static signature
     `net.output` dispatches) at EVERY padded batch-size bucket, so no
     admitted request shape ever triggers an XLA compile. Features-only —
-    parameters, optimizer state and RNG are untouched. Returns
-    `{bucket: warmup summary}`."""
+    parameters, optimizer state and RNG are untouched.
+
+    `param_variants`: substitute params trees (adapter-merged serving
+    trees — `nn/lora.py`) to warm IN ADDITION to the net's own at every
+    bucket. A merged tree carries `__lora_*` leaves, a different jit
+    signature than the bare base, so per-adapter dispatch only stays
+    compile-free after warming a variant-shaped program per bucket.
+    Returns `{bucket: warmup summary}`."""
     from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
 
     fshape = tuple(shape) if shape else infer_feature_shape(net)
@@ -123,7 +131,8 @@ def warmup_buckets(net, batch_sizes: Sequence[int],
         x = np.zeros((b,) + fshape, dtype)
         ds = (MultiDataSet(features=[x], labels=None) if is_graph
               else DataSet(x, None))
-        out[b] = warmup_net(net, ds, kinds=("output",))
+        out[b] = warmup_net(net, ds, kinds=("output",),
+                            param_variants=param_variants)
     return out
 
 
@@ -243,7 +252,7 @@ def _stack_superbatch(ds, k: int, is_graph: bool):
 
 def warmup_net(net, data=None, kinds: Optional[Sequence[str]] = None,
                background: bool = False, batch_size: int = 32,
-               context=None):
+               context=None, param_variants: Optional[Sequence[Any]] = None):
     """Pre-compile `net`'s programs for the given example batch(es).
 
     `data`: a DataSet / MultiDataSet / `(features, labels)` tuple, a list
@@ -251,6 +260,11 @@ def warmup_net(net, data=None, kinds: Optional[Sequence[str]] = None,
     batch from the model's declared input type. `kinds` defaults to
     train_step + output + score (+ train_superstep when the superstep knob
     is active); labels-free items warm only `output`.
+
+    `param_variants`: extra params trees to warm the inference program
+    with (args[0] substituted) — adapter-merged serving trees have their
+    own jit signature, and the synthetic-dataset path would otherwise
+    only ever warm the net's bare base tree.
 
     Returns a summary dict ``{"programs", "aot", "compiled", "ready",
     "jit", "seconds"}`` — or, with `background=True`, the started daemon
@@ -266,23 +280,25 @@ def warmup_net(net, data=None, kinds: Optional[Sequence[str]] = None,
 
     if background:
         thread = threading.Thread(
-            target=_warmup_worker, args=(net, items, kinds, ctx),
+            target=_warmup_worker,
+            args=(net, items, kinds, ctx, param_variants),
             name="dl4j-warmup", daemon=True)
         thread.warmup_result = None
         thread.warmup_error = None
         thread.start()
         return thread
     with parallel_context(ctx):
-        return _warmup_items(net, items, kinds)
+        return _warmup_items(net, items, kinds, param_variants)
 
 
-def _warmup_worker(net, items, kinds, ctx):
+def _warmup_worker(net, items, kinds, ctx, param_variants=None):
     from deeplearning4j_tpu.parallel.context import parallel_context
 
     thread = threading.current_thread()
     try:
         with parallel_context(ctx):
-            thread.warmup_result = _warmup_items(net, items, kinds)
+            thread.warmup_result = _warmup_items(net, items, kinds,
+                                                 param_variants)
     except Exception as e:  # surfaced via the thread object, not the log
         thread.warmup_error = e
 
@@ -305,7 +321,7 @@ def _normalize_items(net, data, batch_size: int) -> List[Any]:
     return [_normalize_items(net, item, batch_size)[0] for item in data]
 
 
-def _warmup_items(net, items, kinds) -> Dict[str, Any]:
+def _warmup_items(net, items, kinds, param_variants=None) -> Dict[str, Any]:
     from deeplearning4j_tpu.datasets.iterators import (
         MultiSuperbatch, Superbatch)
     from deeplearning4j_tpu.nn import superstep as _superstep
@@ -352,7 +368,11 @@ def _warmup_items(net, items, kinds) -> Dict[str, Any]:
             # always requested with train=False (`net.output` passes it),
             # and a static mismatch is a different cached program.
             static = {"train": False} if kind == "output" else {}
-            warm(kind, static, make(net, item, kind))
+            args = make(net, item, kind)
+            warm(kind, static, args)
+            if kind == "output":
+                for variant in (param_variants or ()):
+                    warm(kind, static, (variant,) + args[1:])
         if k > 1 and kinds is None and has_labels:
             sb = _stack_superbatch(item, k, is_graph)
             warm("train_superstep", {"k": k, "scan": _superstep.use_scan()},

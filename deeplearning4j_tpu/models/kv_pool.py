@@ -270,10 +270,13 @@ class PrefixCache:
     def __len__(self) -> int:
         return len(self._entries)
 
-    def get(self, prompt: Sequence[int]):
+    def get(self, prompt: Sequence[int], namespace=None):
         """`(pages, length, probs)` for an exact prompt match (LRU
-        refresh), else None. Counts hits/misses."""
-        key = tuple(int(i) for i in prompt)
+        refresh), else None. Counts hits/misses. `namespace` partitions
+        the key space — the SAME prompt prefilled through different
+        param trees (per-adapter serving) has different KV, so a hit must
+        never cross adapters."""
+        key = (namespace,) + tuple(int(i) for i in prompt)
         ent = self._entries.get(key)
         if ent is None:
             self.misses += 1
@@ -283,10 +286,11 @@ class PrefixCache:
         return ent
 
     def admit(self, prompt: Sequence[int], pages: Sequence[int],
-              length: int, probs) -> None:
+              length: int, probs, namespace=None) -> None:
         """Cache a freshly-prefilled prompt: +1 ref on its pages, store
-        the next-token distribution, LRU-evict beyond `max_entries`."""
-        key = tuple(int(i) for i in prompt)
+        the next-token distribution, LRU-evict beyond `max_entries`.
+        `namespace` must match the `get` that missed (see there)."""
+        key = (namespace,) + tuple(int(i) for i in prompt)
         if key in self._entries or not pages:
             return
         self.pool.ref(pages)

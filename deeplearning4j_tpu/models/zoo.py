@@ -504,6 +504,21 @@ class DecodeStepper:
         self._declared = cg._declared_state()
         self._state = None  # batched rnn overlay; allocated on first install
         self._rng0 = jax.random.PRNGKey(0)
+        # Multi-tenant serving (serving/scheduler.py): an adapter-merged
+        # params tree substituted for `cg.params_tree` on the next
+        # dispatches. Params are jit ARGUMENTS, not statics, so swapping
+        # trees of the same structure re-uses the compiled program —
+        # zero serving-path compiles on adapter switches.
+        self.params_override = None
+
+    def set_params(self, params_tree) -> None:
+        """Route subsequent prefill/step dispatches through `params_tree`
+        (None restores the graph's own params)."""
+        self.params_override = params_tree
+
+    def _params(self):
+        return (self.cg.params_tree if self.params_override is None
+                else self.params_override)
 
     # -- prompt path ------------------------------------------------------
 
@@ -532,7 +547,7 @@ class DecodeStepper:
         x = np.zeros((1, pad_to, 1), np.float32)
         x[0, :n, 0] = ids
         fn = self.cg._get_jit("output", train=False, keep_rnn_state=True)
-        outs, new_state = fn(self.cg.params_tree, self.cg.state,
+        outs, new_state = fn(self._params(), self.cg.state,
                              [jnp.asarray(x)], None, self._rng0)
         rnn = rnn_mod.split_rnn_state(new_state, self._declared)
         # Rewind every cursor from pad_to to the real length.
@@ -581,6 +596,11 @@ class DecodeStepper:
                 if v.ndim == 1 and jnp.issubdtype(v.dtype, jnp.integer):
                     s[k] = v.at[slot].set(0)
 
+    def warm_page_copies(self):
+        """Compile any lazily-dispatched page-maintenance ops before
+        traffic. The dense stepper has none; the paged stepper overrides
+        this with a self-copy that traces the CoW append path."""
+
     # -- decode path ------------------------------------------------------
 
     def _before_dispatch(self, t: int):
@@ -598,7 +618,7 @@ class DecodeStepper:
             raise RuntimeError("no sequence installed; call prefill/install")
         fn = self.cg._get_jit("output", train=False, keep_rnn_state=True)
         state = rnn_mod.merge_rnn_state(self.cg.state, self._state)
-        outs, new_state = fn(self.cg.params_tree, state,
+        outs, new_state = fn(self._params(), state,
                              [jnp.asarray(x)], None, self._rng0)
         self._state = rnn_mod.split_rnn_state(new_state, self._declared)
         out = np.asarray(outs[0])
@@ -761,6 +781,23 @@ class PagedDecodeStepper(DecodeStepper):
         self.pool.free_slot(slot)
         super().clear(slot)
 
+    def warm_page_copies(self):
+        """Trace the CoW page copy (`k_pages[src]` gather + `.at[dst]`
+        scatter) with a page-0 self-copy. A prefix-cache hit's first
+        divergent append runs these exact eager ops in `_before_dispatch`;
+        without this they compile mid-decode on the first shared-page
+        write, which breaks the zero-compiles-after-warmup guarantee."""
+        import numpy as np
+        import jax.numpy as jnp
+
+        if self._state is None:
+            return
+        idx = jnp.asarray(np.asarray([0], np.int32))
+        for layer in self._attn_layers:
+            s = self._state[layer]
+            s["k_pages"] = s["k_pages"].at[idx].set(s["k_pages"][idx])
+            s["v_pages"] = s["v_pages"].at[idx].set(s["v_pages"][idx])
+
     def rewind_all(self, lengths):
         import numpy as np
 
@@ -776,9 +813,13 @@ class PagedDecodeStepper(DecodeStepper):
         import jax.numpy as jnp
 
         copies = self.pool.plan_appends(t)
-        if copies:
-            src = jnp.asarray(np.asarray([c[0] for c in copies], np.int32))
-            dst = jnp.asarray(np.asarray([c[1] for c in copies], np.int32))
+        # One width-1 copy per CoW'd page, not one width-N batch: how many
+        # slots diverge in the same round is scheduling-dependent, and each
+        # distinct N would trace a fresh gather/scatter shape mid-decode.
+        # Width 1 reuses the program `warm_page_copies` compiled.
+        for src_page, dst_page in copies:
+            src = jnp.asarray(np.asarray([src_page], np.int32))
+            dst = jnp.asarray(np.asarray([dst_page], np.int32))
             for layer in self._attn_layers:
                 s = self._state[layer]
                 s["k_pages"] = s["k_pages"].at[dst].set(s["k_pages"][src])

@@ -95,6 +95,15 @@ class Layer:
     epsilon: Optional[float] = None
     gradient_normalization: Optional[Any] = None
     gradient_normalization_threshold: Optional[float] = None
+    # Transfer learning / LoRA (nn/transfer.py, nn/lora.py). None keeps the
+    # serialized conf byte-identical to pre-transfer checkpoints (to_dict
+    # skips None fields). `frozen=True` excludes the layer's base params
+    # from grads and updater state; `lora_rank` adds `<name>__lora_a/b`
+    # sibling leaves for every 2-D weight (base weights become frozen,
+    # adapters train).
+    frozen: Optional[bool] = None
+    lora_rank: Optional[int] = None
+    lora_alpha: Optional[float] = None
 
     # ---- shape inference ----
     def get_output_type(self, input_type: InputType) -> InputType:

@@ -41,6 +41,7 @@ from deeplearning4j_tpu.ops import schedules as schedules_mod
 from deeplearning4j_tpu.ops import updaters as updaters_mod
 from deeplearning4j_tpu.nn import jit_cache as jit_cache_mod
 from deeplearning4j_tpu.nn import superstep as _superstep
+from deeplearning4j_tpu.nn import transfer as transfer_mod
 from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.datasets import staging as _staging
 from deeplearning4j_tpu.datasets.iterators import (
@@ -200,9 +201,19 @@ class ComputationGraph:
                 g.lr_policy, g.lr_policy_decay_rate, g.lr_policy_power,
                 g.lr_policy_steps, g.max_num_iterations, g.lr_schedule,
             )
+        # Transfer learning / LoRA (nn/transfer.py): frozen leaves get NO
+        # updater state — opt_state is built over the trainable subtree
+        # (a fully-frozen vertex's entry is ()). Empty spec (the common
+        # case) keeps the structures byte-identical to before.
+        self._frozen_spec = transfer_mod.frozen_spec(
+            ((n, v.layer) for n, v in self.layer_vertices.items()),
+            self.params_tree)
         opt_base = master if master is not None else self.params_tree
+        opt_src = (transfer_mod.split_tree(opt_base, self._frozen_spec)[0]
+                   if self._frozen_spec else opt_base)
         self.opt_state = {
-            name: self._updaters[name].init(opt_base[name])
+            name: (() if name in self._frozen_spec and not opt_src[name]
+                   else self._updaters[name].init(opt_src[name]))
             for name in self.layer_vertices
         }
         # Reserved opt_state keys (never vertex names): f32 master params
@@ -595,7 +606,19 @@ class ComputationGraph:
 
     def _train_step(self, params, state, opt_state, inputs, labels, fmasks, lmasks,
                     step, rng, carry_rnn=False, ebs=None, collect_stats=False):
+        # Transfer learning / LoRA: differentiate the TRAINABLE subtree
+        # only — frozen leaves (incl. int8 bases, which jax.grad refuses)
+        # close over the loss as constants and re-attach to the outputs
+        # as the same arrays. Empty spec: identity, program unchanged.
+        spec = getattr(self, "_frozen_spec", None)
+        if spec:
+            params, frozen_stored = transfer_mod.split_tree(params, spec)
+        else:
+            frozen_stored = None
+
         def loss_fn(p):
+            if frozen_stored is not None:
+                p = transfer_mod.merge_tree(p, frozen_stored)
             outs, new_state, aux, omasks = self._forward_fn(
                 p, state, inputs, rng, True, fmasks, keep_rnn_state=carry_rnn
             )
@@ -635,6 +658,9 @@ class ComputationGraph:
         # Low-precision params: updates apply to the f32 MASTER copy; stored
         # params are its cast (no bf16/f16 update underflow).
         base = opt_state["_master"] if lowp else params
+        frozen_master = None
+        if spec and lowp:
+            base, frozen_master = transfer_mod.split_tree(base, spec)
         g = self.conf.global_conf
         sign = 1.0 if g.minimize else -1.0
         new_base, new_opt = {}, {}
@@ -703,7 +729,16 @@ class ComputationGraph:
 
         if lowp:
             new_params = params_mod.cast_floating(new_base, pol.jnp_param)
-            new_opt["_master"] = new_base
+            if frozen_stored is not None:
+                # Frozen STORED leaves pass through untouched (no recast);
+                # the master keeps its frozen f32 copies alongside.
+                new_params = transfer_mod.merge_tree(new_params, frozen_stored)
+                new_opt["_master"] = transfer_mod.merge_tree(
+                    new_base, frozen_master)
+            else:
+                new_opt["_master"] = new_base
+        elif frozen_stored is not None:
+            new_params = transfer_mod.merge_tree(new_base, frozen_stored)
         else:
             new_params = new_base
         if scaling:
@@ -1072,9 +1107,15 @@ class ComputationGraph:
 
     # -------------------------------------------------------------- predict
 
-    def output(self, *inputs, train: bool = False, features_masks=None) -> List[np.ndarray]:
+    def output(self, *inputs, train: bool = False, features_masks=None,
+               params=None) -> List[np.ndarray]:
+        """`params` substitutes another params tree of the same structure
+        (e.g. an adapter-merged serving tree — `nn/lora.py`) for this
+        net's own; params are jit arguments, so the swap re-uses the
+        compiled program."""
         fn = self._get_jit("output", train=train)
-        outs, _ = fn(self.params_tree, self.state,
+        outs, _ = fn(self.params_tree if params is None else params,
+                     self.state,
                      [jnp.asarray(x) for x in inputs],
                      features_masks,
                      self._next_rng() if train else jax.random.PRNGKey(0))

@@ -1,0 +1,145 @@
+"""LoRA adapters as sibling low-rank param leaves (Hu et al., "LoRA").
+
+A layer configured with `lora_rank=r` grows, for every 2-D weight `W` in
+its `param_shapes()`, three sibling leaves in the SAME layer param dict:
+
+    W__lora_a      [n_in, r]   gaussian-init (trainable)
+    W__lora_b      [r, n_out]  zero-init (trainable; zero => delta starts 0)
+    W__lora_scale  [] f32      alpha / r (constant, never trained)
+
+The effective weight is resolved inside jit at the `prep_layer_params`
+seam (`nn/params.py`): `W_eff = W + scale * (A @ B)`, computed at the
+policy compute dtype so XLA fuses the rank-r delta into the consuming
+matmul. Because the base weight is dequantized at the same seam, adapters
+compose with int8 post-training-quantized bases (`q * qscale + AB`)
+without ever materializing a dense f32 weight.
+
+Storing adapters as sibling leaves (not a parallel module tree) means
+checkpointing, sharding, flat-view and serving code see one ordinary
+pytree; `extract_adapter` / `merge_adapter` convert between a full tree
+and the tiny delta-only tree that `checkpoint/adapters.py` persists and
+`serving/host.py` hot-swaps per request.
+
+Freezing of the base weights (and the updater-state exclusion that makes
+LoRA fine-tuning cheap) lives in `nn/transfer.py`.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.layers import Layer
+
+LORA_A = "__lora_a"
+LORA_B = "__lora_b"
+LORA_SCALE = "__lora_scale"
+_SUFFIXES = (LORA_A, LORA_B, LORA_SCALE)
+
+# A-factor init stddev (Hu et al. init: A ~ N(0, sigma^2), B = 0, so the
+# delta starts at exactly zero and the first forward equals the base).
+_A_INIT_STD = 0.02
+
+
+def is_lora_leaf(name: str) -> bool:
+    return name.endswith(_SUFFIXES)
+
+
+def base_name(name: str) -> str:
+    """`W__lora_a` -> `W` (identity for non-adapter names)."""
+    for suf in _SUFFIXES:
+        if name.endswith(suf):
+            return name[: -len(suf)]
+    return name
+
+
+def lora_target_names(conf: Layer) -> List[str]:
+    """The layer weights that take adapters: its declared 2-D weight
+    params (Dense/Output/Embedding W, attention Wq/Wk/Wv/Wo, LSTM W/RW,
+    positional tables). Conv HWIO 4-D and MoE stacked 3-D tables are
+    excluded — the low-rank factorization below is a plain matmul."""
+    shapes = conf.param_shapes()
+    return [k for k in conf.weight_param_keys() if len(shapes[k]) == 2]
+
+
+def init_lora_params(conf: Layer, rng: jax.Array,
+                     dtype=jnp.float32) -> Dict[str, jnp.ndarray]:
+    """Fresh adapter leaves for one layer config (empty when `lora_rank`
+    is unset). Scale is kept in its own f32 scalar leaf rather than baked
+    into A so a checkpointed adapter records alpha/r explicitly."""
+    r = int(getattr(conf, "lora_rank", None) or 0)
+    if r <= 0:
+        return {}
+    alpha = float(getattr(conf, "lora_alpha", None) or r)
+    shapes = conf.param_shapes()
+    out: Dict[str, jnp.ndarray] = {}
+    for i, name in enumerate(lora_target_names(conf)):
+        n_in, n_out = shapes[name]
+        key = jax.random.fold_in(rng, i)
+        out[name + LORA_A] = (
+            jax.random.normal(key, (n_in, r), dtype) * _A_INIT_STD)
+        out[name + LORA_B] = jnp.zeros((r, n_out), dtype)
+        out[name + LORA_SCALE] = jnp.asarray(alpha / r, jnp.float32)
+    return out
+
+
+def extract_adapter(params_tree: Dict[str, Dict[str, jnp.ndarray]]
+                    ) -> Dict[str, Dict[str, jnp.ndarray]]:
+    """The delta-only subtree: every `__lora_*` leaf, keyed like the full
+    tree. Layers without adapters are omitted (keeps checkpoints tiny)."""
+    out: Dict[str, Dict[str, jnp.ndarray]] = {}
+    for lk, lparams in params_tree.items():
+        if not isinstance(lparams, dict):
+            continue
+        leaves = {k: a for k, a in lparams.items() if is_lora_leaf(k)}
+        if leaves:
+            out[lk] = leaves
+    return out
+
+
+def strip_adapter(params_tree: Dict[str, Dict[str, jnp.ndarray]]
+                  ) -> Dict[str, Dict[str, jnp.ndarray]]:
+    """The base-only tree: same structure with every `__lora_*` leaf
+    removed (all layer keys retained)."""
+    return {
+        lk: ({k: a for k, a in lparams.items() if not is_lora_leaf(k)}
+             if isinstance(lparams, dict) else lparams)
+        for lk, lparams in params_tree.items()
+    }
+
+
+def merge_adapter(base_tree: Dict[str, Dict[str, jnp.ndarray]],
+                  adapter: Dict[str, Dict[str, jnp.ndarray]]
+                  ) -> Dict[str, Dict[str, jnp.ndarray]]:
+    """A full serving tree: the base tree (arrays shared, never copied)
+    overlaid with one adapter's leaves. Passing `adapter=None` returns a
+    plain shallow copy — the no-adapter serving path."""
+    out = {
+        lk: (dict(lparams) if isinstance(lparams, dict) else lparams)
+        for lk, lparams in base_tree.items()
+    }
+    for lk, leaves in (adapter or {}).items():
+        if lk not in out:
+            raise KeyError(
+                f"adapter layer {lk!r} not present in base tree "
+                f"(layers: {sorted(base_tree)})")
+        out[lk].update(leaves)
+    return out
+
+
+def adapter_nbytes(adapter: Dict[str, Dict[str, jnp.ndarray]]) -> int:
+    return sum(int(getattr(leaf, "nbytes", 0))
+               for leaf in jax.tree_util.tree_leaves(adapter))
+
+
+def adapter_rank(adapter: Dict[str, Dict[str, jnp.ndarray]]) -> int:
+    """The (max) rank across an adapter's factor pairs — the `r` knob as
+    recoverable from the leaves themselves."""
+    r = 0
+    for lparams in adapter.values():
+        for k, a in lparams.items():
+            if k.endswith(LORA_A) and getattr(a, "ndim", 0) == 2:
+                r = max(r, int(a.shape[1]))
+    return r

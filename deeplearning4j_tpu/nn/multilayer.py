@@ -47,6 +47,7 @@ from deeplearning4j_tpu.ops import schedules as schedules_mod
 from deeplearning4j_tpu.ops import updaters as updaters_mod
 from deeplearning4j_tpu.nn import jit_cache as jit_cache_mod
 from deeplearning4j_tpu.nn import superstep as _superstep
+from deeplearning4j_tpu.nn import transfer as transfer_mod
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets import staging as _staging
 from deeplearning4j_tpu.datasets.iterators import (
@@ -206,9 +207,18 @@ class MultiLayerNetwork:
             )
             for layer in self.layers
         ]
+        # Transfer learning / LoRA (nn/transfer.py): frozen leaves get NO
+        # updater state — opt_state is built over the trainable subtree
+        # (a fully-frozen layer's entry is ()). Empty spec (the common
+        # case) keeps the structures byte-identical to before.
+        self._frozen_spec = transfer_mod.frozen_spec(
+            zip(self.layer_keys, self.layers), self.params_tree)
         base = master if master is not None else self.params_tree
+        opt_src = (transfer_mod.split_tree(base, self._frozen_spec)[0]
+                   if self._frozen_spec else base)
         self.opt_state = {
-            lk: self._updaters[i].init(base[lk])
+            lk: (() if lk in self._frozen_spec and not opt_src[lk]
+                 else self._updaters[i].init(opt_src[lk]))
             for i, lk in enumerate(self.layer_keys)
         }
         # Reserved opt_state keys (never layer keys): the f32 master params
@@ -598,8 +608,20 @@ class MultiLayerNetwork:
         pol = self.dtype_policy
         scaling = pol.uses_loss_scaling
         lowp = pol.low_precision_params
+        # Transfer learning / LoRA: differentiate the TRAINABLE subtree
+        # only — frozen leaves (incl. int8 bases, which jax.grad refuses)
+        # close over the loss as constants, their grads are never built,
+        # and they re-attach to the outputs as the same arrays. Empty
+        # spec: identity, the traced program is unchanged.
+        spec = getattr(self, "_frozen_spec", None)
+        if spec:
+            params, frozen_stored = transfer_mod.split_tree(params, spec)
+        else:
+            frozen_stored = None
 
         def loss_fn(p):
+            if frozen_stored is not None:
+                p = transfer_mod.merge_tree(p, frozen_stored)
             preout, new_state, _, aux = self._forward_fn(
                 p, state, x, rng, True, fmask, keep_rnn_state=carry_rnn
             )
@@ -636,6 +658,9 @@ class MultiLayerNetwork:
         # f32 updater state); stored params are its cast, so tiny updates
         # never underflow bf16/f16 quantization.
         base = opt_state["_master"] if lowp else params
+        frozen_master = None
+        if spec and lowp:
+            base, frozen_master = transfer_mod.split_tree(base, spec)
         new_base, new_opt, stats = self._apply_updates(
             base, grads, opt_state, step, collect_stats=collect_stats)
 
@@ -669,7 +694,16 @@ class MultiLayerNetwork:
 
         if lowp:
             new_params = _cast_floating(new_base, pol.jnp_param)
-            new_opt["_master"] = new_base
+            if frozen_stored is not None:
+                # Frozen STORED leaves pass through untouched (no recast);
+                # the master keeps its frozen f32 copies alongside.
+                new_params = transfer_mod.merge_tree(new_params, frozen_stored)
+                new_opt["_master"] = transfer_mod.merge_tree(
+                    new_base, frozen_master)
+            else:
+                new_opt["_master"] = new_base
+        elif frozen_stored is not None:
+            new_params = transfer_mod.merge_tree(new_base, frozen_stored)
         else:
             new_params = new_base
         if scaling:
@@ -1155,10 +1189,16 @@ class MultiLayerNetwork:
 
     # -------------------------------------------------------------- predict
 
-    def output(self, x, train: bool = False, features_mask=None) -> np.ndarray:
-        """Inference forward (reference: `output()` `:1519-1601`)."""
+    def output(self, x, train: bool = False, features_mask=None,
+               params=None) -> np.ndarray:
+        """Inference forward (reference: `output()` `:1519-1601`).
+        `params` substitutes another params tree of the same structure
+        (e.g. an adapter-merged serving tree — `nn/lora.py`) for this
+        net's own; params are jit arguments, so the swap re-uses the
+        compiled program."""
         fn = self._get_jit("output", train=train)
-        out, _ = fn(self.params_tree, self.state, jnp.asarray(x),
+        out, _ = fn(self.params_tree if params is None else params,
+                    self.state, jnp.asarray(x),
                     None if features_mask is None else jnp.asarray(features_mask),
                     self._next_rng() if train else jax.random.PRNGKey(0))
         return np.asarray(out)

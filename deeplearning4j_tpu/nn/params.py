@@ -92,6 +92,13 @@ def init_layer_params(conf: Layer, rng: jax.Array, dtype=jnp.float32) -> Dict[st
                 scheme=WeightInit.of(conf.weight_init) or WeightInit.XAVIER,
                 distribution=conf.dist, dtype=dtype,
             )
+    if getattr(conf, "lora_rank", None):
+        from deeplearning4j_tpu.nn import lora as _lora
+
+        # Distinct subkey stream so adding adapters never perturbs the
+        # base-weight draws (the base stays bitwise-reproducible).
+        params.update(_lora.init_lora_params(
+            conf, jax.random.fold_in(rng, len(shapes) + 1), dtype))
     return params
 
 
@@ -110,21 +117,39 @@ def prep_layer_params(lparams: Dict[str, jnp.ndarray], compute_dtype):
     `checkpoint/quantize.py`) dequantize as `q * scale` AT the compute
     dtype, so XLA fuses the dequant into the consuming matmul/conv and the
     f32 weights never materialize in HBM. Default-policy nets trace the
-    exact same cast as the old inline `tree_map`."""
+    exact same cast as the old inline `tree_map`.
+
+    LoRA adapter leaves (`nn/lora.py`) resolve here too: a weight with
+    `<name>__lora_a` / `<name>__lora_b` siblings becomes
+    `W_eff = base + scale * (A @ B)` at the compute dtype, where `base`
+    is the (possibly dequantized-int8) weight — adapters compose with
+    quantized bases and the rank-r delta fuses into the consuming
+    matmul. (`<name>__lora_scale` is consumed by the `__scale` suffix
+    skip below; only the factor pair needs explicit handling.)"""
     out: Dict[str, jnp.ndarray] = {}
     for k, a in lparams.items():
-        if k.endswith("__scale"):
-            continue  # consumed alongside its quantized tensor
+        if k.endswith(("__scale", "__lora_a", "__lora_b")):
+            continue  # consumed alongside their base tensor
         if isinstance(a, dict):  # nested sub-tree (defensive): recurse
             out[k] = prep_layer_params(a, compute_dtype)
             continue
         scale = lparams.get(k + "__scale")
         if scale is not None and jnp.issubdtype(a.dtype, jnp.integer):
-            out[k] = a.astype(compute_dtype) * scale.astype(compute_dtype)
+            base = a.astype(compute_dtype) * scale.astype(compute_dtype)
         elif jnp.issubdtype(a.dtype, jnp.floating):
-            out[k] = a.astype(compute_dtype)
+            base = a.astype(compute_dtype)
         else:
             out[k] = a
+            continue
+        la = lparams.get(k + "__lora_a")
+        lb = lparams.get(k + "__lora_b")
+        if la is not None and lb is not None:
+            delta = la.astype(compute_dtype) @ lb.astype(compute_dtype)
+            ls = lparams.get(k + "__lora_scale")
+            if ls is not None:
+                delta = delta * ls.astype(compute_dtype)
+            base = base + delta
+        out[k] = base
     return out
 
 

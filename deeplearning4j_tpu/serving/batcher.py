@@ -127,11 +127,18 @@ def serving_feature_spec(net, warmup_shape=None):
 
 class _Pending:
     __slots__ = ("array", "event", "result", "error", "deadline",
-                 "cancelled", "ctx", "t_submit_ns")
+                 "cancelled", "ctx", "t_submit_ns", "adapter", "params")
 
     def __init__(self, array: np.ndarray,
-                 deadline: Optional[float] = None):
+                 deadline: Optional[float] = None,
+                 adapter: Optional[str] = None, params=None):
         self.array = array
+        # Multi-tenant serving: the adapter name is part of the batch
+        # grouping key (rows dispatched through different param trees
+        # can't share one forward), `params` the merged tree to dispatch
+        # with (None = the model's own base params).
+        self.adapter = adapter
+        self.params = params
         self.event = threading.Event()
         self.result: Optional[np.ndarray] = None
         self.error: Optional[str] = None
@@ -163,6 +170,12 @@ class ShapeBucketBatcher:
         self._queue: "queue.Queue[Optional[_Pending]]" = queue.Queue(
             maxsize=int(queue_depth))
         self._thread: Optional[threading.Thread] = None
+        # Multi-tenant hook (serving/server.py): a callable returning the
+        # adapter-merged param trees to warm alongside the base — the
+        # merged trees carry `__lora_*` leaves, which is a DIFFERENT jit
+        # signature than the bare base tree, so an unwarmed adapter path
+        # would compile on the first adapter request.
+        self.param_variants = None
         _m.MODEL_QUEUE_DEPTH.labels(
             model=model_name, route="predict").set_function(self._queue.qsize)
 
@@ -194,10 +207,11 @@ class ShapeBucketBatcher:
     # ---------------------------------------------------------- admission
 
     def submit(self, arr: np.ndarray,
-               deadline: Optional[float] = None) -> _Pending:
+               deadline: Optional[float] = None,
+               adapter: Optional[str] = None, params=None) -> _Pending:
         """Enqueue one request's rows; sheds (503 + Retry-After) when the
         bounded queue is full instead of growing it."""
-        p = _Pending(arr, deadline)
+        p = _Pending(arr, deadline, adapter=adapter, params=params)
         try:
             self._queue.put_nowait(p)
         except queue.Full:
@@ -220,16 +234,20 @@ class ShapeBucketBatcher:
             raise ValueError(
                 "cannot infer the model's input shape; pass "
                 "warmup_shape=(...) to InferenceServer")
+        variants = (self.param_variants() if callable(self.param_variants)
+                    else self.param_variants)
         if hasattr(self.net, "_get_jit"):
-            warmup_buckets(self.net, self.buckets, shape=shape, dtype=dtype)
+            warmup_buckets(self.net, self.buckets, shape=shape, dtype=dtype,
+                           param_variants=variants)
         else:
             x = np.zeros((self.max_batch_size,) + tuple(shape), dtype)
             np.asarray(self._forward(x))
 
     # ------------------------------------------------------------ batching
 
-    def _forward(self, x: np.ndarray) -> np.ndarray:
-        out = self.net.output(x)
+    def _forward(self, x: np.ndarray, params=None) -> np.ndarray:
+        out = (self.net.output(x, params=params) if params is not None
+               else self.net.output(x))
         if isinstance(out, list):  # ComputationGraph returns [out, ...]
             out = out[0]
         return np.asarray(out)
@@ -256,10 +274,11 @@ class ShapeBucketBatcher:
                 continue
             live.append(p)
         # Requests with different per-example shapes can't share one padded
-        # batch — run one sub-batch per distinct feature shape.
+        # batch, and neither can requests dispatching through different
+        # adapter trees — run one sub-batch per (shape, adapter) group.
         groups: dict = {}
         for p in live:
-            groups.setdefault(p.array.shape[1:], []).append(p)
+            groups.setdefault((p.array.shape[1:], p.adapter), []).append(p)
         for group in groups.values():
             self._run_group(group)
 
@@ -287,7 +306,7 @@ class ShapeBucketBatcher:
             with _obs.tracer.span("serving.batch", cat="serving",
                                   model=self.model_name, requests=len(live),
                                   rows=n, padded_to=bucket):
-                preds = self._forward(x)[:n]
+                preds = self._forward(x, params=live[0].params)[:n]
             dur_fwd = time.perf_counter_ns() - t_fwd
             for p in traced:
                 _obs.tracer.complete(

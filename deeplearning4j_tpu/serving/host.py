@@ -148,7 +148,16 @@ def _measure_hbm(model: "ServedModel") -> None:
 
 class ServedModel:
     """One hosted model: the engine plus its serving runtime (batcher and,
-    for LMs, the generation scheduler), readiness, and LRU bookkeeping."""
+    for LMs, the generation scheduler), readiness, and LRU bookkeeping.
+
+    Multi-tenant serving: `adapters` holds LoRA deltas (`nn/lora.py`)
+    registered next to this ONE resident base. Each entry keeps the tiny
+    delta tree plus a lazily-built merged params tree (base arrays shared
+    by reference — the per-adapter HBM cost is the delta alone); requests
+    select an adapter by name and dispatch through the merged tree.
+    Because every merged tree has the same pytree structure, all adapters
+    share one compiled program per shape — hot-swapping adapters costs
+    zero serving-path compiles."""
 
     def __init__(self, name: str, net=None, path=None, pinned=False,
                  options: Optional[dict] = None):
@@ -167,6 +176,9 @@ class ServedModel:
                           else estimate_checkpoint_bytes(path)
                           if path is not None else 0)
         self.dtype = model_dtype(net=net, path=path)
+        # name -> {"tree": delta, "rank": int, "bytes": int,
+        #          "pinned": bool, "merged": full tree or None (lazy)}
+        self.adapters: Dict[str, dict] = {}
 
     @property
     def resident(self) -> bool:
@@ -174,6 +186,57 @@ class ServedModel:
 
     def touch(self) -> None:
         self.last_used = time.monotonic()
+
+    # ----------------------------------------------------------- adapters
+
+    def add_adapter(self, name: str, tree, pinned: bool = True) -> dict:
+        """Register one LoRA delta tree under `name` (idempotent re-adds
+        of the same name replace the delta and drop its merged cache)."""
+        from deeplearning4j_tpu.nn import lora as _lora
+
+        entry = {
+            "tree": tree,
+            "rank": _lora.adapter_rank(tree),
+            "bytes": _lora.adapter_nbytes(tree),
+            "pinned": bool(pinned),
+            "merged": None,
+        }
+        self.adapters[name] = entry
+        _m.ADAPTERS_RESIDENT.labels(model=self.name).set(len(self.adapters))
+        return entry
+
+    def adapter_params(self, name: str):
+        """The full serving tree for `name`: base params overlaid with the
+        delta, built once and cached (the cache is dropped on eviction —
+        merged trees hold references into the base arrays)."""
+        entry = self.adapters.get(name)
+        if entry is None:
+            raise KeyError(
+                f"model {self.name!r} hosts no adapter {name!r}; loaded: "
+                f"{sorted(self.adapters) or '(none)'}")
+        if entry["merged"] is None:
+            from deeplearning4j_tpu.nn import lora as _lora
+
+            if self.net is None:
+                raise ModelNotReadyError(
+                    f"model {self.name!r} is not resident; retry shortly")
+            entry["merged"] = _lora.merge_adapter(self.net.params_tree,
+                                                  entry["tree"])
+        return entry["merged"]
+
+    def adapter_trees(self):
+        """{name: merged tree} for every registered adapter (warmup
+        drives each through the compiled-program path)."""
+        return {n: self.adapter_params(n) for n in sorted(self.adapters)}
+
+    def adapter_rows(self) -> List[dict]:
+        """`/v1/models` sub-rows for this model's adapters."""
+        return [{
+            "name": n,
+            "rank": int(e["rank"]),
+            "bytes": int(e["bytes"]),
+            "pinned": bool(e["pinned"]),
+        } for n, e in sorted(self.adapters.items())]
 
 
 class ModelHost:
@@ -319,6 +382,11 @@ class ModelHost:
             model.scheduler = None
         if self.on_evict is not None:
             self.on_evict(model)
+        # Merged adapter trees alias the base arrays: drop the caches (the
+        # tiny deltas stay registered; a reload re-merges lazily against
+        # the fresh base).
+        for entry in model.adapters.values():
+            entry["merged"] = None
         model.net = None  # drop the device buffers
         try:
             from deeplearning4j_tpu.observability import memory as _obsmem
@@ -349,6 +417,7 @@ class ModelHost:
                 "dtype": m.dtype,
                 "path": m.path,
                 "lm": m.scheduler is not None,
+                "adapters": m.adapter_rows(),
             } for m in self._models.values()]
 
     def stop(self) -> None:

@@ -5,11 +5,16 @@ Routes (all JSON):
 - `GET  /health`     liveness (+ hosted model names)
 - `GET  /healthz`    readiness: `{"status": "warming"|"ready", "models": …}`
 - `GET  /metrics`    Prometheus scrape (`?format=json` for the snapshot)
-- `GET  /v1/models`  per-model status / residency / HBM estimate
-- `POST /predict`    `{"data": [[...]], "model"?, "timeout_ms"?}`
+- `GET  /v1/models`  per-model status / residency / HBM estimate / loaded
+                     LoRA adapters (name, rank, bytes, pinned)
+- `POST /predict`    `{"data": [[...]], "model"?, "adapter"?,
+                       "timeout_ms"?}`
 - `POST /generate`   `{"prompt_ids": [...], "n_steps": N, "temperature"?,
                        "top_k"?, "top_p"?, "seed"?, "eos_id"?, "model"?,
-                       "timeout_ms"?}`
+                       "adapter"?, "timeout_ms"?}`
+
+`"adapter"` selects a LoRA delta loaded next to the model's resident base
+(`InferenceServer.load_adapter`); an unknown name is a 400.
 
 When the server is a fleet member (`server.fleet_replica` set by
 `serving/fleet.py`), two admin routes appear and every predict/generate
@@ -185,6 +190,7 @@ def make_handler(server):
                     admitted = self._admit("predict")
                     preds = server.predict(
                         payload["data"], model=name,
+                        adapter=payload.get("adapter"),
                         timeout_s=self._timeout_s(payload))
                 except Exception as e:
                     return self._error(e)
@@ -209,8 +215,8 @@ def make_handler(server):
                     admitted = self._admit("generate")
                     ids = server.generate(
                         payload["prompt_ids"], int(payload["n_steps"]),
-                        model=name, timeout_s=self._timeout_s(payload),
-                        **sampling)
+                        model=name, adapter=payload.get("adapter"),
+                        timeout_s=self._timeout_s(payload), **sampling)
                 except Exception as e:
                     return self._error(e)
                 finally:

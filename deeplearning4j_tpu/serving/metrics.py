@@ -83,6 +83,22 @@ DECODE_SLOTS_BUSY = _obs.metrics.gauge(
     "Generation scheduler slots currently holding an active sequence",
     label_names=("model",))
 
+# ----------------------------------------------------------- multi-tenant
+# LoRA adapter serving (nn/lora.py, checkpoint/adapters.py): hundreds of
+# rank-r deltas resident next to ONE base model, selected per request.
+ADAPTERS_RESIDENT = _obs.metrics.gauge(
+    "dl4j_adapters_resident",
+    "LoRA adapters loaded next to each hosted base model (each is a "
+    "rank-r delta, typically <1% of the base's HBM — see /v1/models for "
+    "per-adapter bytes)",
+    label_names=("model",))
+ADAPTER_REQUESTS = _obs.metrics.counter(
+    "dl4j_adapter_requests_total",
+    "Requests served through a named LoRA adapter over a shared base "
+    "(adapter='' rows would be the base itself; those count only under "
+    "dl4j_requests_total)",
+    label_names=("model", "adapter"))
+
 # ------------------------------------------------------------- paged decode
 # Paged-KV / prefix-cache / speculative-decoding families (PR 15). Same
 # JX008 shape as everything above: family registered at import, children

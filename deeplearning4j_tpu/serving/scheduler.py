@@ -60,10 +60,18 @@ def prompt_bucket_ladder(capacity: int,
 class GenerationRequest:
     __slots__ = ("prompt", "n_steps", "temperature", "top_k", "top_p",
                  "seed", "eos_id", "ids", "error", "deadline", "cancelled",
-                 "event", "t_submit", "rng", "ctx", "t_submit_ns")
+                 "event", "t_submit", "rng", "ctx", "t_submit_ns",
+                 "adapter", "params")
 
     def __init__(self, prompt, n_steps, *, temperature=1.0, top_k=0,
-                 top_p=0.0, seed=0, eos_id=None, deadline=None):
+                 top_p=0.0, seed=0, eos_id=None, deadline=None,
+                 adapter=None):
+        # Multi-tenant serving: the LoRA adapter name this request decodes
+        # through (None = the base model). `params` is filled at submit
+        # with the adapter-merged tree; the decode loop groups slots by
+        # adapter per round.
+        self.adapter = None if adapter is None else str(adapter)
+        self.params = None
         self.prompt = [int(t) for t in prompt]
         self.n_steps = int(n_steps)
         self.temperature = float(temperature)
@@ -148,6 +156,11 @@ class GenerationScheduler:
             self._prefix_cache = PrefixCache(
                 self.stepper.pool, max_entries=prefix_cache_entries)
             self.stepper.pool.reclaim = self._prefix_cache.evict_one
+        # Multi-tenant hooks (set by serving/server.py when the hosted
+        # model has LoRA adapters loaded): name -> merged params tree, and
+        # the list of names to warm per-adapter dispatch for.
+        self.adapter_params = None
+        self.adapter_names = None
         self.prompt_buckets = prompt_bucket_ladder(self.capacity,
                                                    prompt_buckets)
         self._queue: "queue.Queue[Optional[GenerationRequest]]" = queue.Queue(
@@ -193,11 +206,26 @@ class GenerationScheduler:
         store before traffic (one short throwaway generation per bucket).
         With a draft model, also warms the draft's programs and every
         speculative verify width (k_round shrinks from spec_k to 0 near
-        capacity, and each T is its own traced program)."""
+        capacity, and each T is its own traced program). With adapters
+        loaded, every bucket is re-driven through ONE adapter-merged tree:
+        merged trees all share a pytree structure (distinct from the bare
+        base), so one variant warms per-adapter dispatch for every
+        tenant."""
         for b in self.prompt_buckets:
             probs, slot_state, n = self.stepper.prefill([0], pad_to=b)
         self.stepper.install(0, slot_state, n)
         self.stepper.step([0] * self.slots)
+        self.stepper.warm_page_copies()
+        names = self.adapter_names() if callable(self.adapter_names) else ()
+        if names and self.adapter_params is not None:
+            try:
+                self.stepper.set_params(self.adapter_params(names[0]))
+                for b in self.prompt_buckets:
+                    _, astate, an = self.stepper.prefill([0], pad_to=b)
+                self.stepper.install(0, astate, an)
+                self.stepper.step([0] * self.slots)
+            finally:
+                self.stepper.set_params(None)
         if self._draft_stepper is not None:
             for t in range(2, self._spec_k + 2):
                 self.stepper.rewind_all([n] + [0] * (self.slots - 1))
@@ -206,6 +234,7 @@ class GenerationScheduler:
                 _, dstate, dn = self._draft_stepper.prefill([0], pad_to=b)
             self._draft_stepper.install(0, dstate, dn)
             self._draft_stepper.step([0] * self.slots)
+            self._draft_stepper.warm_page_copies()
             self._draft_stepper.clear(0)
         self.stepper.clear(0)
 
@@ -220,6 +249,23 @@ class GenerationScheduler:
             raise InputValidationError(
                 f"prompt ({len(req.prompt)}) + n_steps ({req.n_steps}) "
                 f"exceeds the decode cache capacity {self.capacity}")
+        if req.adapter is not None:
+            if self._draft_stepper is not None:
+                raise InputValidationError(
+                    "adapter selection is not supported with a draft "
+                    "(speculative) model configured — the draft has no "
+                    "per-tenant delta to propose with")
+            if self.adapter_params is None:
+                raise InputValidationError(
+                    f"model {self.model_name!r} hosts no adapters "
+                    f"(requested {req.adapter!r})")
+            try:
+                # Resolve at admission so an unknown name 400s here and
+                # the decode loop only ever sees a ready merged tree.
+                req.params = self.adapter_params(req.adapter)
+            except KeyError as e:
+                raise InputValidationError(str(e.args[0]) if e.args
+                                           else str(e))
         try:
             self._queue.put_nowait(req)
         except queue.Full:
@@ -229,14 +275,14 @@ class GenerationScheduler:
         return req
 
     def generate(self, prompt_ids, n_steps: int, *,
-                 timeout_s: Optional[float] = None,
+                 timeout_s: Optional[float] = None, adapter=None,
                  **sampling) -> List[int]:
         """Blocking helper: submit + wait; cancels the request (recycled at
         the next step boundary) when the caller's timeout expires."""
         deadline = (None if timeout_s is None
                     else time.monotonic() + timeout_s)
         req = GenerationRequest(prompt_ids, n_steps, deadline=deadline,
-                                **sampling)
+                                adapter=adapter, **sampling)
         self.submit(req)
         req.event.wait(timeout=timeout_s)
         if not req.event.is_set():
@@ -277,7 +323,10 @@ class GenerationScheduler:
         so TTFT on a repeat prompt is pure sampling. Miss: prefill,
         install, and admit the fresh pages into the cache."""
         cache = self._prefix_cache
-        hit = cache.get(req.prompt) if cache is not None else None
+        # Prefix entries are namespaced by adapter: the same prompt
+        # prefilled through different merged trees has different KV.
+        hit = (cache.get(req.prompt, namespace=req.adapter)
+               if cache is not None else None)
         if hit is not None:
             pages, n, probs = hit
             self.stepper.install_shared(slot, pages, n)
@@ -288,13 +337,14 @@ class GenerationScheduler:
             with _obs.tracer.span("serving.prefill", cat="serving",
                                   parent_ctx=req.ctx,
                                   model=self.model_name, pad_to=pad_to):
+                self.stepper.set_params(req.params)
                 probs, slot_state, n = self.stepper.prefill(req.prompt,
                                                             pad_to=pad_to)
                 self.stepper.install(slot, slot_state, n)
             if cache is not None:
                 _m.PREFIX_CACHE_MISSES.labels(model=self.model_name).inc()
                 cache.admit(req.prompt, self.stepper.pool.pages_of(slot),
-                            n, probs)
+                            n, probs, namespace=req.adapter)
         if self._draft_stepper is not None:
             # The draft always prefills (its dense cache has no pages to
             # share) — it is the small model, so a prefix hit still skips
@@ -392,10 +442,8 @@ class GenerationScheduler:
             if self._draft_stepper is not None:
                 self._spec_round(active, free, step_hist)
                 continue
-            tokens = [active[s].ids[-1] if s in active else 0
-                      for s in range(self.slots)]
             t0_ns = time.perf_counter_ns()
-            probs = self.stepper.step(tokens)
+            rows = self._decode_round(active)
             dur_ns = time.perf_counter_ns() - t0_ns
             step_hist.observe(dur_ns / 1e9)
             for req in active.values():
@@ -412,11 +460,55 @@ class GenerationScheduler:
                     del active[slot]
                     free.append(slot)
                     continue
-                self._sample(req, probs[slot])
+                self._sample(req, rows[slot])
                 if req.done:
                     self._retire(slot, req)
                     del active[slot]
                     free.append(slot)
+
+    def _decode_round(self, active: Dict[int, GenerationRequest]):
+        """One decode step for every active slot, grouped by adapter.
+        Returns `{slot: next-token distribution}`.
+
+        All requests on one adapter (the overwhelmingly common round,
+        including the no-adapter case) are ONE dispatch — identical to
+        the pre-adapter loop. Mixed rounds dispatch once per adapter
+        group: each group's `step` advances EVERY slot (the batch is the
+        whole slot bank), so after each dispatch the caches rewind —
+        slots whose own group has run stay at `L+1` (their position-L KV
+        row was just written with the RIGHT params; later groups deposit
+        garbage at `L+1`, beyond the cursor and overwritten next round),
+        slots still waiting drop back to `L` so their group rewrites
+        position L correctly. A slot's returned row always comes from its
+        own group's dispatch."""
+        tokens = [active[s].ids[-1] if s in active else 0
+                  for s in range(self.slots)]
+        order: List[Optional[str]] = []
+        groups: Dict[Optional[str], List[int]] = {}
+        for s in sorted(active):
+            a = active[s].adapter
+            if a not in groups:
+                groups[a] = []
+                order.append(a)
+            groups[a].append(s)
+        if len(order) == 1:
+            self.stepper.set_params(active[groups[order[0]][0]].params)
+            probs = self.stepper.step(tokens)
+            return {s: probs[s] for s in active}
+        L = [len(active[s].ids) - 1 if s in active else 0
+             for s in range(self.slots)]
+        rows: Dict[int, object] = {}
+        done: set = set()
+        for a in order:
+            gslots = groups[a]
+            self.stepper.set_params(active[gslots[0]].params)
+            probs = self.stepper.step(tokens)
+            done.update(gslots)
+            for s in gslots:
+                rows[s] = probs[s]
+            self.stepper.rewind_all([L[s] + 1 if s in done else L[s]
+                                     for s in range(self.slots)])
+        return rows
 
     def _spec_round(self, active: Dict[int, GenerationRequest],
                     free: List[int], step_hist) -> None:

@@ -204,6 +204,12 @@ class InferenceServer:
             max_batch_size=o["max_batch_size"], buckets=o["batch_buckets"],
             max_delay_s=o["max_delay_s"], queue_depth=o["queue_depth"],
             warmup_shape=o["warmup_shape"]).start()
+        # Multi-tenant hooks: warmup and per-request dispatch resolve
+        # adapter-merged trees through the ServedModel registry (lazy, so
+        # adapters loaded after _attach are picked up too).
+        model.batcher.param_variants = (
+            lambda: [model.adapter_params(n)
+                     for n in sorted(model.adapters)])
         if o["lm"] and hasattr(model.net, "_get_jit"):
             try:
                 model.scheduler = GenerationScheduler(
@@ -215,7 +221,11 @@ class InferenceServer:
                     kv=o["kv_cache"], page_size=o["kv_page_size"],
                     kv_pages=o["kv_pages"],
                     prefix_cache=o["prefix_cache"],
-                    draft=o["draft"], spec_k=o["spec_k"]).start()
+                    draft=o["draft"], spec_k=o["spec_k"])
+                model.scheduler.adapter_params = model.adapter_params
+                model.scheduler.adapter_names = (
+                    lambda: sorted(model.adapters))
+                model.scheduler.start()
             except Exception:
                 # lm="auto" probes: a model without a KV-cached decode path
                 # simply doesn't serve /generate.
@@ -224,6 +234,48 @@ class InferenceServer:
                 else:
                     raise
         model.ready.set()
+
+    # ------------------------------------------------------------- adapters
+
+    def load_adapter(self, name: str, path=None, net=None,
+                     model: Optional[str] = None,
+                     pinned: bool = True):
+        """Host a LoRA adapter next to a resident base model. `path` loads
+        an adapter checkpoint (`checkpoint/adapters.py` — refused unless
+        its base fingerprint matches the resident base); `net` extracts
+        the delta straight from a live fine-tuned engine. Requests then
+        select it with `adapter=name` on predict/generate — the base stays
+        resident once, every adapter adds only its rank-r delta to HBM,
+        and (after warmup) hot-swapping adapters compiles nothing."""
+        from deeplearning4j_tpu.nn import lora as lora_mod
+
+        served = self.models.get(self.default_model if model is None
+                                 else model)
+        if (path is None) == (net is None):
+            raise ValueError("load_adapter needs exactly one of path/net")
+        if path is not None:
+            from deeplearning4j_tpu.checkpoint import adapters as _adapters
+
+            tree = _adapters.load_adapter(path, base_net=served.net)
+        else:
+            tree = lora_mod.extract_adapter(net.params_tree)
+            if not tree:
+                raise ValueError(
+                    "net has no LoRA adapter leaves to extract")
+        return served.add_adapter(name, tree, pinned=pinned)
+
+    def _resolve_adapter(self, served, adapter: Optional[str]):
+        """Adapter name -> merged params tree (None passes through); an
+        unknown name is a 400, not a 500."""
+        if adapter is None:
+            return None
+        try:
+            params = served.adapter_params(str(adapter))
+        except KeyError as e:
+            raise InputValidationError(str(e.args[0]) if e.args else str(e))
+        _m.ADAPTER_REQUESTS.labels(model=served.name,
+                                   adapter=str(adapter)).inc()
+        return params
 
     # -------------------------------------------------------------- warmup
 
@@ -265,18 +317,22 @@ class InferenceServer:
     # ------------------------------------------------------------- predict
 
     def predict(self, data, model: Optional[str] = None,
-                timeout_s: object = _UNSET) -> np.ndarray:
+                timeout_s: object = _UNSET,
+                adapter: Optional[str] = None) -> np.ndarray:
         """In-process entry (the HTTP handler calls this too). Observed once
         per caller request into the latency histograms, however many
-        bucket-sized chunks it splits into."""
+        bucket-sized chunks it splits into. `adapter` routes the request
+        through a loaded LoRA delta over the same resident base."""
         name = self.default_model if model is None else model
         timeout = (self.predict_timeout_s if timeout_s is _UNSET
                    else timeout_s)
         t0 = time.perf_counter()
         try:
             served = self.models.get(name)
+            params = self._resolve_adapter(served, adapter)
             arr = canonicalize_features(served.net, data)
-            result = self._predict_rows(served, arr, timeout)
+            result = self._predict_rows(served, arr, timeout,
+                                        adapter=adapter, params=params)
         except Exception as e:
             _m.REQUESTS_LEGACY.labels(outcome="error").inc()
             _m.REQUESTS.labels(model=name, route="predict",
@@ -302,14 +358,17 @@ class InferenceServer:
         return "error"
 
     def _predict_rows(self, served, arr: np.ndarray,
-                      timeout: Optional[float]) -> np.ndarray:
+                      timeout: Optional[float],
+                      adapter: Optional[str] = None,
+                      params=None) -> np.ndarray:
         deadline = None if timeout is None else time.monotonic() + timeout
         size = served.batcher.max_batch_size
         # Split oversized requests into bucket-sized chunks; all chunks are
         # queued up front so they coalesce into consecutive batches.
         chunks = ([arr[i:i + size] for i in range(0, arr.shape[0], size)]
                   or [arr])
-        pendings = [served.batcher.submit(c, deadline) for c in chunks]
+        pendings = [served.batcher.submit(c, deadline, adapter=adapter,
+                                          params=params) for c in chunks]
         results = []
         for p in pendings:
             remaining = (None if deadline is None
@@ -339,10 +398,13 @@ class InferenceServer:
 
     def generate(self, prompt_ids, n_steps: int,
                  model: Optional[str] = None,
-                 timeout_s: object = _UNSET, **sampling):
+                 timeout_s: object = _UNSET,
+                 adapter: Optional[str] = None, **sampling):
         """Continuously-batched LM generation: returns the full token list
         (prompt + generated), float-close to `generate_lm(use_cache=True)`
-        for the same seed/sampling knobs."""
+        for the same seed/sampling knobs. `adapter` decodes through a
+        loaded LoRA delta; slots on different adapters share the decode
+        loop (grouped dispatch per round)."""
         name = self.default_model if model is None else model
         timeout = (self.predict_timeout_s if timeout_s is _UNSET
                    else timeout_s)
@@ -353,8 +415,12 @@ class InferenceServer:
                 raise InputValidationError(
                     f"model {name!r} does not serve generation (no "
                     "KV-cached decode path)")
+            if adapter is not None:
+                _m.ADAPTER_REQUESTS.labels(model=name,
+                                           adapter=str(adapter)).inc()
             ids = served.scheduler.generate(prompt_ids, n_steps,
-                                            timeout_s=timeout, **sampling)
+                                            timeout_s=timeout,
+                                            adapter=adapter, **sampling)
         except Exception as e:
             _m.REQUESTS.labels(model=name, route="generate",
                                outcome=self._outcome(e)).inc()

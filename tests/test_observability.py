@@ -725,6 +725,13 @@ class TestStepProfilerAcceptance:
         assert summary["host_to_device_bytes"] > 0
 
         server = InferenceServer(net, port=0).start()
+        # One LoRA tenant next to the resident base, exercised through one
+        # adapter-routed predict, so the multi-tenant metric families
+        # (ISSUE 16) carry children for the scrape assertion below.
+        from deeplearning4j_tpu.nn.transfer import TransferLearning
+
+        server.load_adapter(
+            "tenant-a", net=TransferLearning(net).add_lora(rank=1).build())
         try:
             req = urllib.request.Request(
                 server.url + "/predict",
@@ -733,6 +740,13 @@ class TestStepProfilerAcceptance:
             with urllib.request.urlopen(req, timeout=30) as r:
                 preds = json.loads(r.read())["predictions"]
             assert len(preds) == 4
+            req = urllib.request.Request(
+                server.url + "/predict",
+                data=json.dumps({"data": x[:4].tolist(),
+                                 "adapter": "tenant-a"}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert len(json.loads(r.read())["predictions"]) == 4
             status, body = _http_get(server.url + "/metrics")
         finally:
             server.stop()
@@ -750,6 +764,9 @@ class TestStepProfilerAcceptance:
                 "dl4j_train_flops_per_step",
                 "dl4j_program_hbm_bytes",                 # static HBM gauges
                 "dl4j_input_wait_seconds_bucket",         # starvation split
+                "dl4j_adapters_resident{",                # LoRA tenants ...
+                "dl4j_adapter_requests_total{",           # ... routed
+                'adapter="tenant-a"',
         ):
             assert needle in scrape, f"missing {needle} in /metrics"
 

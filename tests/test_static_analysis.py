@@ -839,6 +839,78 @@ def table(conf, slots):
         assert [f for f in lint_package(rules=["JX014"])] == []
 
 
+# --------------------------------------------------------------- JX015
+
+class TestJX015FrozenLeafTraining:
+    def _lint(self, src, path="deeplearning4j_tpu/nn/fake_trainer.py"):
+        return lint_source(src, path, rules=["JX015"])
+
+    def test_grad_over_handrolled_lora_split_fires(self):
+        src = """
+import jax
+
+def step(net, params, x, y):
+    trainable = {k: v for k, v in params.items() if "__lora_" in k}
+    loss, grads = jax.value_and_grad(net.loss)(trainable, x, y)
+    return grads
+"""
+        fs = self._lint(src)
+        assert rules_of(fs) == {"JX015"}
+        assert "frozen_spec" in fs[0].message
+
+    def test_updater_init_over_frozen_leaves_fires(self):
+        src = """
+def build_opt(updaters, layers, params):
+    out = {}
+    for lk, layer in zip(params, layers):
+        if layer.frozen:
+            pass  # handled by hand below
+        out[lk] = updaters[lk].init(params[lk])
+    return out
+"""
+        fs = self._lint(src)
+        assert rules_of(fs) == {"JX015"}
+        assert ".init(" in fs[0].message
+
+    def test_grad_without_markers_is_clean(self):
+        # Ordinary training code: no frozen/LoRA handling in sight.
+        src = """
+import jax
+
+def step(net, params, x, y):
+    loss, grads = jax.value_and_grad(net.loss)(params, x, y)
+    return grads
+"""
+        assert self._lint(src) == []
+
+    def test_markers_without_train_op_are_clean(self):
+        # Serving-side merge code touches lora leaves but never trains.
+        src = """
+def merged(base, adapter):
+    return {k: v for k, v in base.items() if "__lora_" not in k}
+"""
+        assert self._lint(src) == []
+
+    def test_seam_modules_are_exempt(self):
+        src = """
+import jax
+
+def refit(conf, params, loss):
+    if conf.lora_rank:
+        return jax.grad(loss)(params)
+"""
+        assert self._lint(
+            src, path="deeplearning4j_tpu/nn/transfer.py") == []
+        assert self._lint(
+            src, path="deeplearning4j_tpu/nn/lora.py") == []
+        assert rules_of(self._lint(src)) == {"JX015"}
+
+    def test_package_is_clean(self):
+        # The engines consume the freeze seam through transfer.frozen_spec
+        # / split_tree and never spell the marker names next to a grad.
+        assert [f for f in lint_package(rules=["JX015"])] == []
+
+
 # ------------------------------------------------------------ framework
 
 class TestLinterFramework:
@@ -846,7 +918,7 @@ class TestLinterFramework:
         assert set(ALL_RULES) >= {"JX001", "JX002", "JX003", "JX004",
                                   "JX005", "JX006", "JX007", "JX008",
                                   "JX009", "JX010", "JX011", "JX012",
-                                  "JX013", "JX014"}
+                                  "JX013", "JX014", "JX015"}
 
     def test_findings_are_typed_and_sorted(self):
         src = """

@@ -1,0 +1,326 @@
+"""Transfer learning, LoRA adapters, adapter checkpoints, and
+multi-tenant serving (ISSUE 16).
+
+Covers the freeze contract (frozen leaves bitwise-unchanged AND zero
+updater state), the LoRA fine-tuning loss trend against a full
+fine-tune, adapter checkpoint round-trip + base-fingerprint refusal,
+and the serving acceptance: one resident base + two LoRA tenants served
+over HTTP (predict AND paged generate) with distinct outputs and zero
+serving-path XLA compiles after warmup.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (
+    MultiLayerNetwork,
+    NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.nn import lora as lora_mod
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.transfer import TransferLearning, _layer_items
+from deeplearning4j_tpu.checkpoint import adapters as adapters_mod
+
+
+def _mln(seed=7):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).learning_rate(0.1).updater("adam")
+            .list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss_function="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _batch(seed=0, b=32):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(b, 4).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, b)]
+    return DataSet(x, y)
+
+
+def _leaves(net):
+    return {(lk, name): np.asarray(a)
+            for lk, lp in net.params_tree.items()
+            for name, a in (lp.items() if isinstance(lp, dict) else ())}
+
+
+# ------------------------------------------------------------- freezing
+
+
+class TestFreeze:
+    def test_frozen_leaves_bitwise_unchanged_and_no_updater_state(self):
+        base = _mln()
+        tuned = TransferLearning(base).freeze_up_to(1).build()
+        frozen_keys = tuned.layer_keys[:2]
+
+        # Frozen layers carry NO updater state: their opt entry is ().
+        for lk in frozen_keys:
+            assert tuned.opt_state[lk] == ()
+        assert tuned.opt_state[tuned.layer_keys[2]] != ()
+
+        before = _leaves(tuned)
+        ds = _batch()
+        for _ in range(5):
+            tuned.fit(ds)
+        after = _leaves(tuned)
+
+        for (lk, name), arr in before.items():
+            if lk in frozen_keys:
+                np.testing.assert_array_equal(
+                    arr, after[(lk, name)],
+                    err_msg=f"frozen leaf {lk}/{name} moved")
+        # The head actually trained.
+        head = tuned.layer_keys[2]
+        assert any(not np.array_equal(before[(head, n)], after[(head, n)])
+                   for (lk, n) in before if lk == head)
+
+    def test_source_net_is_never_mutated(self):
+        base = _mln()
+        before = _leaves(base)
+        tuned = TransferLearning(base).freeze_up_to(0).build()
+        tuned.fit(_batch())
+        for key, arr in _leaves(base).items():
+            np.testing.assert_array_equal(before[key], arr)
+
+
+# ----------------------------------------------------------------- lora
+
+
+class TestLoRATraining:
+    def test_lora_loss_trend_vs_full_finetune(self):
+        base = _mln()
+        ds = _batch()
+
+        full = TransferLearning(base).build()
+        lora = TransferLearning(base).add_lora(rank=2, alpha=4).build()
+
+        s_full0, s_lora0 = full.score(ds), lora.score(ds)
+        for _ in range(30):
+            full.fit(ds)
+            lora.fit(ds)
+        # Both fine-tunes learn; the rank-2 adapter tracks the full
+        # fine-tune's trend even though it trains a fraction of the params.
+        assert full.score(ds) < s_full0
+        assert lora.score(ds) < s_lora0
+
+        # LoRA training moved ONLY the adapter factors: every base leaf
+        # (of adapted layers) is bitwise the source net's.
+        for (lk, name), arr in _leaves(lora).items():
+            if name.endswith((lora_mod.LORA_A, lora_mod.LORA_B)):
+                continue
+            if name.endswith(lora_mod.LORA_SCALE):
+                continue
+            np.testing.assert_array_equal(
+                arr, np.asarray(base.params_tree[lk][name]),
+                err_msg=f"LoRA fine-tune moved base leaf {lk}/{name}")
+        # ... and the B factors left their zero init (they did train).
+        assert any(np.any(arr != 0) for (lk, name), arr in
+                   _leaves(lora).items()
+                   if name.endswith(lora_mod.LORA_B))
+
+    def test_lora_layers_have_no_base_updater_state(self):
+        lora = TransferLearning(_mln()).add_lora(rank=2).build()
+        # Adapted layers keep updater state only for the a/b factors.
+        import jax
+
+        for lk in lora.layer_keys:
+            flat = jax.tree_util.tree_leaves(lora.opt_state[lk])
+            lp = lora.params_tree[lk]
+            n_trainable = sum(a.size for name, a in lp.items()
+                              if name.endswith((lora_mod.LORA_A,
+                                                lora_mod.LORA_B)))
+            moments = sum(a.size for a in flat
+                          if hasattr(a, "size") and a.ndim > 0)
+            assert moments <= 2 * n_trainable + 2
+
+
+# ---------------------------------------------------- adapter checkpoint
+
+
+class TestAdapterCheckpoint:
+    def test_round_trip_is_bitwise(self, tmp_path):
+        base = _mln()
+        tuned = TransferLearning(base).add_lora(rank=2, alpha=8).build()
+        tuned.fit(_batch())
+        path = str(tmp_path / "tenant")
+        adapters_mod.save_adapter(tuned, path, name="tenant-a")
+
+        assert adapters_mod.is_adapter_checkpoint(path)
+        meta = adapters_mod.adapter_meta(path)
+        assert meta["name"] == "tenant-a"
+        assert meta["rank"] == 2
+
+        loaded = adapters_mod.load_adapter(path, base_net=base)
+        want = lora_mod.extract_adapter(tuned.params_tree)
+        assert set(loaded) == set(want)
+        for lk in want:
+            for name, arr in want[lk].items():
+                np.testing.assert_array_equal(np.asarray(arr),
+                                              np.asarray(loaded[lk][name]))
+
+    def test_mismatched_base_is_refused(self, tmp_path):
+        from deeplearning4j_tpu.checkpoint.array_store import CheckpointError
+
+        tuned = TransferLearning(_mln(seed=1)).add_lora(rank=2).build()
+        path = str(tmp_path / "tenant")
+        adapters_mod.save_adapter(tuned, path, name="t")
+        other = _mln(seed=2)
+        with pytest.raises(CheckpointError, match="refusing"):
+            adapters_mod.load_adapter(path, base_net=other)
+        # Without a base to verify against, loading is allowed.
+        assert adapters_mod.load_adapter(path)
+
+    def test_fingerprint_ignores_adapter_leaves(self):
+        base = _mln()
+        tuned = TransferLearning(base).add_lora(rank=2).build()
+        assert (adapters_mod.base_fingerprint(base)
+                == adapters_mod.base_fingerprint(tuned))
+
+    def test_save_without_lora_leaves_is_an_error(self, tmp_path):
+        from deeplearning4j_tpu.checkpoint.array_store import CheckpointError
+
+        with pytest.raises(CheckpointError, match="LoRA"):
+            adapters_mod.save_adapter(_mln(), str(tmp_path / "x"))
+
+
+# ------------------------------------------------- multi-tenant serving
+
+
+def _post(url, route, payload, timeout=60):
+    req = urllib.request.Request(url + route, json.dumps(payload).encode(),
+                                 {"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _tenant_net(base, seed):
+    """A deterministic, strongly-distinct tenant: built via the public
+    TransferLearning path, with the adapter factors overwritten by a
+    seeded draw (training to divergence would dominate test runtime)."""
+    tuned = TransferLearning(base).add_lora(rank=2, alpha=4).build()
+    rng = np.random.RandomState(seed)
+    for lk, lp in tuned.params_tree.items():
+        for name in list(lp if isinstance(lp, dict) else ()):
+            if name.endswith((lora_mod.LORA_A, lora_mod.LORA_B)):
+                lp[name] = jnp.asarray(
+                    rng.normal(0.0, 0.5, lp[name].shape).astype(np.float32))
+    return tuned
+
+
+class TestMultiTenantServing:
+    def test_two_adapters_one_base_http_predict_and_generate(self):
+        from deeplearning4j_tpu.models import zoo
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.serving import InferenceServer
+        from deeplearning4j_tpu.serving.fleet import compiles_total
+
+        conf = zoo.transformer_lm(vocab_size=17, t=16, d_model=32,
+                                  n_heads=2, n_blocks=1,
+                                  decode_cache_length=32)
+        base = ComputationGraph(conf).init()
+
+        server = InferenceServer(base, warmup=True, max_batch_size=4,
+                                 decode_slots=2, kv_cache="paged",
+                                 kv_page_size=8)
+        server.load_adapter("tenant-a", net=_tenant_net(base, 1))
+        server.load_adapter("tenant-b", net=_tenant_net(base, 2))
+        server.start()
+        try:
+            assert server.wait_ready(600)
+            url = server.url
+            c0 = compiles_total()
+
+            x = [[[t % 7] for t in range(16)]]
+            p = {a: _post(url, "/predict", {"data": x, "adapter": a}
+                          if a else {"data": x})["predictions"]
+                 for a in (None, "tenant-a", "tenant-b")}
+            assert not np.allclose(p["tenant-a"], p["tenant-b"])
+            assert not np.allclose(p["tenant-a"], p[None])
+
+            gen = {a: _post(url, "/generate",
+                            dict({"prompt_ids": [1, 2, 3], "n_steps": 6,
+                                  "temperature": 0.0},
+                                 **({"adapter": a} if a else {})))["ids"]
+                   for a in (None, "tenant-a", "tenant-b")}
+            # Same prompt, per-tenant continuations: the prefix cache must
+            # not leak KV across adapters and greedy outputs must differ.
+            assert gen["tenant-a"] != gen["tenant-b"]
+            assert gen["tenant-a"] != gen[None]
+
+            # Adapter switches ride the SAME compiled programs: zero
+            # serving-path XLA compiles after warmup.
+            assert compiles_total() - c0 == 0
+
+            # Concurrent mixed-tenant decode matches the sequential runs.
+            res, errs = {}, []
+
+            def run(name, adapter):
+                try:
+                    res[name] = _post(url, "/generate",
+                                      {"prompt_ids": [1, 2, 3],
+                                       "n_steps": 6, "temperature": 0.0,
+                                       "adapter": adapter})["ids"]
+                except Exception as e:  # pragma: no cover - diagnostic
+                    errs.append(e)
+
+            ts = [threading.Thread(target=run, args=(a, a))
+                  for a in ("tenant-a", "tenant-b")]
+            [t.start() for t in ts]
+            [t.join() for t in ts]
+            assert not errs
+            assert res["tenant-a"] == gen["tenant-a"]
+            assert res["tenant-b"] == gen["tenant-b"]
+            assert compiles_total() - c0 == 0
+
+            # /v1/models: adapter rows + the <=10% HBM acceptance ratio.
+            with urllib.request.urlopen(url + "/v1/models",
+                                        timeout=30) as r:
+                row = json.loads(r.read())["models"][0]
+            names = {a["name"] for a in row["adapters"]}
+            assert names == {"tenant-a", "tenant-b"}
+            for a in row["adapters"]:
+                assert a["rank"] == 2 and a["bytes"] > 0 and a["pinned"]
+            total = sum(a["bytes"] for a in row["adapters"])
+            assert total <= 0.10 * row["hbm_bytes"]
+
+            # Unknown adapter is a 400, on both routes.
+            for route, payload in (("/predict", {"data": x}),
+                                   ("/generate", {"prompt_ids": [1],
+                                                  "n_steps": 1})):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    _post(url, route, dict(payload, adapter="nope"))
+                assert ei.value.code == 400
+        finally:
+            server.stop()
+
+    def test_speculative_decoding_rejects_adapters(self):
+        from deeplearning4j_tpu.models import zoo
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.serving import InferenceServer
+        from deeplearning4j_tpu.serving.errors import InputValidationError
+
+        conf = zoo.transformer_lm(vocab_size=17, t=16, d_model=16,
+                                  n_heads=2, n_blocks=1,
+                                  decode_cache_length=32)
+        base = ComputationGraph(conf).init()
+        draft = ComputationGraph(conf).init()
+        server = InferenceServer(base, decode_slots=2, draft=draft,
+                                 spec_k=2)
+        server.load_adapter("t", net=_tenant_net(base, 3))
+        try:
+            with pytest.raises(InputValidationError):
+                server.generate([1, 2], 2, adapter="t")
+        finally:
+            server.stop()
