@@ -1,0 +1,170 @@
+"""Bench regression sentinel: diff two BENCH_out.json-shaped documents.
+
+    python -m deeplearning4j_tpu.analysis.benchdiff BENCH_out.json BASELINE.json
+
+Matches metrics BY NAME between the two documents — the headline entry
+(top-level ``metric``/``value``) plus every named entry under ``extra``
+(either ``{"name": number}`` or ``{"name": {"value": ..., "unit": ...}}``)
+— computes ``current/baseline`` per shared metric, and exits non-zero
+when any ratio regresses beyond its tolerance. A metric present in only
+one document is reported and skipped: the sentinel gates CHANGE, it
+doesn't demand identical coverage (the committed BASELINE.json predates
+most configs).
+
+Direction is inferred per metric: latency-like metrics (unit ``ms``/
+``s``, or a name mentioning latency/p50/p99/ttft/itl/overhead/seconds)
+regress UP; everything else (throughput, accept rates, hit ratios)
+regresses DOWN. Tolerance defaults to 5% and is overridable globally
+(``--tolerance 0.1``) or per metric (``--tol name=0.2``, repeatable) —
+noisy microbenches get wide bands without loosening the rest.
+
+Exit codes: 0 ok (including "no shared metrics"), 1 regression,
+2 usage/IO error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, Optional, Tuple
+
+#: Substrings marking a lower-is-better metric name.
+_LOWER_IS_BETTER_HINTS = ("latency", "p50", "p90", "p99", "ttft", "itl",
+                          "seconds", "overhead", "_ms", "wait", "stall")
+_LOWER_IS_BETTER_UNITS = ("ms", "s", "seconds", "us", "ns")
+
+
+def extract_metrics(doc: dict) -> Dict[str, float]:
+    """``{metric_name: value}`` from one bench document: the headline
+    pair plus the named ``extra`` entries. Non-numeric values (prose
+    metrics in paper-metadata baselines) are skipped."""
+    out: Dict[str, float] = {}
+
+    def put(name, value):
+        if not isinstance(name, str) or not name:
+            return
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            return
+        out[name] = float(value)
+
+    if isinstance(doc.get("metric"), str):
+        put(doc["metric"], doc.get("value"))
+    extra = doc.get("extra")
+    if isinstance(extra, dict):
+        for name, entry in extra.items():
+            if isinstance(entry, dict):
+                put(name, entry.get("value"))
+            else:
+                put(name, entry)
+    return out
+
+
+def units_of(doc: dict) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    if isinstance(doc.get("metric"), str) and doc.get("unit"):
+        out[doc["metric"]] = str(doc["unit"])
+    extra = doc.get("extra")
+    if isinstance(extra, dict):
+        for name, entry in extra.items():
+            if isinstance(entry, dict) and entry.get("unit"):
+                out[str(name)] = str(entry["unit"])
+    return out
+
+
+def lower_is_better(name: str, unit: Optional[str]) -> bool:
+    if unit and unit.lower() in _LOWER_IS_BETTER_UNITS:
+        return True
+    low = name.lower()
+    return any(h in low for h in _LOWER_IS_BETTER_HINTS)
+
+
+def diff(current: dict, baseline: dict, tolerance: float = 0.05,
+         per_metric: Optional[Dict[str, float]] = None
+         ) -> Tuple[list, list]:
+    """Compare two bench documents. Returns ``(rows, regressions)``:
+    every shared metric's row, and the subset that regressed beyond
+    tolerance. A row is ``{metric, current, baseline, ratio, direction,
+    tolerance, regressed}``."""
+    per_metric = per_metric or {}
+    cur = extract_metrics(current)
+    base = extract_metrics(baseline)
+    units = dict(units_of(baseline), **units_of(current))
+    rows, regressions = [], []
+    for name in sorted(set(cur) & set(base)):
+        b = base[name]
+        if b == 0:
+            continue  # a zero baseline has no ratio
+        ratio = cur[name] / b
+        lower = lower_is_better(name, units.get(name))
+        tol = float(per_metric.get(name, tolerance))
+        regressed = (ratio > 1.0 + tol) if lower else (ratio < 1.0 - tol)
+        row = {"metric": name, "current": cur[name], "baseline": b,
+               "ratio": ratio,
+               "direction": "lower_is_better" if lower
+               else "higher_is_better",
+               "tolerance": tol, "regressed": regressed}
+        rows.append(row)
+        if regressed:
+            regressions.append(row)
+    return rows, regressions
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m deeplearning4j_tpu.analysis.benchdiff",
+        description="Exit non-zero when a bench metric regressed "
+                    "beyond tolerance vs a baseline document.")
+    ap.add_argument("current", help="BENCH_out.json from this run")
+    ap.add_argument("baseline", help="baseline document to gate against")
+    ap.add_argument("--tolerance", type=float, default=0.05,
+                    help="default tolerated relative regression "
+                         "(0.05 = 5%%)")
+    ap.add_argument("--tol", action="append", default=[],
+                    metavar="METRIC=FRACTION",
+                    help="per-metric tolerance override (repeatable)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    args = ap.parse_args(argv)
+
+    per_metric: Dict[str, float] = {}
+    for spec in args.tol:
+        name, sep, frac = spec.partition("=")
+        if not sep:
+            print(f"bad --tol {spec!r} (want METRIC=FRACTION)",
+                  file=sys.stderr)
+            return 2
+        try:
+            per_metric[name] = float(frac)
+        except ValueError:
+            print(f"bad --tol fraction in {spec!r}", file=sys.stderr)
+            return 2
+    docs = []
+    for path in (args.current, args.baseline):
+        try:
+            with open(path) as fh:
+                docs.append(json.load(fh))
+        except (OSError, ValueError) as e:
+            print(f"cannot read {path}: {e}", file=sys.stderr)
+            return 2
+
+    rows, regressions = diff(docs[0], docs[1], tolerance=args.tolerance,
+                             per_metric=per_metric)
+    if args.json:
+        print(json.dumps({"rows": rows,
+                          "regressions": [r["metric"]
+                                          for r in regressions]}))
+    else:
+        if not rows:
+            print("benchdiff: no shared metrics between "
+                  f"{args.current} and {args.baseline}; nothing to gate")
+        for r in rows:
+            flag = "REGRESSED" if r["regressed"] else "ok"
+            print(f"{flag:9s} {r['metric']}: {r['current']:g} vs "
+                  f"{r['baseline']:g} (ratio {r['ratio']:.4f}, "
+                  f"{r['direction']}, tol {r['tolerance']:.2%})")
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

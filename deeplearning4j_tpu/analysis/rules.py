@@ -1,4 +1,4 @@
-"""tpulint rules JX001-JX015.
+"""tpulint rules JX001-JX016.
 
 Each rule is a class with a stable ``id``; registration is
 registry-driven (`@register_rule`) so satellite PRs add rules without
@@ -1130,3 +1130,107 @@ class FrozenLeafTrainingRule(Rule):
                     "state and no grad — compute the exclusion with "
                     "nn/transfer.frozen_spec and build the op over "
                     "split_tree's trainable half instead")
+
+
+@register_rule
+class UnboundedLabelCardinalityRule(Rule):
+    """JX016: metric label values fed from unbounded per-request data.
+
+    Prometheus-style registries (`observability/metrics.py`) keep one
+    child PER DISTINCT LABEL TUPLE forever — a label fed from a request
+    id, a prompt, a trace/span id, or an exception message mints a new
+    series per request and grows the registry (and every scrape body)
+    without bound. Per-request detail belongs in the request ledger
+    (`observability/ledger.py`) or the span tracer, which are rings;
+    labels are for BOUNDED vocabularies (model names, routes, outcome
+    enums — `dl4j_requests_total{outcome}` is the shape to copy).
+
+    Heuristic: a ``.labels(k=v)`` keyword whose value expression
+    mentions (a) an obviously per-request name (``request_id``,
+    ``prompt``, ``trace_id``, ...) or (b) a variable bound by an
+    ``except ... as e`` handler in the same function (``str(e)``,
+    f-strings over it — exception text embeds addresses, shapes, paths).
+    Derivations that BOUND the value first (``reason.split(":", 1)[0]``
+    in flight.py caps the vocabulary at the callers' reason prefixes;
+    ``str(adapter)`` draws from the loaded-adapter registry) mention
+    neither and stay clean.
+    """
+
+    id = "JX016"
+    description = ("metric .labels(...) fed from unbounded per-request "
+                   "data (per-request series = cardinality explosion)")
+
+    _SUSPECT = {"request_id", "req_id", "prompt", "prompt_ids",
+                "trace_id", "span_id", "user_id", "session_id"}
+
+    @staticmethod
+    def _names_in(node) -> Iterator[str]:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name):
+                yield sub.id
+            elif isinstance(sub, ast.Attribute):
+                yield sub.attr
+
+    @classmethod
+    def _stringified_exc(cls, value, exc_names) -> List[str]:
+        """Except-bound names whose TEXT reaches the label: the bare
+        name as the whole value (labels stringify it), `str(e)` /
+        `repr(e)` / `format(e)`, or an f-string over it. Passing `e` to
+        a classifier that returns an enum is the fix, not a finding."""
+        hits = set()
+        if isinstance(value, ast.Name) and value.id in exc_names:
+            hits.add(value.id)
+        for sub in ast.walk(value):
+            args = ()
+            if (isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name)
+                    and sub.func.id in ("str", "repr", "format")):
+                args = sub.args
+            elif (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "format"):
+                args = list(sub.args) + [k.value for k in sub.keywords]
+            elif isinstance(sub, ast.JoinedStr):
+                args = (sub,)
+            for a in args:
+                for n in ast.walk(a):
+                    if isinstance(n, ast.Name) and n.id in exc_names:
+                        hits.add(n.id)
+        return sorted(hits)
+
+    def check(self, ctx):
+        rel = ctx.rel.replace("\\", "/")
+        if "/analysis/" in rel or rel.startswith("analysis/"):
+            return  # the linter's own fixtures/tests spell the patterns
+        for info in ctx.functions.values():
+            exc_names = {
+                node.name for node in walk_body(info.node)
+                if isinstance(node, ast.ExceptHandler) and node.name}
+            for node in walk_body(info.node):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "labels"):
+                    continue
+                for kw in node.keywords:
+                    if kw.arg is None:
+                        continue
+                    names = set(self._names_in(kw.value))
+                    suspect = sorted(names & self._SUSPECT)
+                    from_exc = self._stringified_exc(kw.value, exc_names)
+                    if suspect:
+                        yield self.finding(
+                            ctx, node,
+                            f"label `{kw.arg}=` is fed from per-request "
+                            f"data ({', '.join(suspect)}): every request "
+                            "mints a new series and the registry grows "
+                            "without bound — record per-request detail "
+                            "in the request ledger or a span, keep "
+                            "labels to bounded vocabularies")
+                    elif from_exc:
+                        yield self.finding(
+                            ctx, node,
+                            f"label `{kw.arg}=` embeds an exception "
+                            f"value ({', '.join(from_exc)}): error text "
+                            "is unbounded (addresses, shapes, paths) — "
+                            "label with the exception CLASS or an "
+                            "outcome enum and put the message in the "
+                            "ledger/flight bundle")

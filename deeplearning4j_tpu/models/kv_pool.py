@@ -87,6 +87,7 @@ class KVPagePool:
         self._ref = np.zeros(self.num_pages, np.int64)
         self._seq: Dict[int, List[int]] = {}   # slot -> physical pages
         self._len: Dict[int, int] = {}         # slot -> token length
+        self._cow: Dict[int, int] = {}         # slot -> CoW copies since install
         self.table = np.zeros((self.slots, self.pages_per_seq), np.int32)
         # Called when the free list runs dry; returns True if it freed
         # >= 1 page (the scheduler wires PrefixCache.evict_one here).
@@ -115,6 +116,11 @@ class KVPagePool:
 
     def pages_of(self, slot: int) -> Tuple[int, ...]:
         return tuple(self._seq.get(slot, ()))
+
+    def cow_count(self, slot: int) -> int:
+        """Copy-on-write page copies this slot has forced since its
+        install (the request-ledger's per-request CoW cost field)."""
+        return self._cow.get(slot, 0)
 
     # --------------------------------------------------------- refcounting
 
@@ -188,6 +194,7 @@ class KVPagePool:
         its table row so future rides write to the zero page."""
         pages = self._seq.pop(slot, None)
         self._len.pop(slot, None)
+        self._cow.pop(slot, None)
         self.table[slot, :] = 0
         if pages:
             self.unref(pages)
@@ -237,6 +244,7 @@ class KVPagePool:
                 new = self._alloc_one()
                 if pi < len(pages):
                     copies.append((pages[pi], new))   # CoW: shared page
+                    self._cow[slot] = self._cow.get(slot, 0) + 1
                     self.unref([pages[pi]])
                     pages[pi] = new
                 else:

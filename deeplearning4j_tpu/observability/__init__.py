@@ -49,7 +49,8 @@ __all__ = [
     "iteration_span", "host_nbytes", "install_jax_compile_hook",
     "bench_snapshot", "prometheus_payload", "chip_peak_flops",
     "estimate_step_flops", "flight", "FlightRecorder", "memory",
-    "propagate", "install_build_info",
+    "propagate", "install_build_info", "request_ledger", "RequestLedger",
+    "slo",
 ]
 
 OBS_ENABLED = os.environ.get("DL4J_TPU_OBS", "1").lower() not in (
@@ -319,6 +320,7 @@ def bench_snapshot(registry: Optional[MetricsRegistry] = None) -> Dict[str, Any]
     for hist in ("dl4j_step_latency_seconds", "dl4j_step_dispatch_seconds",
                  "dl4j_infer_latency_seconds", "dl4j_request_latency_seconds",
                  "dl4j_serving_request_seconds", "dl4j_serving_ttft_seconds",
+                 "dl4j_serving_itl_seconds",
                  "dl4j_serving_decode_step_seconds", "dl4j_compile_seconds",
                  "dl4j_input_wait_seconds"):
         fam = reg.get_family(hist)
@@ -336,6 +338,8 @@ def bench_snapshot(registry: Optional[MetricsRegistry] = None) -> Dict[str, Any]
                  "dl4j_requests_total",
                  "dl4j_serving_generated_tokens_total",
                  "dl4j_serving_evictions_total",
+                 "dl4j_tenant_device_seconds_total",
+                 "dl4j_tenant_tokens_total",
                  "dl4j_jit_cache_hits_total", "dl4j_jit_cache_misses_total",
                  "dl4j_host_to_device_bytes_total",
                  "dl4j_checkpoint_bytes_written_total",
@@ -359,3 +363,8 @@ def bench_snapshot(registry: Optional[MetricsRegistry] = None) -> Dict[str, Any]
 from deeplearning4j_tpu.observability import memory  # noqa: E402,F401
 from deeplearning4j_tpu.observability.flight import (  # noqa: E402
     FlightRecorder, recorder as flight)
+# `request_ledger` is the instance; the module keeps its dotted name
+# (`deeplearning4j_tpu.observability.ledger`) for the serving tier.
+from deeplearning4j_tpu.observability.ledger import (  # noqa: E402
+    RequestLedger, ledger as request_ledger)
+from deeplearning4j_tpu.observability import slo  # noqa: E402,F401

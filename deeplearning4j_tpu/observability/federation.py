@@ -200,6 +200,11 @@ class FleetAggregator:
         # One membership lookup serves a whole metrics+trace cycle.
         self._members_ttl_s = 0.5
         self._members_cache: Tuple[float, Dict[str, str]] = (0.0, {})
+        # Members whose coordinator lease has expired (lease_age_s past
+        # lost_after_s) but who haven't been evicted from `status` yet:
+        # never scraped (their numbers are stale by definition), surfaced
+        # as dl4j_federation_up 0 so one poll flags the staleness.
+        self._stale_members: Dict[str, str] = {}
         # Status-only client: never joins, tight backoff — a dead
         # coordinator should fail the fleet view fast, not hang it.
         self._client = CoordinatorClient(
@@ -221,12 +226,23 @@ class FleetAggregator:
             return dict(cached)
         doc = self._client.status()
         out: Dict[str, str] = {}
+        stale: Dict[str, str] = {}
+        lost_after = doc.get("lost_after_s")
         for wid, d in doc.get("detail", {}).items():
             role = str(d.get("role", ""))
             if not role.startswith("replica") or "@" not in wid:
                 continue
             addr = wid.rsplit("@", 1)[1]
+            lease_age = d.get("lease_age_s")
+            if (lost_after is not None and lease_age is not None
+                    and float(lease_age) >= float(lost_after)):
+                # Lease expired but not yet evicted from `status`: its
+                # counters are from before the silence began — dropping
+                # the scrape beats federating stale numbers as fresh.
+                stale[wid] = f"http://{addr}"
+                continue
             out[wid] = f"http://{addr}"
+        self._stale_members = stale
         murl = doc.get("metrics_url")
         if murl:
             out[f"coordinator@{self._client.host}:{self._client.port}"] = \
@@ -280,6 +296,8 @@ class FleetAggregator:
                 up.append((wid, 1))
             except Exception:
                 up.append((wid, 0))
+        for wid in self._stale_members:
+            up.append((wid, 0))
         merged = merge_prometheus(texts)
         lines = [f"# TYPE {UP_FAMILY} gauge"]
         lines += [f'{UP_FAMILY}{{worker_id="{w}"}} {v}' for w, v in up]

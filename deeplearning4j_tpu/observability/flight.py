@@ -15,6 +15,8 @@ A **dump** writes a self-contained bundle directory:
 - ``trace.json``     — Chrome trace: the span buffer plus the ring's
   steps as ``X`` events (open in ui.perfetto.dev)
 - ``metrics.json``   — full registry snapshot (`MetricsRegistry.to_json`)
+- ``ledger.jsonl``   — recently closed request-ledger records (who was
+  in flight, and whose device-seconds they were — `observability/ledger.py`)
 - ``memory.pprof``   — `jax.profiler.device_memory_profile()` when the
   backend provides it (`pprof -http : memory.pprof`)
 
@@ -328,6 +330,22 @@ class FlightRecorder:
 
             with open(os.path.join(bundle_dir, "metrics.json"), "w") as f:
                 json.dump(_obs.metrics.to_json(), f, default=str)
+        except Exception:
+            pass
+
+        # Join the request-lifecycle ledger: the same bundle that shows
+        # WHERE the process was (steps/trace) shows WHICH requests were
+        # in flight and who they were billed to.
+        try:
+            from deeplearning4j_tpu.observability.ledger import (
+                ledger as _ledger)
+
+            records = _ledger.snapshot()
+            if records:
+                with open(os.path.join(bundle_dir, "ledger.jsonl"),
+                          "w") as f:
+                    for rec in records:
+                        f.write(json.dumps(rec, default=str) + "\n")
         except Exception:
             pass
 

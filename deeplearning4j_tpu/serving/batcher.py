@@ -127,11 +127,13 @@ def serving_feature_spec(net, warmup_shape=None):
 
 class _Pending:
     __slots__ = ("array", "event", "result", "error", "deadline",
-                 "cancelled", "ctx", "t_submit_ns", "adapter", "params")
+                 "cancelled", "ctx", "t_submit_ns", "adapter", "params",
+                 "ledger_rec")
 
     def __init__(self, array: np.ndarray,
                  deadline: Optional[float] = None,
-                 adapter: Optional[str] = None, params=None):
+                 adapter: Optional[str] = None, params=None,
+                 ledger_rec=None):
         self.array = array
         # Multi-tenant serving: the adapter name is part of the batch
         # grouping key (rows dispatched through different param trees
@@ -148,6 +150,10 @@ class _Pending:
         # own thread, where the submitter's thread-local binding is gone.
         self.ctx = _prop.current()
         self.t_submit_ns = time.perf_counter_ns()
+        # The request's accounting record (observability/ledger.py): the
+        # batch loop credits it queue-wait and its row-share of each
+        # dispatch's wall time; the SERVER owns open/close.
+        self.ledger_rec = ledger_rec
 
 
 class ShapeBucketBatcher:
@@ -178,6 +184,8 @@ class ShapeBucketBatcher:
         self.param_variants = None
         _m.MODEL_QUEUE_DEPTH.labels(
             model=model_name, route="predict").set_function(self._queue.qsize)
+        self._dispatch_seconds = _m.DISPATCH_SECONDS.labels(
+            model=model_name, phase="forward")
 
     # ------------------------------------------------------------ control
 
@@ -208,10 +216,12 @@ class ShapeBucketBatcher:
 
     def submit(self, arr: np.ndarray,
                deadline: Optional[float] = None,
-               adapter: Optional[str] = None, params=None) -> _Pending:
+               adapter: Optional[str] = None, params=None,
+               ledger_rec=None) -> _Pending:
         """Enqueue one request's rows; sheds (503 + Retry-After) when the
         bounded queue is full instead of growing it."""
-        p = _Pending(arr, deadline, adapter=adapter, params=params)
+        p = _Pending(arr, deadline, adapter=adapter, params=params,
+                     ledger_rec=ledger_rec)
         try:
             self._queue.put_nowait(p)
         except queue.Full:
@@ -313,6 +323,18 @@ class ShapeBucketBatcher:
                     "serving.device_dispatch", t_fwd, dur_fwd,
                     cat="serving", parent_ctx=p.ctx,
                     model=self.model_name, rows=n, padded_to=bucket)
+            # Cost attribution choke point: ONE dispatch's wall time is
+            # split across its co-batched requests by real (pre-padding)
+            # row share, so tenant device-seconds sum to measured
+            # dispatch seconds.
+            dispatch_s = dur_fwd / 1e9
+            self._dispatch_seconds.inc(dispatch_s)
+            for p, c in zip(live, counts):
+                rec = p.ledger_rec
+                if rec is not None:
+                    rec.set_queue_wait((t_fwd - p.t_submit_ns) / 1e9)
+                    rec.mark("queue_done")
+                    rec.add_device_seconds(dispatch_s * (c / n))
             off = 0
             for p, c in zip(live, counts):
                 p.result = preds[off:off + c]

@@ -7,6 +7,13 @@ Routes (all JSON):
 - `GET  /metrics`    Prometheus scrape (`?format=json` for the snapshot)
 - `GET  /v1/models`  per-model status / residency / HBM estimate / loaded
                      LoRA adapters (name, rank, bytes, pinned)
+- `GET  /v1/tenants` per-(model, adapter) cost rollups from the request
+                     ledger: requests, tokens in/out, attributed
+                     device-seconds, mean queue wait, adapter HBM share
+- `POST /admin/flight-dump`  trigger a flight-recorder bundle
+                     (`{"reason"?}`); rate-limited per reason, so the
+                     response's `"path"` is null when a recent dump for
+                     the same reason already exists
 - `POST /predict`    `{"data": [[...]], "model"?, "adapter"?,
                        "timeout_ms"?}`
 - `POST /generate`   `{"prompt_ids": [...], "n_steps": N, "temperature"?,
@@ -115,11 +122,18 @@ def make_handler(server):
                 self._json(_obs.tracer.export_chrome(since=since))
             elif url.path == "/v1/models":
                 self._json({"models": server.models.snapshot()})
+            elif url.path == "/v1/tenants":
+                try:
+                    self._json({"tenants": server.tenant_snapshot()})
+                except Exception as e:
+                    self._error(e)
             else:
                 self._json({"error": "not found",
                             "routes": ["/health", "/healthz", "/metrics",
                                        "/api/trace", "/v1/models",
-                                       "/predict", "/generate"]}, 404)
+                                       "/v1/tenants", "/predict",
+                                       "/generate",
+                                       "/admin/flight-dump"]}, 404)
 
         # ------------------------------------------------------------ POST
 
@@ -151,6 +165,8 @@ def make_handler(server):
                 return self._post_predict()
             if self.path == "/generate":
                 return self._post_generate()
+            if self.path == "/admin/flight-dump":
+                return self._post_flight_dump()
             replica = getattr(server, "fleet_replica", None)
             if replica is not None and self.path == "/admin/drain":
                 return self._post_drain(replica)
@@ -225,6 +241,22 @@ def make_handler(server):
                 self._json({"ids": [int(t) for t in ids]})
 
         # ----------------------------------------------------------- admin
+
+        def _post_flight_dump(self):
+            """SLO-page hook: the router's burn-rate engine POSTs here when
+            a paging burn implicates this replica. force=False rides the
+            recorder's per-reason rate limit — repeated pages within the
+            window return path=null instead of a second bundle, which is
+            how one sustained breach yields exactly one bundle."""
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                payload = (json.loads(self.rfile.read(length))
+                           if length else {})
+                reason = str(payload.get("reason") or "admin")
+                path = _obs.flight.dump(reason=reason, force=False)
+            except Exception as e:
+                return self._error(e)
+            self._json({"path": None if path is None else str(path)})
 
         def _post_drain(self, replica):
             import threading

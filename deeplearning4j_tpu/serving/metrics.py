@@ -51,6 +51,12 @@ DECODE_STEP_SECONDS = _obs.metrics.histogram(
     "dl4j_serving_decode_step_seconds",
     "One continuous-batching decode step (all slots, one dispatch)",
     label_names=("model",))
+ITL_SECONDS = _obs.metrics.histogram(
+    "dl4j_serving_itl_seconds",
+    "Inter-token latency: wall-clock gap between consecutive sampled "
+    "tokens of ONE request (the per-request token-gap distribution the "
+    "SLO engine's itl_p99 objective reads; TTFT covers the first token)",
+    label_names=("model",), buckets=_obs.WIDE_BUCKETS)
 GENERATED_TOKENS = _obs.metrics.counter(
     "dl4j_serving_generated_tokens_total",
     "Tokens sampled by the generation scheduler",
@@ -94,10 +100,38 @@ ADAPTERS_RESIDENT = _obs.metrics.gauge(
     label_names=("model",))
 ADAPTER_REQUESTS = _obs.metrics.counter(
     "dl4j_adapter_requests_total",
-    "Requests served through a named LoRA adapter over a shared base "
-    "(adapter='' rows would be the base itself; those count only under "
-    "dl4j_requests_total)",
+    "Requests served through a named LoRA adapter over a shared base, by "
+    "outcome (ok / timeout / shed / failed) — per-tenant error rates "
+    "without joining the ledger (adapter='' rows would be the base "
+    "itself; those count only under dl4j_requests_total)",
+    label_names=("model", "adapter", "outcome"))
+
+# ------------------------------------------------------------- accounting
+# Per-tenant cost attribution (observability/ledger.py): every batched
+# dispatch's wall time is split across its co-batched requests at the two
+# dispatch choke points (batcher._run_group, scheduler decode rounds) and
+# rolled up here by (model, adapter). adapter='' is base-model traffic.
+DISPATCH_SECONDS = _obs.metrics.counter(
+    "dl4j_serving_dispatch_seconds_total",
+    "Total measured dispatch wall seconds at the serving choke points, "
+    "UNSPLIT (phase: forward = batcher sub-batch, prefill = prompt "
+    "install, decode = one decode/speculative round). The per-tenant "
+    "split of the same durations lands in "
+    "dl4j_tenant_device_seconds_total; across tenants the two must "
+    "reconcile",
+    label_names=("model", "phase"))
+TENANT_DEVICE_SECONDS = _obs.metrics.counter(
+    "dl4j_tenant_device_seconds_total",
+    "Attributed device-seconds per tenant: each dispatch's wall time "
+    "split across co-batched requests (by row share in the batcher, "
+    "evenly across active slots in the decode loop). Sums to total "
+    "measured dispatch seconds across tenants",
     label_names=("model", "adapter"))
+TENANT_TOKENS = _obs.metrics.counter(
+    "dl4j_tenant_tokens_total",
+    "Tokens in/out per tenant (direction: in = prompt tokens admitted, "
+    "out = tokens sampled). Predict rows count as 'in' per input row",
+    label_names=("model", "adapter", "direction"))
 
 # ------------------------------------------------------------- paged decode
 # Paged-KV / prefix-cache / speculative-decoding families (PR 15). Same

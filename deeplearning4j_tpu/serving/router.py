@@ -216,7 +216,9 @@ class FleetRouter:
                  quarantine_s: float = 2.0,
                  stale_lease_fraction: float = 0.75,
                  host: str = "127.0.0.1", port: int = 0,
-                 http: bool = True):
+                 http: bool = True,
+                 slo_objectives=None,
+                 slo_window_scale: float = 1.0):
         self.coordinator_address = str(coordinator_address)
         self.poll_interval_s = float(poll_interval_s)
         self.scrape_timeout_s = float(scrape_timeout_s)
@@ -258,6 +260,10 @@ class FleetRouter:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._http_thread: Optional[threading.Thread] = None
         self._aggregator = None
+        self._slo_objectives = slo_objectives
+        self.slo_window_scale = float(slo_window_scale)
+        self._slo_engine = None
+        self._slo_lock = threading.Lock()
 
     # ----------------------------------------------------------- federation
 
@@ -273,6 +279,86 @@ class FleetRouter:
                 scrape_timeout_s=self.scrape_timeout_s,
                 local_worker_id=f"fleet-router@{self.host}:{self.port}")
         return self._aggregator
+
+    def slo_engine(self):
+        """The fleet burn-rate engine (`observability/slo.py`), built
+        lazily. Its `on_page` hook POSTs `/admin/flight-dump` to each
+        offending replica, so a paging burn freezes forensic bundles
+        while the incident is live — the replica-side per-reason rate
+        limit is what makes one sustained breach yield one bundle."""
+        if self._slo_engine is None:
+            from deeplearning4j_tpu.observability import slo as _slo
+
+            with self._slo_lock:
+                if self._slo_engine is None:
+                    self._slo_engine = _slo.BurnRateEngine(
+                        objectives=self._slo_objectives,
+                        window_scale=self.slo_window_scale,
+                        on_page=self._on_slo_page)
+        return self._slo_engine
+
+    def _on_slo_page(self, objective: str, worker_ids: List[str]) -> None:
+        with self._lock:
+            urls = {wid: info.url for wid, info in self._table.items()}
+        for wid in worker_ids:
+            url = urls.get(wid)
+            if url is None:
+                # Not in the routing table (e.g. just evicted): the
+                # worker-id convention still carries the address.
+                if "@" not in wid:
+                    continue
+                url = f"http://{wid.rsplit('@', 1)[1]}"
+            try:
+                post_json(url + "/admin/flight-dump",
+                          {"reason": f"slo:{objective}"},
+                          timeout_s=self.scrape_timeout_s)
+                _fev.record_event("slo_page", objective=objective,
+                                  replica=wid)
+            except Exception:
+                pass  # forensics must never take down the alert path
+
+    def fleet_slo(self) -> Dict[str, Any]:
+        """`GET /fleet/slo`: scrape the federated exposition, fold it
+        into the burn-rate engine, return the current alert state."""
+        text = self.aggregator().federate_metrics()
+        return self.slo_engine().report(text)
+
+    def fleet_tenants(self) -> Dict[str, Any]:
+        """`GET /v1/tenants` federated: each live replica's per-tenant
+        ledger rollups merged by (model, adapter) — numeric fields sum,
+        and every merged row lists the workers it came from."""
+        merged: Dict[tuple, Dict[str, Any]] = {}
+        with self._lock:
+            targets = [(info.worker_id, info.url)
+                       for info in self._table.values()
+                       if info.state == "live"]
+        for wid, url in targets:
+            try:
+                doc = json.loads(get_text(
+                    url + "/v1/tenants", timeout_s=self.scrape_timeout_s))
+            except Exception:
+                continue
+            for row in doc.get("tenants", []):
+                key = (row.get("model"), row.get("adapter"))
+                agg = merged.setdefault(key, {
+                    "model": key[0], "adapter": key[1], "workers": []})
+                agg["workers"].append(wid)
+                for k, v in row.items():
+                    if isinstance(v, dict):
+                        sub = agg.setdefault(k, {})
+                        for sk, sv in v.items():
+                            sub[sk] = sub.get(sk, 0) + sv
+                    elif isinstance(v, (int, float)) and not isinstance(
+                            v, bool):
+                        agg[k] = agg.get(k, 0) + v
+        rows = sorted(merged.values(),
+                      key=lambda r: (r["model"] or "", r["adapter"] or ""))
+        for row in rows:
+            n = row.get("requests", 0)
+            # The per-replica means don't sum; recompute from the sums.
+            row["queue_wait_mean_s"] = (
+                (row.get("queue_wait_s", 0.0) / n) if n else 0.0)
+        return {"tenants": rows}
 
     # ------------------------------------------------------------ lifecycle
 
@@ -714,10 +800,24 @@ def _make_router_handler(router: FleetRouter):
                     self._json(router.aggregator().federate_trace())
                 except Exception as e:
                     self._json({"error": f"{type(e).__name__}: {e}"}, 502)
+            elif url.path == "/fleet/slo":
+                # Burn-rate evaluation over the federated exposition; a
+                # page-severity burn POSTs flight dumps to the offenders
+                # as a side effect (rate-limited replica-side).
+                try:
+                    self._json(router.fleet_slo())
+                except Exception as e:
+                    self._json({"error": f"{type(e).__name__}: {e}"}, 502)
+            elif url.path == "/v1/tenants":
+                try:
+                    self._json(router.fleet_tenants())
+                except Exception as e:
+                    self._json({"error": f"{type(e).__name__}: {e}"}, 502)
             else:
                 self._json({"error": "not found",
                             "routes": ["/health", "/fleet", "/metrics",
-                                       "/fleet/metrics", "/api/trace",
+                                       "/fleet/metrics", "/fleet/slo",
+                                       "/v1/tenants", "/api/trace",
                                        "/predict", "/generate"]}, 404)
 
         def _payload(self) -> dict:

@@ -29,6 +29,7 @@ import numpy as np
 
 from deeplearning4j_tpu import observability as _obs
 from deeplearning4j_tpu.observability import propagate as _prop
+from deeplearning4j_tpu.observability.ledger import NOOP_RECORD
 from deeplearning4j_tpu.serving import metrics as _m
 from deeplearning4j_tpu.serving.errors import (
     InputValidationError,
@@ -61,11 +62,11 @@ class GenerationRequest:
     __slots__ = ("prompt", "n_steps", "temperature", "top_k", "top_p",
                  "seed", "eos_id", "ids", "error", "deadline", "cancelled",
                  "event", "t_submit", "rng", "ctx", "t_submit_ns",
-                 "adapter", "params")
+                 "adapter", "params", "ledger_rec", "_last_tok_ns")
 
     def __init__(self, prompt, n_steps, *, temperature=1.0, top_k=0,
                  top_p=0.0, seed=0, eos_id=None, deadline=None,
-                 adapter=None):
+                 adapter=None, ledger_rec=None):
         # Multi-tenant serving: the LoRA adapter name this request decodes
         # through (None = the base model). `params` is filled at submit
         # with the adapter-merged tree; the decode loop groups slots by
@@ -90,6 +91,12 @@ class GenerationRequest:
         # thread (the submitter's thread-local binding stops at submit).
         self.ctx = _prop.current()
         self.t_submit_ns = time.perf_counter_ns()
+        # Accounting record (observability/ledger.py): the decode loop
+        # credits it marks, tokens, speculative accepts and its slot-share
+        # of every round's wall time; the SERVER owns open/close. NOOP
+        # default keeps direct scheduler users (tests, bench) branch-free.
+        self.ledger_rec = NOOP_RECORD if ledger_rec is None else ledger_rec
+        self._last_tok_ns: Optional[int] = None  # ITL anchor
 
     @property
     def done(self) -> bool:
@@ -168,6 +175,11 @@ class GenerationScheduler:
         self._thread: Optional[threading.Thread] = None
         _m.MODEL_QUEUE_DEPTH.labels(
             model=model_name, route="generate").set_function(self._queue.qsize)
+        self._itl_hist = _m.ITL_SECONDS.labels(model=model_name)
+        self._disp_prefill = _m.DISPATCH_SECONDS.labels(model=model_name,
+                                                        phase="prefill")
+        self._disp_decode = _m.DISPATCH_SECONDS.labels(model=model_name,
+                                                       phase="decode")
         if kv == "paged":
             pool = self.stepper.pool
             for st in ("free", "used", "shared"):
@@ -276,13 +288,14 @@ class GenerationScheduler:
 
     def generate(self, prompt_ids, n_steps: int, *,
                  timeout_s: Optional[float] = None, adapter=None,
-                 **sampling) -> List[int]:
+                 ledger_rec=None, **sampling) -> List[int]:
         """Blocking helper: submit + wait; cancels the request (recycled at
         the next step boundary) when the caller's timeout expires."""
         deadline = (None if timeout_s is None
                     else time.monotonic() + timeout_s)
         req = GenerationRequest(prompt_ids, n_steps, deadline=deadline,
-                                adapter=adapter, **sampling)
+                                adapter=adapter, ledger_rec=ledger_rec,
+                                **sampling)
         self.submit(req)
         req.event.wait(timeout=timeout_s)
         if not req.event.is_set():
@@ -305,6 +318,14 @@ class GenerationScheduler:
         tok = _sample_token(probs, req.rng, req.temperature, req.top_k,
                             req.top_p)
         req.ids.append(tok)
+        # Per-request inter-token gap: the SLO engine's itl_p99 objective
+        # reads this distribution (TTFT covers the first token, so the
+        # first sample only anchors the clock).
+        now_ns = time.perf_counter_ns()
+        if req._last_tok_ns is not None:
+            self._itl_hist.observe((now_ns - req._last_tok_ns) / 1e9)
+        req._last_tok_ns = now_ns
+        req.ledger_rec.add_tokens_out(1)
         _m.GENERATED_TOKENS.labels(model=self.model_name).inc()
         return tok
 
@@ -331,9 +352,12 @@ class GenerationScheduler:
             pages, n, probs = hit
             self.stepper.install_shared(slot, pages, n)
             _m.PREFIX_CACHE_HITS.labels(model=self.model_name).inc()
+            req.ledger_rec.set_prefix_hit(True)
+            req.ledger_rec.mark("prefix_hit")
         else:
             # parent_ctx is explicit: the decode-loop thread has no
             # enclosing span stack to inherit from.
+            t_pf = time.perf_counter_ns()
             with _obs.tracer.span("serving.prefill", cat="serving",
                                   parent_ctx=req.ctx,
                                   model=self.model_name, pad_to=pad_to):
@@ -341,8 +365,15 @@ class GenerationScheduler:
                 probs, slot_state, n = self.stepper.prefill(req.prompt,
                                                             pad_to=pad_to)
                 self.stepper.install(slot, slot_state, n)
+            # Prefill is a single-request dispatch: its wall time is
+            # attributed whole (no co-batched requests to split with).
+            prefill_s = (time.perf_counter_ns() - t_pf) / 1e9
+            self._disp_prefill.inc(prefill_s)
+            req.ledger_rec.add_device_seconds(prefill_s)
+            req.ledger_rec.mark("prefill")
             if cache is not None:
                 _m.PREFIX_CACHE_MISSES.labels(model=self.model_name).inc()
+                req.ledger_rec.set_prefix_hit(False)
                 cache.admit(req.prompt, self.stepper.pool.pages_of(slot),
                             n, probs, namespace=req.adapter)
         if self._draft_stepper is not None:
@@ -366,6 +397,9 @@ class GenerationScheduler:
                 "serving.admission_wait", req.t_submit_ns,
                 time.perf_counter_ns() - req.t_submit_ns, cat="serving",
                 parent_ctx=req.ctx, model=self.model_name)
+        req.ledger_rec.set_queue_wait(
+            (time.perf_counter_ns() - req.t_submit_ns) / 1e9)
+        req.ledger_rec.mark("admitted")
         try:
             probs = self._install_prompt(slot, req, pad_to)
         except Exception as e:
@@ -375,6 +409,7 @@ class GenerationScheduler:
         _m.TTFT_SECONDS.labels(model=self.model_name).observe(
             time.monotonic() - req.t_submit)
         self._sample(req, probs)
+        req.ledger_rec.mark("first_token")
         if req.done:
             self._clear_slot(slot)
             req.event.set()
@@ -388,6 +423,9 @@ class GenerationScheduler:
 
     def _retire(self, slot: int, req: GenerationRequest,
                 timed_out: bool = False) -> None:
+        if self.kv == "paged":
+            req.ledger_rec.add_cow_copies(
+                self.stepper.pool.cow_count(slot))
         self._clear_slot(slot)
         if timed_out:
             self._finish_timeout(req)
@@ -446,7 +484,14 @@ class GenerationScheduler:
             rows = self._decode_round(active)
             dur_ns = time.perf_counter_ns() - t0_ns
             step_hist.observe(dur_ns / 1e9)
+            # Cost attribution choke point: one round's wall time splits
+            # EVENLY across the co-batched slots (every slot rides every
+            # dispatch of the round, including other groups' rewinds).
+            round_s = dur_ns / 1e9
+            self._disp_decode.inc(round_s)
+            share = round_s / len(active)
             for req in active.values():
+                req.ledger_rec.add_device_seconds(share)
                 if req.ctx is not None:
                     _obs.tracer.complete(
                         "serving.decode_step", t0_ns, dur_ns,
@@ -557,7 +602,11 @@ class GenerationScheduler:
         probs = self.stepper.step_k(tok)
         dur_ns = time.perf_counter_ns() - t0_ns
         step_hist.observe(dur_ns / 1e9)
+        round_s = dur_ns / 1e9
+        self._disp_decode.inc(round_s)
+        share = round_s / len(active)
         for req in active.values():
+            req.ledger_rec.add_device_seconds(share)
             if req.ctx is not None:
                 _obs.tracer.complete(
                     "serving.decode_step", t0_ns, dur_ns, cat="serving",
@@ -585,6 +634,7 @@ class GenerationScheduler:
             if greedy and k:
                 spec_acc.inc(accepted)
                 spec_rej.inc(k - accepted)
+                req.ledger_rec.add_speculative(accepted, k - accepted)
             if req.done:
                 self._retire(slot, req)
                 del active[slot]

@@ -26,6 +26,7 @@ from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
+from deeplearning4j_tpu.observability.ledger import ledger as _ledger
 from deeplearning4j_tpu.serving import metrics as _m
 from deeplearning4j_tpu.serving.batcher import (
     ShapeBucketBatcher,
@@ -266,16 +267,27 @@ class InferenceServer:
 
     def _resolve_adapter(self, served, adapter: Optional[str]):
         """Adapter name -> merged params tree (None passes through); an
-        unknown name is a 400, not a 500."""
+        unknown name is a 400, not a 500. Counting happens at OUTCOME
+        time (`_count_adapter`), not here — the outcome label needs the
+        request's fate."""
         if adapter is None:
             return None
         try:
             params = served.adapter_params(str(adapter))
         except KeyError as e:
             raise InputValidationError(str(e.args[0]) if e.args else str(e))
-        _m.ADAPTER_REQUESTS.labels(model=served.name,
-                                   adapter=str(adapter)).inc()
         return params
+
+    @staticmethod
+    def _count_adapter(model: str, adapter: Optional[str],
+                       outcome: str) -> None:
+        """dl4j_adapter_requests_total{model,adapter,outcome} — per-tenant
+        error rates without joining the ledger. Base-model traffic
+        (adapter=None) counts only under dl4j_requests_total."""
+        if adapter is not None:
+            _m.ADAPTER_REQUESTS.labels(
+                model=model, adapter=str(adapter),
+                outcome="failed" if outcome == "invalid" else outcome).inc()
 
     # -------------------------------------------------------------- warmup
 
@@ -327,19 +339,27 @@ class InferenceServer:
         timeout = (self.predict_timeout_s if timeout_s is _UNSET
                    else timeout_s)
         t0 = time.perf_counter()
+        rec = _ledger.open(route="predict", model=name,
+                           adapter="" if adapter is None else str(adapter))
         try:
             served = self.models.get(name)
             params = self._resolve_adapter(served, adapter)
             arr = canonicalize_features(served.net, data)
+            rec.add_tokens_in(int(arr.shape[0]))  # predict: rows in
             result = self._predict_rows(served, arr, timeout,
-                                        adapter=adapter, params=params)
+                                        adapter=adapter, params=params,
+                                        ledger_rec=rec)
         except Exception as e:
             _m.REQUESTS_LEGACY.labels(outcome="error").inc()
             _m.REQUESTS.labels(model=name, route="predict",
                                outcome=self._outcome(e)).inc()
+            self._count_adapter(name, adapter, self._ledger_outcome(e))
+            _ledger.close(rec, outcome=self._ledger_outcome(e))
             raise
         _m.REQUESTS_LEGACY.labels(outcome="ok").inc()
         _m.REQUESTS.labels(model=name, route="predict", outcome="ok").inc()
+        self._count_adapter(name, adapter, "ok")
+        _ledger.close(rec, outcome="ok")
         dt = time.perf_counter() - t0
         _m.REQ_LATENCY.observe(dt)
         _m.REQUEST_SECONDS.labels(model=name, route="predict").observe(dt)
@@ -357,10 +377,22 @@ class InferenceServer:
             return "error"
         return "error"
 
+    @staticmethod
+    def _ledger_outcome(e: Exception) -> str:
+        """Ledger/adapter outcome vocabulary (ok/timeout/shed/failed plus
+        'invalid', which _count_adapter folds into 'failed')."""
+        if isinstance(e, ServerOverloadedError):
+            return "shed"
+        if isinstance(e, (RequestTimeoutError, TimeoutError)):
+            return "timeout"
+        if isinstance(e, (InputValidationError, ModelNotReadyError)):
+            return "invalid"
+        return "failed"
+
     def _predict_rows(self, served, arr: np.ndarray,
                       timeout: Optional[float],
                       adapter: Optional[str] = None,
-                      params=None) -> np.ndarray:
+                      params=None, ledger_rec=None) -> np.ndarray:
         deadline = None if timeout is None else time.monotonic() + timeout
         size = served.batcher.max_batch_size
         # Split oversized requests into bucket-sized chunks; all chunks are
@@ -368,7 +400,9 @@ class InferenceServer:
         chunks = ([arr[i:i + size] for i in range(0, arr.shape[0], size)]
                   or [arr])
         pendings = [served.batcher.submit(c, deadline, adapter=adapter,
-                                          params=params) for c in chunks]
+                                          params=params,
+                                          ledger_rec=ledger_rec)
+                    for c in chunks]
         results = []
         for p in pendings:
             remaining = (None if deadline is None
@@ -409,27 +443,55 @@ class InferenceServer:
         timeout = (self.predict_timeout_s if timeout_s is _UNSET
                    else timeout_s)
         t0 = time.perf_counter()
+        rec = _ledger.open(route="generate", model=name,
+                           adapter="" if adapter is None else str(adapter),
+                           tokens_in=len(prompt_ids))
         try:
             served = self.models.get(name)
             if served.scheduler is None:
                 raise InputValidationError(
                     f"model {name!r} does not serve generation (no "
                     "KV-cached decode path)")
-            if adapter is not None:
-                _m.ADAPTER_REQUESTS.labels(model=name,
-                                           adapter=str(adapter)).inc()
             ids = served.scheduler.generate(prompt_ids, n_steps,
                                             timeout_s=timeout,
-                                            adapter=adapter, **sampling)
+                                            adapter=adapter,
+                                            ledger_rec=rec, **sampling)
         except Exception as e:
             _m.REQUESTS.labels(model=name, route="generate",
                                outcome=self._outcome(e)).inc()
+            self._count_adapter(name, adapter, self._ledger_outcome(e))
+            _ledger.close(rec, outcome=self._ledger_outcome(e))
             raise
         _m.REQUESTS.labels(model=name, route="generate",
                            outcome="ok").inc()
+        self._count_adapter(name, adapter, "ok")
+        _ledger.close(rec, outcome="ok")
         _m.REQUEST_SECONDS.labels(model=name, route="generate").observe(
             time.perf_counter() - t0)
         return ids
+
+    # ------------------------------------------------------------- tenants
+
+    def tenant_snapshot(self) -> list:
+        """`GET /v1/tenants` payload: the ledger's per-(model, adapter)
+        rollups joined with adapter HBM residency from the model host —
+        requests, tokens in/out, attributed device-seconds, mean queue
+        wait, and each adapter's share of its base model's HBM."""
+        rows = _ledger.tenants()
+        for row in rows:
+            row["hbm_bytes"] = None
+            row["hbm_share"] = None
+            try:
+                served = self.models.get(row["model"])
+            except Exception:
+                continue
+            info = served.adapters.get(row["adapter"])
+            if info is not None:
+                row["hbm_bytes"] = int(info.get("bytes") or 0)
+                base = getattr(served, "hbm_bytes", 0) or 0
+                if base:
+                    row["hbm_share"] = row["hbm_bytes"] / float(base)
+        return rows
 
     # ---------------------------------------------------------------- http
 

@@ -34,6 +34,10 @@ attaches to a `StatsStorage` and serves
 - `/api/trace`           — the span tracer's ring buffer as Chrome
                            trace-event JSON: save the body to a file and
                            open it in ui.perfetto.dev
+- `/api/slo`             — burn-rate evaluation of the declarative SLOs
+                           (`observability/slo.py`) over this process's
+                           registry, or over the federated fleet view
+                           when a coordinator is attached
 - `/api`                 — route index (machine-readable version of this
                            docstring)
 - `POST /remote`         — remote-receiver endpoint for
@@ -490,6 +494,7 @@ class _Handler(BaseHTTPRequestHandler):
     tsne_data: Optional[dict] = None  # latest uploaded t-SNE coords
     coordinator_address: Optional[str] = None  # fleet federation source
     _fleet_agg = None  # lazily built FleetAggregator
+    _slo_engine = None  # lazily built BurnRateEngine (/api/slo)
 
     @classmethod
     def _fleet_aggregator(cls):
@@ -500,6 +505,23 @@ class _Handler(BaseHTTPRequestHandler):
 
             cls._fleet_agg = _fed.FleetAggregator(cls.coordinator_address)
         return cls._fleet_agg
+
+    @classmethod
+    def _slo(cls):
+        """Burn-rate state for `/api/slo`: federated when a coordinator
+        is attached, this process's own registry otherwise."""
+        if cls._slo_engine is None:
+            from deeplearning4j_tpu.observability import slo as _slo_mod
+
+            cls._slo_engine = _slo_mod.BurnRateEngine()
+        agg = cls._fleet_aggregator()
+        if agg is not None:
+            text = agg.federate_metrics()
+        else:
+            from deeplearning4j_tpu import observability as obs
+
+            text = obs.metrics.to_prometheus()
+        return cls._slo_engine.report(text)
 
     def log_message(self, *args):  # quiet
         pass
@@ -635,6 +657,11 @@ class _Handler(BaseHTTPRequestHandler):
             from deeplearning4j_tpu.observability import memory as obsmem
 
             self._json(obsmem.report())
+        elif url.path == "/api/slo":
+            try:
+                self._json(type(self)._slo())
+            except Exception as e:
+                self._json({"error": f"{type(e).__name__}: {e}"}, 502)
         elif url.path == "/api":
             self._json({"routes": _ROUTES})
         else:
@@ -646,7 +673,7 @@ _ROUTES = [
     "/", "/histogram", "/model", "/system", "/flow", "/tsne",
     "/activations", "/metrics", "/api", "/api/sessions", "/api/static",
     "/api/updates", "/api/tsne", "/api/trace", "/api/flight", "/api/memory",
-    "/api/fleet/metrics", "/api/fleet/trace",
+    "/api/slo", "/api/fleet/metrics", "/api/fleet/trace",
     "POST /remote", "POST /api/tsne",
 ]
 

@@ -1,0 +1,633 @@
+"""Request-lifecycle ledger, per-tenant cost attribution, and the SLO
+burn-rate engine (ISSUE 17).
+
+Acceptance coverage:
+
+- the ledger itself: bounded ring, per-tenant rollups, NOOP when
+  disabled, deterministic JSONL spool sampling, tenant counters;
+- serving integration: predict AND generate populate records, attributed
+  device-seconds reconcile with the unsplit
+  `dl4j_serving_dispatch_seconds_total` choke-point counter within 5%
+  on a two-adapter server, `GET /v1/tenants` carries the accounting
+  rows with adapter HBM share, `dl4j_adapter_requests_total` carries
+  the outcome label;
+- `POST /admin/flight-dump` freezes one bundle (with `ledger.jsonl`)
+  and rate-limits repeats per reason;
+- the burn-rate engine: exposition parsing, exact bucket-ladder bad
+  counts, multi-window page/recovery transitions, one `on_page` per
+  sustained breach, counter-reset clamping;
+- federation staleness: a lease-expired member is dropped from the
+  scrape set and surfaced as `dl4j_federation_up 0` within one poll;
+- the benchdiff sentinel: committed BENCH_out.json vs BASELINE.json
+  gates clean; synthetic regressions exit non-zero with direction and
+  per-metric tolerance honored;
+- the fleet drill: 3 in-process replicas x 2 LoRA tenants under mixed
+  traffic — federated `/v1/tenants` device-seconds reconcile with
+  dispatch seconds, and a chaos latency breach pages at `/fleet/slo`
+  producing EXACTLY ONE flight bundle across the fleet.
+"""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (MultiLayerNetwork, NeuralNetConfiguration,
+                                observability as obs)
+from deeplearning4j_tpu.nn import lora as lora_mod
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.transfer import TransferLearning
+from deeplearning4j_tpu.observability import federation as fed
+from deeplearning4j_tpu.observability import slo as slo_mod
+from deeplearning4j_tpu.observability.ledger import (NOOP_RECORD,
+                                                     RequestLedger)
+from deeplearning4j_tpu.serving import InferenceServer
+
+
+def mlp_net(seed=1, n_in=3, n_out=2):
+    return MultiLayerNetwork(
+        (NeuralNetConfiguration.builder()
+         .seed(seed).learning_rate(0.1).weight_init("xavier")
+         .list()
+         .layer(DenseLayer(n_out=4, activation="tanh"))
+         .layer(OutputLayer(n_out=n_out, activation="softmax",
+                            loss_function="mcxent"))
+         .set_input_type(InputType.feed_forward(n_in))
+         .build())).init()
+
+
+def _counter_total(name, **match):
+    fam = obs.metrics.get_family(name)
+    if fam is None:
+        return 0.0
+    return sum(c.get() for c in fam.children()
+               if all(c.labels.get(k) == v for k, v in match.items()))
+
+
+def _post(url, route, payload, timeout=60):
+    req = urllib.request.Request(url + route, json.dumps(payload).encode(),
+                                 {"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _get(url, route, timeout=30):
+    with urllib.request.urlopen(url + route, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _tenant_net(base, seed):
+    """Deterministic distinct tenant (see test_transfer_lora): public
+    TransferLearning path, seeded adapter factors."""
+    tuned = TransferLearning(base).add_lora(rank=2, alpha=4).build()
+    rng = np.random.RandomState(seed)
+    for lk, lp in tuned.params_tree.items():
+        for name in list(lp if isinstance(lp, dict) else ()):
+            if name.endswith((lora_mod.LORA_A, lora_mod.LORA_B)):
+                lp[name] = jnp.asarray(
+                    rng.normal(0.0, 0.5, lp[name].shape).astype(np.float32))
+    return tuned
+
+
+@pytest.fixture(scope="module")
+def lm():
+    from deeplearning4j_tpu.models import zoo
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    conf = zoo.transformer_lm(vocab_size=17, t=16, d_model=16, n_heads=2,
+                              n_blocks=1, decode_cache_length=32)
+    return ComputationGraph(conf).init()
+
+
+# ------------------------------------------------------------ ledger unit
+
+
+class TestRequestLedger:
+    def test_open_close_ring_and_tenant_rollup(self):
+        led = RequestLedger(capacity=64, enabled=True, spool_path="",
+                            sample=1.0)
+        rec = led.open(route="predict", model="ledgerunit_m1",
+                       adapter="t1", tokens_in=3)
+        rec.mark("admitted")
+        rec.add_device_seconds(0.25)
+        rec.add_device_seconds(0.25)
+        rec.add_tokens_out(2)
+        rec.set_queue_wait(0.1)
+        rec.set_prefix_hit(True)
+        rec.add_speculative(accepted=4, rejected=1)
+        rec.add_cow_copies(2)
+        led.close(rec, outcome="ok")
+
+        docs = led.snapshot()
+        assert len(docs) == 1
+        doc = docs[0]
+        assert doc["route"] == "predict"
+        assert doc["model"] == "ledgerunit_m1"
+        assert doc["adapter"] == "t1"
+        assert doc["tokens_in"] == 3 and doc["tokens_out"] == 2
+        assert doc["device_seconds"] == pytest.approx(0.5)
+        assert doc["spec_accepted"] == 4 and doc["spec_rejected"] == 1
+        assert doc["cow_page_copies"] == 2
+        assert doc["prefix_hit"] is True
+        assert doc["outcome"] == "ok" and doc["duration_s"] >= 0
+        assert "admitted" in doc["marks"]
+
+        (row,) = led.tenants()
+        assert (row["model"], row["adapter"]) == ("ledgerunit_m1", "t1")
+        assert row["requests"] == 1
+        assert row["device_seconds"] == pytest.approx(0.5)
+        assert row["outcomes"] == {"ok": 1}
+        assert row["queue_wait_mean_s"] == pytest.approx(0.1)
+
+    def test_disabled_ledger_hands_out_noop(self):
+        led = RequestLedger(capacity=64, enabled=False)
+        rec = led.open(route="predict", model="m")
+        assert rec is NOOP_RECORD
+        rec.mark("x")
+        rec.add_device_seconds(1.0)  # all no-ops, never raise
+        led.close(rec)
+        assert led.snapshot() == [] and led.tenants() == []
+        assert led.status()["enabled"] is False
+
+    def test_ring_is_bounded(self):
+        led = RequestLedger(capacity=16, enabled=True, spool_path="",
+                            sample=1.0)
+        for i in range(40):
+            led.close(led.open(route="predict", model="ledgerunit_ring"))
+        st = led.status()
+        assert len(led.snapshot()) == 16
+        assert st["closed_total"] == 40
+        # tenant aggregates keep counting past the ring horizon
+        (row,) = led.tenants()
+        assert row["requests"] == 40
+
+    def test_spool_sampling_is_deterministic(self, tmp_path):
+        spool = str(tmp_path / "led" / "spool.jsonl")
+        led = RequestLedger(capacity=64, enabled=True, spool_path=spool,
+                            sample=0.5)  # every 2nd closed record
+        for i in range(6):
+            rec = led.open(route="generate", model="m", tokens_in=i)
+            led.close(rec, outcome="ok")
+        lines = [json.loads(x) for x in
+                 open(spool).read().splitlines()]
+        assert len(lines) == 3
+        assert all(doc["route"] == "generate" for doc in lines)
+
+    def test_tenant_counters_roll_up(self):
+        d0 = _counter_total("dl4j_tenant_device_seconds_total",
+                            model="ledgerunit_ctr", adapter="a")
+        t0 = _counter_total("dl4j_tenant_tokens_total",
+                            model="ledgerunit_ctr", adapter="a")
+        led = RequestLedger(capacity=16, enabled=True, spool_path="",
+                            sample=1.0)
+        rec = led.open(route="generate", model="ledgerunit_ctr",
+                       adapter="a", tokens_in=7)
+        rec.add_device_seconds(0.125)
+        rec.add_tokens_out(5)
+        led.close(rec, outcome="ok")
+        assert _counter_total("dl4j_tenant_device_seconds_total",
+                              model="ledgerunit_ctr",
+                              adapter="a") - d0 == pytest.approx(0.125)
+        assert _counter_total("dl4j_tenant_tokens_total",
+                              model="ledgerunit_ctr",
+                              adapter="a") - t0 == 12  # 7 in + 5 out
+
+
+# ----------------------------------------------- serving-tier integration
+
+
+class TestServerLedgerIntegration:
+    def test_two_tenants_reconcile_and_v1_tenants(self, lm):
+        server = InferenceServer(lm, warmup=True, max_batch_size=4,
+                                 decode_slots=2, kv_cache="paged",
+                                 kv_page_size=8)
+        server.load_adapter("tenant-a", net=_tenant_net(lm, 1))
+        server.load_adapter("tenant-b", net=_tenant_net(lm, 2))
+        server.start()
+        try:
+            assert server.wait_ready(600)
+            obs.request_ledger.clear()
+            d0 = _counter_total("dl4j_serving_dispatch_seconds_total",
+                                model="default")
+            a0 = _counter_total("dl4j_adapter_requests_total",
+                                model="default", adapter="tenant-a",
+                                outcome="ok")
+
+            x = np.asarray([[[t % 7] for t in range(16)]], np.int32)
+            for adapter in (None, "tenant-a", "tenant-b"):
+                server.predict(x, adapter=adapter)
+                server.generate([1, 2, 3], 5, temperature=0.0,
+                                adapter=adapter)
+
+            # Attributed device-seconds reconcile with the UNSPLIT
+            # dispatch wall-time counter at the choke points: the split
+            # must conserve time, not approximate it.
+            delta = _counter_total("dl4j_serving_dispatch_seconds_total",
+                                   model="default") - d0
+            rows = server.tenant_snapshot()
+            total = sum(r["device_seconds"] for r in rows)
+            assert delta > 0
+            assert abs(total - delta) <= 0.05 * delta
+
+            by_adapter = {r["adapter"]: r for r in rows}
+            assert set(by_adapter) == {"", "tenant-a", "tenant-b"}
+            for name in ("tenant-a", "tenant-b"):
+                row = by_adapter[name]
+                assert row["requests"] == 2  # one predict + one generate
+                assert row["tokens_in"] > 0 and row["tokens_out"] == 5
+                assert row["outcomes"] == {"ok": 2}
+                assert row["hbm_bytes"] > 0
+                assert 0.0 < row["hbm_share"] < 1.0
+            assert by_adapter[""]["hbm_bytes"] is None
+
+            # The generate record carries the lifecycle marks.
+            gen_docs = [d for d in obs.request_ledger.snapshot()
+                        if d["route"] == "generate"]
+            assert gen_docs
+            assert {"admitted", "first_token"} <= set(gen_docs[-1]["marks"])
+            assert gen_docs[-1]["prefix_hit"] in (True, False)
+
+            # Same rows over HTTP.
+            http_rows = _get(server.url, "/v1/tenants")["tenants"]
+            assert {(r["model"], r["adapter"]) for r in http_rows} == {
+                ("default", ""), ("default", "tenant-a"),
+                ("default", "tenant-b")}
+
+            # Satellite: the adapter counter now carries `outcome`.
+            assert _counter_total("dl4j_adapter_requests_total",
+                                  model="default", adapter="tenant-a",
+                                  outcome="ok") - a0 == 2
+        finally:
+            server.stop()
+
+    def test_failed_request_lands_with_outcome(self, lm):
+        from deeplearning4j_tpu.serving.errors import InputValidationError
+
+        server = InferenceServer(lm, decode_slots=2)
+        server.load_adapter("t", net=_tenant_net(lm, 3))
+        try:
+            obs.request_ledger.clear()
+            with pytest.raises(InputValidationError):
+                server.generate([1, 2], 2, adapter="nope")
+            docs = obs.request_ledger.snapshot()
+            assert docs and docs[-1]["outcome"] == "invalid"
+            f0 = _counter_total("dl4j_adapter_requests_total",
+                                model="default", adapter="nope",
+                                outcome="failed")
+            assert f0 >= 1  # invalid folds into the bounded outcome enum
+        finally:
+            server.stop()
+
+    def test_flight_dump_route_rate_limited_with_ledger(self, lm, tmp_path,
+                                                        monkeypatch):
+        monkeypatch.setattr(obs.flight, "dump_dir", str(tmp_path))
+        server = InferenceServer(lm, decode_slots=2)
+        server.start()
+        try:
+            assert server.wait_ready(600)
+            server.generate([1, 2, 3], 3, temperature=0.0)
+            reason = "testdump-ledger-route"
+            doc = _post(server.url, "/admin/flight-dump",
+                        {"reason": reason})
+            assert doc["path"] is not None
+            bundle = doc["path"]
+            assert os.path.isfile(os.path.join(bundle, "ledger.jsonl"))
+            recs = [json.loads(x) for x in
+                    open(os.path.join(bundle, "ledger.jsonl"))
+                    .read().splitlines()]
+            assert any(r["route"] == "generate" for r in recs)
+            # Same reason again inside the min interval: rate-limited.
+            doc2 = _post(server.url, "/admin/flight-dump",
+                         {"reason": reason})
+            assert doc2["path"] is None
+        finally:
+            server.stop()
+
+
+# ------------------------------------------------------- burn-rate engine
+
+
+def _expo(wid, ok, err, ttft=None):
+    """One worker's federated sample lines (cumulative counters)."""
+    lines = [
+        f'dl4j_requests_total{{worker_id="{wid}",model="m",'
+        f'route="generate",outcome="ok"}} {ok}',
+        f'dl4j_requests_total{{worker_id="{wid}",model="m",'
+        f'route="generate",outcome="error"}} {err}',
+    ]
+    if ttft is not None:
+        under, total = ttft
+        lines += [
+            f'dl4j_serving_ttft_seconds_bucket{{worker_id="{wid}",'
+            f'model="m",le="1.0"}} {under}',
+            f'dl4j_serving_ttft_seconds_bucket{{worker_id="{wid}",'
+            f'model="m",le="+Inf"}} {total}',
+        ]
+    return "\n".join(lines) + "\n"
+
+
+class TestBurnRateEngine:
+    def test_parse_prometheus_splits_workers(self):
+        text = _expo("w1", 3, 1) + _expo("w2", 5, 0) + \
+            "# HELP dl4j_requests_total x\nnot a sample\n" + \
+            "dl4j_plain_total 7\n"
+        parsed = slo_mod.parse_prometheus(text)
+        assert set(parsed) == {"w1", "w2", ""}
+        names = {n for n, _, _ in parsed["w1"]}
+        assert names == {"dl4j_requests_total"}
+        # worker_id is stripped from the sample labels
+        _, labels, value = [s for s in parsed["w2"]
+                            if s[2] == 5.0][0]
+        assert "worker_id" not in labels
+
+    def test_latency_bucket_ladder_is_exact(self):
+        o = slo_mod.Objective("ttft", "latency",
+                              "dl4j_serving_ttft_seconds",
+                              target=0.99, threshold_s=1.0)
+        samples = [
+            ("dl4j_serving_ttft_seconds_bucket", {"le": "0.5"}, 90.0),
+            ("dl4j_serving_ttft_seconds_bucket", {"le": "1.0"}, 95.0),
+            ("dl4j_serving_ttft_seconds_bucket", {"le": "+Inf"}, 100.0),
+        ]
+        assert o.counts(samples) == (5.0, 100.0)
+
+    def test_availability_pages_once_then_recovers(self):
+        pages = []
+        eng = slo_mod.BurnRateEngine(
+            objectives=[slo_mod.Objective(
+                "availability", "availability", "dl4j_requests_total",
+                target=0.999)],
+            on_page=lambda name, wids: pages.append((name, wids)))
+        eng.ingest(_expo("w1", 100, 0), now=0.0)
+        eng.ingest(_expo("w1", 100, 50), now=10.0)
+        doc = eng.evaluate(now=10.0)
+        assert doc["severity"] == "page"
+        (alert,) = doc["alerts"]
+        assert alert["objective"] == "availability"
+        assert alert["workers"] == ["w1"]
+        assert pages == [("availability", ["w1"])]
+
+        # Still breaching: severity holds, on_page does NOT re-fire.
+        eng.ingest(_expo("w1", 100, 55), now=20.0)
+        assert eng.evaluate(now=20.0)["severity"] == "page"
+        assert len(pages) == 1
+
+        # Healthy traffic ages the breach out of the page short window
+        # (300s): burn must fire over BOTH windows, so the page clears
+        # even though the long window still remembers the incident.
+        for t in (400.0, 500.0, 600.0, 700.0):
+            eng.ingest(_expo("w1", 100 + t, 55), now=t)
+        doc = eng.evaluate(now=700.0)
+        avail = [o for o in doc["objectives"]
+                 if o["name"] == "availability"][0]
+        assert avail["severity"] != "page"
+
+        # A NEW sustained breach pages again (the paging set reset).
+        eng.ingest(_expo("w1", 800.0, 400), now=710.0)
+        eng.evaluate(now=710.0)
+        assert len(pages) == 2
+
+    def test_latency_objective_pages_with_window_scale(self):
+        eng = slo_mod.BurnRateEngine(
+            objectives=[slo_mod.Objective(
+                "ttft_p99", "latency", "dl4j_serving_ttft_seconds",
+                target=0.99, threshold_s=1.0)],
+            window_scale=1.0 / 600.0)  # page windows 0.5s / 6s
+        eng.ingest(_expo("w1", 0, 0, ttft=(100, 100)), now=0.0)
+        eng.ingest(_expo("w1", 0, 0, ttft=(101, 200)), now=0.3)
+        doc = eng.evaluate(now=0.3)
+        assert doc["severity"] == "page"
+        assert doc["alerts"][0]["objective"] == "ttft_p99"
+
+    def test_counter_reset_clamps_to_zero(self):
+        eng = slo_mod.BurnRateEngine(
+            objectives=[slo_mod.Objective(
+                "availability", "availability", "dl4j_requests_total",
+                target=0.999)])
+        eng.ingest(_expo("w1", 100, 20), now=0.0)
+        eng.ingest(_expo("w1", 5, 0), now=10.0)  # restart: counters reset
+        assert eng.evaluate(now=10.0)["severity"] == "ok"
+
+    def test_default_objectives_cover_the_serving_slos(self):
+        objs = {o.name: o for o in slo_mod.default_objectives()}
+        assert set(objs) == {"availability", "ttft_p99", "itl_p99",
+                             "predict_p99"}
+        assert objs["itl_p99"].family == "dl4j_serving_itl_seconds"
+        assert objs["predict_p99"].labels == {"route": "predict"}
+
+
+# -------------------------------------------------- federation staleness
+
+
+class TestFederationStaleness:
+    def test_lease_expired_member_dropped_and_marked_down(self):
+        agg = fed.FleetAggregator("127.0.0.1:1")
+        doc = {"lost_after_s": 5.0, "detail": {
+            "r-stale@127.0.0.1:59991": {"role": "replica",
+                                        "lease_age_s": 99.0},
+            "r-live@127.0.0.1:59992": {"role": "replica",
+                                       "lease_age_s": 0.1},
+        }}
+        agg._client.status = lambda: doc
+
+        members = agg.members()
+        assert "r-live@127.0.0.1:59992" in members
+        assert "r-stale@127.0.0.1:59991" not in members
+
+        # One poll surfaces the staleness: the expired member is never
+        # scraped but lands in the exposition as federation_up 0.
+        text = agg.federate_metrics()
+        assert ('dl4j_federation_up{worker_id='
+                '"r-stale@127.0.0.1:59991"} 0') in text
+        assert 'worker_id="r-stale@127.0.0.1:59991",' not in text
+
+
+# ----------------------------------------------------- benchdiff sentinel
+
+
+class TestBenchdiff:
+    def _write(self, tmp_path, name, doc):
+        p = tmp_path / name
+        p.write_text(json.dumps(doc))
+        return str(p)
+
+    def test_committed_files_gate_clean(self):
+        from deeplearning4j_tpu.analysis import benchdiff
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        cur = os.path.join(root, "BENCH_out.json")
+        base = os.path.join(root, "BASELINE.json")
+        assert os.path.isfile(cur) and os.path.isfile(base)
+        assert benchdiff.main([cur, base]) == 0
+
+    def test_latency_regression_exits_nonzero(self, tmp_path):
+        from deeplearning4j_tpu.analysis import benchdiff
+
+        base = self._write(tmp_path, "base.json",
+                           {"metric": "predict_p99_ms", "value": 1.0,
+                            "unit": "ms"})
+        cur = self._write(tmp_path, "cur.json",
+                          {"metric": "predict_p99_ms", "value": 2.0,
+                           "unit": "ms"})
+        assert benchdiff.main([cur, base]) == 1
+        # An improvement (or within-tolerance drift) gates clean.
+        ok = self._write(tmp_path, "ok.json",
+                         {"metric": "predict_p99_ms", "value": 0.5,
+                          "unit": "ms"})
+        assert benchdiff.main([ok, base]) == 0
+        # Per-metric tolerance widens the band for THIS metric only.
+        assert benchdiff.main([cur, base,
+                               "--tol", "predict_p99_ms=1.5"]) == 0
+
+    def test_throughput_direction_and_extra_metrics(self, tmp_path):
+        from deeplearning4j_tpu.analysis import benchdiff
+
+        base = self._write(tmp_path, "base.json", {
+            "metric": "tokens_per_s", "value": 100.0,
+            "extra": {"spec_accept_rate": 0.8,
+                      "decode_step_ms": {"value": 5.0, "unit": "ms"}}})
+        drop = self._write(tmp_path, "drop.json", {
+            "metric": "tokens_per_s", "value": 50.0,
+            "extra": {"spec_accept_rate": 0.8,
+                      "decode_step_ms": {"value": 5.0, "unit": "ms"}}})
+        assert benchdiff.main([drop, base]) == 1  # throughput fell
+        rise = self._write(tmp_path, "rise.json", {
+            "metric": "tokens_per_s", "value": 200.0,
+            "extra": {"spec_accept_rate": 0.81,
+                      "decode_step_ms": {"value": 9.0, "unit": "ms"}}})
+        # Throughput up is fine; the ms extra regressed UP -> non-zero.
+        assert benchdiff.main([rise, base]) == 1
+        rows, regs = benchdiff.diff(json.load(open(rise)),
+                                    json.load(open(base)))
+        assert [r["metric"] for r in regs] == ["decode_step_ms"]
+
+    def test_no_shared_metrics_and_bad_usage(self, tmp_path):
+        from deeplearning4j_tpu.analysis import benchdiff
+
+        a = self._write(tmp_path, "a.json", {"metric": "x", "value": 1.0})
+        b = self._write(tmp_path, "b.json", {"metric": "y", "value": 1.0})
+        assert benchdiff.main([a, b]) == 0
+        assert benchdiff.main([a, str(tmp_path / "missing.json")]) == 2
+        assert benchdiff.main([a, b, "--tol", "nonsense"]) == 2
+
+
+# ------------------------------------------------------ fleet acceptance
+
+
+class TestFleetSLOAcceptance:
+    def test_three_replicas_two_tenants_reconcile_page_one_bundle(
+            self, lm, tmp_path, monkeypatch):
+        """The ISSUE 17 acceptance drill, in-process: 3 replicas x 2
+        LoRA tenants under mixed traffic. All replicas share this
+        process's registry and ledger, so every federated sum is 3x the
+        local one — BOTH sides of the reconciliation scale together."""
+        from deeplearning4j_tpu.parallel.coordinator import Coordinator
+        from deeplearning4j_tpu.serving import FleetRouter
+        from deeplearning4j_tpu.serving.fleet import ReplicaServer
+        from deeplearning4j_tpu.serving import metrics as sm
+
+        monkeypatch.setattr(obs.flight, "dump_dir", str(tmp_path))
+        coord = Coordinator(lost_after_s=10.0).start()
+        replicas, router = [], None
+        try:
+            for i in range(3):
+                rs = ReplicaServer(coord.address, name=f"slor{i}",
+                                   net=lm, replica_index=i,
+                                   heartbeat_s=0.25, max_batch_size=4,
+                                   decode_slots=2, kv_cache="paged",
+                                   kv_page_size=8, handle_sigterm=False)
+                rs.server.load_adapter("tenant-a",
+                                       net=_tenant_net(lm, 1))
+                rs.server.load_adapter("tenant-b",
+                                       net=_tenant_net(lm, 2))
+                rs.start()
+                replicas.append(rs)
+            # Shrink the burn windows (1/150 -> page over 2s/24s) so two
+            # HTTP polls a fraction of a second apart exercise the real
+            # multi-window logic.
+            router = FleetRouter(coord.address, poll_interval_s=0.1,
+                                 http=True,
+                                 slo_window_scale=1.0 / 150.0).start()
+            url = router.url
+
+            obs.request_ledger.clear()
+            d0 = _counter_total("dl4j_serving_dispatch_seconds_total",
+                                model="default")
+
+            x = np.asarray([[[t % 7] for t in range(16)]], np.int32)
+            for i, rs in enumerate(replicas):
+                for adapter in (None, "tenant-a", "tenant-b"):
+                    rs.server.predict(x, adapter=adapter)
+                rs.server.generate([1, 2, 3 + i], 4, temperature=0.0,
+                                   adapter=("tenant-a", "tenant-b")[i % 2])
+
+            # Federated accounting: /v1/tenants over the router merges
+            # every replica's rows; device-seconds must reconcile with
+            # the dispatch choke-point counter within 5%.
+            doc = _get(url, "/v1/tenants")
+            rows = doc["tenants"]
+            assert {(r["model"], r["adapter"]) for r in rows} == {
+                ("default", ""), ("default", "tenant-a"),
+                ("default", "tenant-b")}
+            n_workers = len({w for r in rows for w in r["workers"]})
+            assert n_workers == 3
+            fleet_total = sum(r["device_seconds"] for r in rows)
+            delta = _counter_total("dl4j_serving_dispatch_seconds_total",
+                                   model="default") - d0
+            assert delta > 0
+            # Every worker re-reports the one shared in-process ledger.
+            assert abs(fleet_total - 3 * delta) <= 0.05 * (3 * delta)
+
+            # Every replica is up in the federated exposition.
+            text = router.aggregator().federate_metrics()
+            for rs in replicas:
+                wid = f"{rs.name}@{rs.server.host}:{rs.server.port}"
+                assert f'dl4j_federation_up{{worker_id="{wid}"}} 1' in text
+
+            # Healthy burn: no page yet.
+            assert _get(url, "/fleet/slo")["severity"] == "ok"
+            bundles0 = len(os.listdir(str(tmp_path)))
+
+            # Chaos: a latency breach (first tokens at 5s >> the 1s SLO)
+            # lands in the fleet's TTFT histogram...
+            for _ in range(150):
+                sm.TTFT_SECONDS.labels(model="default").observe(5.0)
+
+            # ...and the NEXT burn evaluation pages on ttft_p99 over
+            # both windows, naming every offending worker.
+            doc = _get(url, "/fleet/slo")
+            assert doc["severity"] == "page"
+            alert = [a for a in doc["alerts"]
+                     if a["objective"] == "ttft_p99"][0]
+            assert alert["severity"] == "page"
+            assert len(alert["workers"]) >= 3
+
+            # The page froze evidence on the offenders: the router POSTed
+            # every offender's /admin/flight-dump, and the per-reason
+            # rate limit collapsed them into EXACTLY ONE bundle.
+            bundles = [d for d in os.listdir(str(tmp_path))
+                       if "slo" in d]
+            assert len(os.listdir(str(tmp_path))) - bundles0 == 1
+            assert len(bundles) == 1
+            ledger_file = os.path.join(str(tmp_path), bundles[0],
+                                       "ledger.jsonl")
+            assert os.path.isfile(ledger_file)
+
+            # Still breaching on the next poll: no second dump round
+            # (the engine pages on transition, the recorder rate-limits).
+            assert _get(url, "/fleet/slo")["severity"] == "page"
+            assert len(os.listdir(str(tmp_path))) - bundles0 == 1
+        finally:
+            if router is not None:
+                router.stop()
+            for rs in replicas:
+                try:
+                    rs.drain(timeout_s=5.0)
+                except Exception:
+                    pass
+            coord.close()

@@ -491,6 +491,7 @@ class TestSLOMetricsScrape:
                 'dl4j_serving_request_seconds_bucket{model="default",'
                 'route="generate"',
                 'dl4j_serving_ttft_seconds_bucket{model="default"',
+                'dl4j_serving_itl_seconds_bucket{model="default"',
                 'dl4j_serving_decode_step_seconds_bucket{model="default"',
                 # outcome-labeled request counter
                 'dl4j_requests_total{model="default",route="predict",'

@@ -911,6 +911,71 @@ def refit(conf, params, loss):
         assert [f for f in lint_package(rules=["JX015"])] == []
 
 
+# --------------------------------------------------------------- JX016
+
+class TestJX016UnboundedLabelCardinality:
+    def _lint(self, src, path="deeplearning4j_tpu/serving/fake_server.py"):
+        return lint_source(src, path, rules=["JX016"])
+
+    def test_request_id_label_fires(self):
+        src = """
+def handle(counter, request_id, model):
+    counter.labels(model=model, request=str(request_id)).inc()
+"""
+        fs = self._lint(src)
+        assert rules_of(fs) == {"JX016"}
+        assert "request_id" in fs[0].message
+
+    def test_prompt_in_fstring_label_fires(self):
+        src = """
+def handle(counter, prompt):
+    counter.labels(key=f"p:{prompt[:8]}").inc()
+"""
+        assert rules_of(self._lint(src)) == {"JX016"}
+
+    def test_stringified_exception_label_fires(self):
+        src = """
+def handle(counter, fn):
+    try:
+        fn()
+    except Exception as e:
+        counter.labels(reason=str(e)).inc()
+"""
+        fs = self._lint(src)
+        assert rules_of(fs) == {"JX016"}
+        assert "exception" in fs[0].message
+
+    def test_bare_exception_label_fires(self):
+        src = """
+def handle(counter, fn):
+    try:
+        fn()
+    except Exception as e:
+        counter.labels(reason=e).inc()
+"""
+        assert rules_of(self._lint(src)) == {"JX016"}
+
+    def test_bounded_vocabularies_are_clean(self):
+        # The in-tree shapes: an adapter name drawn from the loaded
+        # registry, a reason capped to its prefix, and an exception fed
+        # to a CLASSIFIER that returns an outcome enum.
+        src = """
+def count(counter, model, adapter, reason, outcome_of, fn):
+    counter.labels(model=model, adapter=str(adapter)).inc()
+    counter.labels(reason=reason.split(":", 1)[0]).inc()
+    try:
+        fn()
+    except Exception as e:
+        counter.labels(outcome=outcome_of(e)).inc()
+"""
+        assert self._lint(src) == []
+
+    def test_package_is_clean(self):
+        # Serving/observability label per-request detail via the ledger
+        # and spans, never via metric labels.
+        assert [f for f in lint_package(rules=["JX016"])] == []
+
+
 # ------------------------------------------------------------ framework
 
 class TestLinterFramework:
@@ -918,7 +983,7 @@ class TestLinterFramework:
         assert set(ALL_RULES) >= {"JX001", "JX002", "JX003", "JX004",
                                   "JX005", "JX006", "JX007", "JX008",
                                   "JX009", "JX010", "JX011", "JX012",
-                                  "JX013", "JX014", "JX015"}
+                                  "JX013", "JX014", "JX015", "JX016"}
 
     def test_findings_are_typed_and_sorted(self):
         src = """
