@@ -4,24 +4,52 @@ The reference DL4J stack validated configuration on the JVM side
 (`MultiLayerConfiguration` sanity checks) before any native kernel ran.
 This package is the JAX port's equivalent, split in two:
 
-- **Static** (`linter.py`, `rules.py`): an AST pass over every module in
-  the package with framework-aware rules (JX001-JX010) for the failure
-  modes that are *silent* on TPU — host syncs inside traced code, Python
-  side effects baked in at trace time, retrace storms, accidental
-  float64, unlocked cross-thread mutation, dtype-sniffing on user input,
-  AOT machinery outside `compilation/`, metrics family creation in hot
-  paths, hardcoded compute dtypes in layer kernels, and Pallas
-  imports outside the kernel registry (`kernels/`, JX010).
-  Run it with ``python -m deeplearning4j_tpu.analysis`` (or the
-  ``tpulint`` console script); findings are suppressible inline
+- **Static** (`linter.py`, `rules.py`, `concurrency.py`): an AST pass
+  over every module in the package with framework-aware rules
+  (JX001-JX018) for the failure modes that are *silent* on TPU:
+
+  ========  ========================================================
+  JX001     host sync (.item/.block_until_ready/np.asarray) under jit
+  JX002     Python side effects (print/time/random) baked at trace
+  JX003     retrace hazards (jit-in-loop, jit(lambda), static arrays)
+  JX004     float64 literals in traced code (TPU emulates f64)
+  JX005     cross-thread attribute mutation without the class lock
+  JX006     dtype-sniffing outside nn/conf/preprocessors.py
+  JX007     AOT machinery (.lower/.compile/jax.export) outside
+            compilation/
+  JX008     metrics family creation in jit-reachable or looped code
+  JX009     hardcoded f32 compute dtype in nn/layers/ kernels
+  JX010     Pallas imports outside the kernel registry (kernels/)
+  JX011     synchronous host->device staging in fit/dispatch loops
+  JX012     blocking socket/HTTP without a timeout in serving/parallel
+  JX013     outbound HTTP hop that drops the X-DL4J-Trace context
+  JX014     dense full-length KV buffers outside the paged pool
+  JX015     grad/updater work over frozen/LoRA leaves outside the seam
+  JX016     metric labels fed from unbounded per-request data
+  JX017     lock-order inversion across code paths (deadlock cycle)
+  JX018     blocking call (dispatch/HTTP/join/sleep/RPC) under a lock
+  ========  ========================================================
+
+  JX017/JX018 come from the interprocedural lock model in
+  `concurrency.py` (``python -m deeplearning4j_tpu.analysis.concurrency
+  [--dot]`` prints the package-wide lock-order graph). Run the linter
+  with ``python -m deeplearning4j_tpu.analysis`` (or the ``tpulint``
+  console script); ``--explain JXnnn`` prints a rule's docstring and a
+  minimal true-positive example. Findings are suppressible inline
   (``# tpulint: disable=JX001``) or grandfathered in a checked-in
   baseline where every entry carries a reason.
 
-- **Runtime** (`runtime.py`): ``strict_mode()`` wraps a step body in
-  ``jax.transfer_guard("disallow")``; ``RetraceGuard`` fires when one
-  function compiles more than N times (wired to the engines' jit-cache
-  counters from the observability core); ``install_nan_guard`` hooks the
-  engines' ``_fit_dispatch`` to fail fast on a NaN loss.
+- **Runtime** (`runtime.py`, `locktrace.py`): ``strict_mode()`` wraps a
+  step body in ``jax.transfer_guard("disallow")``; ``RetraceGuard``
+  fires when one function compiles more than N times (wired to the
+  engines' jit-cache counters from the observability core);
+  ``install_nan_guard`` hooks the engines' ``_fit_dispatch`` to fail
+  fast on a NaN loss. `locktrace.py` is JX017/JX018's runtime twin: an
+  opt-in (``DL4J_TPU_LOCKTRACE=1``) traced-lock factory adopted by the
+  serving/fleet/observability packages, with online lock-order cycle
+  detection and a stall watchdog that dumps one rate-limited flight
+  bundle (``locks.json``: thread stacks + the lock graph) when an
+  acquire blocks past ``DL4J_TPU_LOCK_STALL_S``.
 
 Tier-1 runs the full-package lint (`tests/test_static_analysis.py`), so a
 new violation fails CI before it costs a TPU hour.

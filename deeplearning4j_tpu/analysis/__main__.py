@@ -35,11 +35,34 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable JSON output")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--explain", metavar="JXnnn",
+                    help="print a rule's full docstring and a minimal "
+                         "true-positive example, then exit")
     args = ap.parse_args(argv)
 
     if args.list_rules:
         for rid in sorted(ALL_RULES):
             print(f"{rid}  {ALL_RULES[rid].description}")
+        return 0
+
+    if args.explain:
+        rid = args.explain.upper()
+        cls = ALL_RULES.get(rid)
+        if cls is None:
+            print(f"tpulint: unknown rule {args.explain!r}; known: "
+                  f"{', '.join(sorted(ALL_RULES))}", file=sys.stderr)
+            return 2
+        import inspect
+
+        print(f"{rid}  {cls.description}")
+        print()
+        print(inspect.cleandoc(cls.__doc__ or "(no docstring)"))
+        if cls.example:
+            print()
+            print("Minimal true positive:")
+            print()
+            for line in cls.example.rstrip("\n").split("\n"):
+                print(f"    {line}")
         return 0
 
     rules = args.rules.split(",") if args.rules else None

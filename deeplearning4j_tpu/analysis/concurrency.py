@@ -1,0 +1,696 @@
+"""Whole-program concurrency analysis: the static lock model (JX017/JX018).
+
+Built on the same per-module :class:`~deeplearning4j_tpu.analysis.context.
+ModuleContext` the other tpulint rules use. One :class:`LockModel` per
+module:
+
+- **lock discovery** — ``self._x = threading.Lock()/RLock()/Condition()``
+  attributes and module-level ``_X = threading.Lock()`` globals, plus the
+  instrumented factory spellings (``locktrace.named_lock/named_rlock/
+  named_condition``) so adopting the runtime tracer does not blind the
+  static tier. ``threading.Condition(self._lock)`` aliases the wrapped
+  lock: acquiring either IS the same mutex (`datasets/staging.py` idiom).
+- **acquisition tracking** — ``with self._lock:`` regions (including
+  multi-item ``with a, b:``) and explicit ``.acquire()`` calls, carried
+  through the intra-module call graph with the same closure style as
+  jit-reachability: a function's *acquire summary* is everything it may
+  lock transitively, with one witness chain per lock retained for the
+  report.
+- **JX017** — a cycle in the may-hold→then-acquire graph: two code paths
+  that take the same locks in opposite orders deadlock the first time
+  the schedules interleave. Reported once per cycle with BOTH witness
+  paths (qualnames, not line numbers, so baselines don't churn on edits).
+- **JX018** — blocking work inside a held-lock region: device dispatch
+  (calls to locally-jitted functions, ``block_until_ready``,
+  ``device_put``), outbound HTTP/socket I/O (``urlopen`` and the
+  project's ``post_json``/``get_text`` helpers), coordinator/client
+  RPCs, ``queue.get``, thread ``join``/runtime ``stop``, ``sleep``, and
+  unbounded ``wait`` on foreign events. This is the exact shape of the
+  `_reload` stuck-`loading` and rolling-update bugs: one slow call under
+  the host lock turns into a fleet-wide stall. Waiting on the held
+  lock's own condition (``with self._cond: self._cond.wait()``) is the
+  one legal blocking-under-lock and is exempt.
+
+The analysis is deliberately intra-module (same contract as the call
+graph it rides on): cross-module lock ordering is the runtime tier's job
+(`analysis/locktrace.py`). The CLI merges every module's edges into one
+package-wide graph for inspection::
+
+    python -m deeplearning4j_tpu.analysis.concurrency [--dot] [paths...]
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from deeplearning4j_tpu.analysis.context import (
+    ModuleContext, attr_base, terminal_attr,
+)
+from deeplearning4j_tpu.analysis.findings import Severity
+from deeplearning4j_tpu.analysis.rules import Rule, register_rule
+
+# Constructors that create a lock object. The factory names keep the
+# static tier seeing locks after modules adopt the runtime tracer.
+_LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "condition",
+               "named_lock": "lock", "named_rlock": "rlock",
+               "named_condition": "condition"}
+
+_HTTP_FNS = {"urlopen", "post_json", "get_text"}
+_SOCKET_ATTRS = {"create_connection", "getaddrinfo"}
+_STOPPY_ATTRS = {"stop", "shutdown"}  # join worker threads by convention
+
+
+class _LockRegion:
+    """One ``with <lock>:`` region: the held lock plus its body."""
+
+    __slots__ = ("lock_id", "node", "owner", "outer")
+
+    def __init__(self, lock_id: str, node, owner: str,
+                 outer: List[str]):
+        self.lock_id = lock_id
+        self.node = node          # the With node (line anchor)
+        self.owner = owner        # qualname of the enclosing function
+        self.outer = outer        # locks already held when this one taken
+
+
+class _Edge:
+    """One may-hold→then-acquire observation with its witness."""
+
+    __slots__ = ("src", "dst", "node", "owner", "chain")
+
+    def __init__(self, src: str, dst: str, node, owner: str, chain: str):
+        self.src = src
+        self.dst = dst
+        self.node = node
+        self.owner = owner
+        self.chain = chain
+
+    def witness(self) -> str:
+        return f"{self.owner}: {self.chain}"
+
+
+class _Blocked:
+    """One blocking call observed inside a held-lock region."""
+
+    __slots__ = ("lock_id", "node", "owner", "category", "chain")
+
+    def __init__(self, lock_id: str, node, owner: str, category: str,
+                 chain: str):
+        self.lock_id = lock_id
+        self.node = node
+        self.owner = owner
+        self.category = category
+        self.chain = chain
+
+
+class LockModel:
+    """Interprocedural (intra-module) lock model for one ModuleContext."""
+
+    def __init__(self, ctx: ModuleContext):
+        self.ctx = ctx
+        #: canonical lock id ("Class.attr" / "name") -> kind
+        self.locks: Dict[str, str] = {}
+        #: alias lock id -> canonical id (Condition wrapping a lock)
+        self.aliases: Dict[str, str] = {}
+        #: per-function direct acquisitions
+        self._direct_acq: Dict[str, Set[str]] = {}
+        #: per-function direct blocking calls [(category, label)]
+        self._direct_blk: Dict[str, List[Tuple[str, str]]] = {}
+        #: closures with one witness chain each
+        self.acq_closure: Dict[str, Dict[str, str]] = {}
+        self.blk_closure: Dict[str, Dict[Tuple[str, str], str]] = {}
+        self.edges: List[_Edge] = []
+        self.blocked: List[_Blocked] = []
+        self._find_locks()
+        if self.locks:
+            self._summarize_functions()
+            self._close_summaries()
+            self._scan_regions()
+
+    # ------------------------------------------------------------ discovery
+
+    def _lock_ctor_kind(self, value) -> Optional[str]:
+        if not isinstance(value, ast.Call):
+            return None
+        term = terminal_attr(value.func)
+        if term not in _LOCK_CTORS:
+            return None
+        base = attr_base(value.func)
+        if term in ("Lock", "RLock", "Condition"):
+            if base not in ("threading", term):  # threading.Lock / bare Lock
+                return None
+        return _LOCK_CTORS[term]
+
+    def _find_locks(self):
+        # First pass: creations. Second pass handles Condition(self._lock)
+        # aliases (the wrapped lock may be assigned later in source order,
+        # so aliasing resolves after all creations are known).
+        pending_alias: List[Tuple[str, str]] = []
+        for node in ast.walk(self.ctx.tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            value = node.value
+            kind = self._lock_ctor_kind(value)
+            if kind is None:
+                continue
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            owner = self.ctx.context_of(node)
+            cls = (self.ctx.functions[owner].class_name
+                   if owner in self.ctx.functions else None)
+            for tgt in targets:
+                lock_id = self._target_id(tgt, cls)
+                if lock_id is None:
+                    continue
+                self.locks[lock_id] = kind
+                if kind == "condition" and value.args:
+                    wrapped = self._expr_id(value.args[0], cls)
+                    if wrapped is not None:
+                        pending_alias.append((lock_id, wrapped))
+        for cond_id, wrapped in pending_alias:
+            if wrapped in self.locks:
+                # the condition and its wrapped lock are one mutex
+                self.aliases[cond_id] = wrapped
+                self.locks.pop(cond_id, None)
+
+    def _target_id(self, tgt, cls: Optional[str]) -> Optional[str]:
+        if (isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self" and cls is not None):
+            return f"{cls}.{tgt.attr}"
+        if isinstance(tgt, ast.Name):
+            return tgt.id
+        return None
+
+    def _expr_id(self, expr, cls: Optional[str]) -> Optional[str]:
+        """Resolve a lock-valued expression to a canonical lock id."""
+        lock_id = None
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self" and cls is not None):
+            lock_id = f"{cls}.{expr.attr}"
+        elif isinstance(expr, ast.Name):
+            lock_id = expr.id
+        if lock_id is None:
+            return None
+        lock_id = self.aliases.get(lock_id, lock_id)
+        return lock_id if lock_id in self.locks else None
+
+    def _class_of(self, qual: str) -> Optional[str]:
+        info = self.ctx.functions.get(qual)
+        return info.class_name if info is not None else None
+
+    # ----------------------------------------------------------- summaries
+
+    def _summarize_functions(self):
+        for qual, info in self.ctx.functions.items():
+            cls = info.class_name
+            acq: Set[str] = set()
+            blk: List[Tuple[str, str]] = []
+            for node in _walk_no_defs(info.node):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        lid = self._expr_id(item.context_expr, cls)
+                        if lid is not None:
+                            acq.add(lid)
+                elif isinstance(node, ast.Call):
+                    f = node.func
+                    if (isinstance(f, ast.Attribute)
+                            and f.attr == "acquire"):
+                        lid = self._expr_id(f.value, cls)
+                        if lid is not None:
+                            acq.add(lid)
+                    cat = self._classify_blocking(node, cls, held=None)
+                    if cat is not None:
+                        blk.append(cat)
+            self._direct_acq[qual] = acq
+            self._direct_blk[qual] = blk
+
+    def _close_summaries(self):
+        """Fixpoint propagation of acquire/blocking summaries along the
+        intra-module call graph, keeping one witness chain per fact —
+        the same closure style `jit_reachable` uses, but per function."""
+        acq = {q: {lid: f"with {lid}" for lid in s}
+               for q, s in self._direct_acq.items()}
+        blk = {q: {key: f"{key[1]}" for key in lst}
+               for q, lst in self._direct_blk.items()}
+        for _ in range(len(self.ctx.functions) + 1):
+            changed = False
+            for qual in self.ctx.functions:
+                for kind, name in self.ctx.calls.get(qual, ()):
+                    target = self.ctx._resolve(qual, kind, name)
+                    if target is None or target == qual:
+                        continue
+                    for lid, chain in acq.get(target, {}).items():
+                        if lid not in acq[qual]:
+                            acq[qual][lid] = f"{name}() -> {chain}"
+                            changed = True
+                    for key, chain in blk.get(target, {}).items():
+                        if key not in blk[qual]:
+                            blk[qual][key] = f"{name}() -> {chain}"
+                            changed = True
+            if not changed:
+                break
+        self.acq_closure = acq
+        self.blk_closure = blk
+
+    # ------------------------------------------------------------- regions
+
+    def _scan_regions(self):
+        for qual, info in self.ctx.functions.items():
+            body = info.node.body
+            if not isinstance(body, list):
+                continue  # lambda: expression body, no with-regions
+            self._scan_stmts(body, qual, info.class_name, [])
+
+    def _scan_stmts(self, stmts, qual: str, cls: Optional[str],
+                    held: List[str]):
+        for stmt in stmts:
+            self._scan_node(stmt, qual, cls, held)
+
+    def _scan_node(self, node, qual: str, cls: Optional[str],
+                   held: List[str]):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return
+        if isinstance(node, ast.With):
+            inner = list(held)
+            for item in node.items:
+                lid = self._expr_id(item.context_expr, cls)
+                if lid is not None:
+                    for outer in inner:
+                        self._note_edge(outer, lid, node, qual,
+                                        f"holds {outer}, takes {lid}")
+                    inner = inner + [lid]
+                else:
+                    self._scan_expr(item.context_expr, qual, cls, held)
+            self._scan_stmts(node.body, qual, cls, inner)
+            return
+        if isinstance(node, ast.Call):
+            self._scan_call(node, qual, cls, held)
+            # still descend: nested calls in args are separate call nodes
+        for child in ast.iter_child_nodes(node):
+            self._scan_node(child, qual, cls, held)
+
+    def _scan_expr(self, expr, qual: str, cls: Optional[str],
+                   held: List[str]):
+        for child in ast.walk(expr):
+            if isinstance(child, ast.Call):
+                self._scan_call(child, qual, cls, held)
+
+    def _scan_call(self, node, qual: str, cls: Optional[str],
+                   held: List[str]):
+        if not held:
+            return
+        f = node.func
+        # explicit .acquire() of another known lock while holding one
+        if isinstance(f, ast.Attribute) and f.attr == "acquire":
+            lid = self._expr_id(f.value, cls)
+            if lid is not None:
+                for outer in held:
+                    self._note_edge(outer, lid, node, qual,
+                                    f"holds {outer}, acquires {lid}")
+                return
+        # direct blocking call inside the held region
+        cat = self._classify_blocking(node, cls, held=held)
+        if cat is not None:
+            self.blocked.append(_Blocked(held[-1], node, qual, cat[0],
+                                         cat[1]))
+            return
+        # interprocedural: the callee's transitive acquires/blocking
+        target = self._resolve_call(node, qual)
+        if target is None or target == qual:
+            return
+        if target in self.ctx.jit_roots:
+            self.blocked.append(_Blocked(
+                held[-1], node, qual, "device dispatch",
+                f"call to jitted `{_short(target)}`"))
+            return
+        for lid, chain in self.acq_closure.get(target, {}).items():
+            for outer in held:
+                if lid != outer:
+                    self._note_edge(outer, lid, node, qual,
+                                    f"holds {outer}, calls "
+                                    f"{_short(target)}() -> {chain}")
+        for (category, label), chain in self.blk_closure.get(
+                target, {}).items():
+            self.blocked.append(_Blocked(
+                held[-1], node, qual, category,
+                f"{_short(target)}() -> {chain}"))
+
+    def _resolve_call(self, node, qual: str) -> Optional[str]:
+        f = node.func
+        if isinstance(f, ast.Name):
+            return self.ctx._resolve(qual, "name", f.id)
+        if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+                and f.value.id == "self"):
+            return self.ctx._resolve(qual, "self", f.attr)
+        return None
+
+    def _note_edge(self, src: str, dst: str, node, owner: str, chain: str):
+        if src == dst:
+            return  # reentrancy, not ordering
+        self.edges.append(_Edge(src, dst, node, owner, chain))
+
+    # ------------------------------------------------------ blocking calls
+
+    def _classify_blocking(self, node, cls: Optional[str],
+                           held: Optional[List[str]]
+                           ) -> Optional[Tuple[str, str]]:
+        """(category, label) when `node` is a call that can block the
+        thread; None otherwise. `held` enables the same-lock wait
+        exemption (a summary pass passes None and keeps waits out — a
+        callee's `cond.wait()` belongs to the callee's own lock)."""
+        ctx = self.ctx
+        f = node.func
+        term = terminal_attr(f)
+        base = attr_base(f)
+        kwargs = {kw.arg for kw in node.keywords}
+        if term == "block_until_ready":
+            return ("device sync", ".block_until_ready()")
+        if term == "device_put" and base in (ctx.jax_aliases | {"jax"}):
+            return ("device dispatch", f"{base}.device_put()")
+        if isinstance(f, ast.Name) and f.id in _HTTP_FNS:
+            return ("network I/O", f"{f.id}()")
+        if isinstance(f, ast.Attribute) and term in _HTTP_FNS:
+            return ("network I/O", f".{term}()")
+        if term in _SOCKET_ATTRS and base == "socket":
+            return ("network I/O", f"socket.{term}()")
+        if term == "sleep" and (base in ctx.time_aliases
+                                or isinstance(f, ast.Name)):
+            return ("sleep", "sleep()")
+        if isinstance(f, ast.Attribute):
+            recv = terminal_attr(f.value) or ""
+            if term == "join" and not node.args:
+                return ("thread join", f"{recv}.join()")
+            if (term in _STOPPY_ATTRS and recv
+                    and recv not in ("self", "cls")):
+                return ("worker stop/join", f"{recv}.{term}()")
+            if term == "get" and "queue" in recv.lower():
+                return ("queue wait", f"{recv}.get()")
+            if (term in ("wait", "wait_for")
+                    and held is not None):
+                lid = self._expr_id(f.value, cls)
+                if lid is not None and lid in held:
+                    return None  # waiting on the held lock's condition
+                if "timeout" not in kwargs and len(node.args) < (
+                        2 if term == "wait_for" else 1):
+                    return ("blocking wait", f"{recv}.{term}()")
+            # coordinator/client RPCs: any method on a *client handle
+            if "client" in recv.lower() or "coordinator" in recv.lower():
+                return ("coordinator RPC", f"{recv}.{term}()")
+        return None
+
+    # ------------------------------------------------------------- queries
+
+    def order_edges(self) -> Dict[Tuple[str, str], List[_Edge]]:
+        out: Dict[Tuple[str, str], List[_Edge]] = {}
+        for e in self.edges:
+            out.setdefault((e.src, e.dst), []).append(e)
+        return out
+
+    def cycles(self) -> List[List[Tuple[str, str]]]:
+        """Distinct cycles in the order graph as edge lists, each edge a
+        (src, dst) key into :meth:`order_edges`. Deterministic order."""
+        adj: Dict[str, Set[str]] = {}
+        for (a, b) in self.order_edges():
+            adj.setdefault(a, set()).add(b)
+        seen_cycles: Set[frozenset] = set()
+        out: List[List[Tuple[str, str]]] = []
+        for (a, b) in sorted(self.order_edges()):
+            path = _find_path(adj, b, a)
+            if path is None:
+                continue
+            nodes = frozenset([a] + path)
+            if nodes in seen_cycles:
+                continue
+            seen_cycles.add(nodes)
+            cycle_nodes = [a, b] + path[1:]  # a -> b -> ... -> a
+            out.append([(cycle_nodes[i], cycle_nodes[i + 1])
+                        for i in range(len(cycle_nodes) - 1)])
+        return out
+
+
+def _find_path(adj: Dict[str, Set[str]], src: str, dst: str
+               ) -> Optional[List[str]]:
+    """Shortest path src..dst (inclusive) over `adj`, None when absent."""
+    if src == dst:
+        return [src]
+    frontier = [[src]]
+    seen = {src}
+    while frontier:
+        nxt: List[List[str]] = []
+        for path in frontier:
+            for peer in sorted(adj.get(path[-1], ())):
+                if peer == dst:
+                    return path + [peer]
+                if peer not in seen:
+                    seen.add(peer)
+                    nxt.append(path + [peer])
+        frontier = nxt
+    return None
+
+
+def _walk_no_defs(fn_node) -> Iterator[ast.AST]:
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _short(qual: str) -> str:
+    return qual.rsplit(".<locals>.", 1)[-1].rsplit(".", 1)[-1]
+
+
+# ----------------------------------------------------------------- rules
+
+# One LockModel per (ModuleContext) — JX017 and JX018 share the pass.
+_MODEL_CACHE: Dict[int, LockModel] = {}
+
+
+def model_for(ctx: ModuleContext) -> LockModel:
+    model = _MODEL_CACHE.get(id(ctx))
+    if model is None or model.ctx is not ctx:
+        _MODEL_CACHE.clear()  # one module in flight at a time
+        model = LockModel(ctx)
+        _MODEL_CACHE[id(ctx)] = model
+    return model
+
+
+def _skip(ctx: ModuleContext) -> bool:
+    rel = ctx.rel.replace("\\", "/")
+    return "/analysis/" in rel or rel.startswith("analysis/")
+
+
+@register_rule
+class LockOrderRule(Rule):
+    """JX017: potential lock-order inversion (deadlock on interleave).
+
+    Two code paths acquire the same locks in opposite orders: the
+    may-hold→then-acquire graph built from every ``with``/``acquire``
+    region (closed over the intra-module call graph) contains a cycle.
+    The first schedule that interleaves the two paths deadlocks — the
+    bug ships silently because each path is correct alone. Reported once
+    per cycle with a witness path for every edge.
+    """
+
+    id = "JX017"
+    description = ("lock-order inversion: two paths acquire the same "
+                   "locks in opposite orders")
+
+    example = '''\
+import threading
+
+class Transfer:
+    def __init__(self):
+        self._src = threading.Lock()
+        self._dst = threading.Lock()
+
+    def push(self):
+        with self._src:
+            with self._dst:
+                pass
+
+    def pull(self):
+        with self._dst:
+            with self._src:
+                pass
+'''
+
+    def check(self, ctx):
+        if _skip(ctx):
+            return
+        model = model_for(ctx)
+        if not model.locks:
+            return
+        edge_map = model.order_edges()
+        for cycle in model.cycles():
+            witnesses = "; ".join(
+                edge_map[key][0].witness() for key in cycle)
+            ring = " -> ".join([cycle[0][0]] + [b for _, b in cycle])
+            anchor = edge_map[cycle[0]][0]
+            yield self.finding(
+                ctx, anchor.node,
+                f"lock-order inversion {ring}: {witnesses} — opposite "
+                "acquisition orders deadlock when the paths interleave")
+
+
+@register_rule
+class BlockingUnderLockRule(Rule):
+    """JX018: blocking call while holding a lock.
+
+    Device dispatch (jitted-program calls, ``block_until_ready``,
+    ``device_put``), outbound HTTP/socket I/O, coordinator RPCs,
+    ``queue.get``, thread ``join`` / worker ``stop()``, ``sleep`` and
+    unbounded foreign ``wait`` inside a held-lock region serialize every
+    other thread behind one slow operation — the `_reload` and
+    rolling-update bug shape: the lock is held for the duration of I/O
+    that can take seconds, so health checks, admission and unrelated
+    models all stall. Waiting on the held lock's own condition is
+    exempt. Move the slow call off the lock: snapshot under the lock,
+    do the work outside, re-take the lock to publish.
+    """
+
+    id = "JX018"
+    description = ("blocking call (device dispatch / network / join / "
+                   "sleep / RPC) while holding a lock")
+
+    example = '''\
+import threading
+import time
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def refresh(self):
+        with self._lock:
+            time.sleep(1.0)
+'''
+
+    def check(self, ctx):
+        if _skip(ctx):
+            return
+        model = model_for(ctx)
+        if not model.locks:
+            return
+        for b in model.blocked:
+            yield self.finding(
+                ctx, b.node,
+                f"{b.category} while holding {b.lock_id}: {b.chain} — "
+                "blocks every thread contending this lock for the "
+                "call's duration; snapshot under the lock and do the "
+                "slow work outside",
+                Severity.WARNING)
+
+
+# ------------------------------------------------------------------- CLI
+
+
+def package_graph(paths: Optional[Sequence[str]] = None):
+    """(edges, cycles, lock_kinds) merged across modules, lock ids
+    qualified by repo-relative path so the graph is package-wide."""
+    import os
+
+    from deeplearning4j_tpu.analysis.linter import (
+        _PKG_DIR, _relpath, iter_py_files,
+    )
+
+    files: List[str] = []
+    for p in (paths or [_PKG_DIR]):
+        if os.path.isdir(p):
+            files.extend(iter_py_files(p))
+        else:
+            files.append(p)
+    edges: Dict[Tuple[str, str], List[str]] = {}
+    kinds: Dict[str, str] = {}
+    cycles: List[Tuple[str, List[str]]] = []
+    for path in files:
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                src = fh.read()
+            rel = _relpath(path)
+            ctx = ModuleContext(src, path, rel)
+        except (OSError, SyntaxError):
+            continue
+        if _skip(ctx):
+            continue
+        model = LockModel(ctx)
+        if not model.locks:
+            continue
+        mod = rel.rsplit("/", 1)[-1].rsplit(".py", 1)[0]
+        for lid, kind in model.locks.items():
+            kinds[f"{mod}.{lid}"] = kind
+        for (a, b), es in model.order_edges().items():
+            edges.setdefault((f"{mod}.{a}", f"{mod}.{b}"), []).extend(
+                f"{rel}:{e.node.lineno} {e.witness()}" for e in es)
+        edge_map = model.order_edges()
+        for cycle in model.cycles():
+            ring = " -> ".join([cycle[0][0]] + [bb for _, bb in cycle])
+            cycles.append((f"{mod}: {ring}",
+                           [edge_map[k][0].witness() for k in cycle]))
+    return edges, cycles, kinds
+
+
+def to_dot(edges, cycles, kinds) -> str:
+    cyclic_nodes = set()
+    for desc, _ in cycles:
+        ring = desc.split(": ", 1)[1]
+        mod = desc.split(":", 1)[0]
+        cyclic_nodes.update(f"{mod}.{n}" for n in ring.split(" -> "))
+    lines = ["digraph lock_order {", '  rankdir="LR";',
+             '  node [shape=box, fontsize=10];']
+    for node in sorted(kinds):
+        attrs = [f'label="{node}\\n({kinds[node]})"']
+        if node in cyclic_nodes:
+            attrs.append('color="red"')
+        lines.append(f'  "{node}" [{", ".join(attrs)}];')
+    for (a, b), witnesses in sorted(edges.items()):
+        color = ', color="red"' if a in cyclic_nodes and b in cyclic_nodes \
+            else ""
+        lines.append(f'  "{a}" -> "{b}" [label="{len(witnesses)}"{color}];')
+    lines.append("}")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m deeplearning4j_tpu.analysis.concurrency",
+        description="Static lock-order graph + witness paths "
+                    "(JX017/JX018 model)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs (default: the whole package)")
+    ap.add_argument("--dot", action="store_true",
+                    help="emit the graph as Graphviz DOT on stdout")
+    args = ap.parse_args(argv)
+
+    edges, cycles, kinds = package_graph(args.paths or None)
+    if args.dot:
+        print(to_dot(edges, cycles, kinds), end="")
+        return 0
+    print(f"lock-order graph: {len(kinds)} lock(s), "
+          f"{len(edges)} ordered edge(s), {len(cycles)} cycle(s)")
+    for (a, b), witnesses in sorted(edges.items()):
+        print(f"  {a} -> {b}  [{len(witnesses)} path(s)]")
+        for w in witnesses[:3]:
+            print(f"      {w}")
+    if cycles:
+        print("cycles (JX017):")
+        for desc, witnesses in cycles:
+            print(f"  {desc}")
+            for w in witnesses:
+                print(f"      {w}")
+    return 1 if cycles else 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

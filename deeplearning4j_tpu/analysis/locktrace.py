@@ -1,0 +1,516 @@
+"""Runtime lock tracer: held-set tracking, online cycle detection, and a
+stall watchdog that turns silent hangs into flight bundles.
+
+The static tier (`analysis/concurrency.py`) sees one module at a time;
+this is the cross-module truth. Opt-in via ``DL4J_TPU_LOCKTRACE=1``: the
+``named_lock``/``named_rlock``/``named_condition`` factory — adopted by
+the serving, fleet and observability packages — then returns traced
+wrappers instead of plain ``threading`` primitives (disabled, it returns
+the plain primitive: the off cost is one env check at construction, zero
+per acquire).
+
+Traced locks record, per thread, the stack of locks currently held and,
+at every acquire *start*, the observed may-hold→then-acquire edges. A
+new edge runs online cycle detection over the observed graph — an AB/BA
+interleave is flagged the moment the second order is *attempted*, not
+when it deadlocks. Metrics: ``dl4j_lock_order_edges`` (gauge, distinct
+observed edges) and ``dl4j_lock_cycles_total`` (counter).
+
+The **watchdog** (daemon thread, started with the first traced lock)
+fires when an acquire has been blocked, or a lock held, longer than
+``DL4J_TPU_LOCK_STALL_S`` (default 30): it dumps ONE flight bundle
+(reason ``lock_stall``, subject to the recorder's per-reason rate limit,
+so a stalled fleet produces forensics, not a disk full) and writes
+``locks.json`` into the bundle: every thread's stack, its held locks and
+the lock it is waiting for, the full acquisition-order graph, and any
+detected cycles — enough to read a deadlock off one file.
+
+`lock_inversion_drill` is the chaos probe (`util/faultinject.py` kind
+``lock_invert``): two threads forced into AB/BA acquisition, asserting
+the cycle is flagged and the watchdog produces exactly one bundle.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional, Tuple
+
+ENV_ENABLE = "DL4J_TPU_LOCKTRACE"
+ENV_STALL_S = "DL4J_TPU_LOCK_STALL_S"
+
+STALL_REASON = "lock_stall"
+
+
+def enabled() -> bool:
+    return os.environ.get(ENV_ENABLE, "0").lower() in ("1", "true", "on")
+
+
+def stall_threshold_s() -> float:
+    try:
+        return float(os.environ.get(ENV_STALL_S, "30"))
+    except ValueError:
+        return 30.0
+
+
+class _Held:
+    __slots__ = ("lock", "since")
+
+    def __init__(self, lock: "TracedLock", since: float):
+        self.lock = lock
+        self.since = since
+
+
+class _Registry:
+    """Process-global tracer state. Its internal lock is a PLAIN lock and
+    every metrics/flight call happens OUTSIDE it — the tracer must never
+    take a traced lock (or anything that takes one) while holding its own
+    state, or instrumenting the metrics registry would deadlock the
+    instrumentation."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # (src_name, dst_name) -> observation count
+        self.edges: Dict[Tuple[str, str], int] = {}
+        self._adj: Dict[str, set] = {}
+        self.cycles: List[List[str]] = []   # detected rings, capped
+        self.cycles_total = 0
+        # thread ident -> held stack (the list object is shared with the
+        # owning thread's TLS; only the owner mutates it)
+        self.held_by_thread: Dict[int, List[_Held]] = {}
+        # thread ident -> (lock name, blocked-since monotonic)
+        self.pending: Dict[int, Tuple[str, float]] = {}
+        self.last_stall_bundle: Optional[str] = None
+        self.stall_dumps = 0
+        self._watchdog: Optional[threading.Thread] = None
+        self._metrics_wired = False
+
+    # ------------------------------------------------------------ edges
+
+    def record_edges(self, held_names: List[str], dst: str
+                     ) -> Optional[List[str]]:
+        """Record held->dst edges; returns a cycle ring when the newest
+        edge closes one. Cycle bookkeeping happens inside the state lock;
+        the CALLER emits metrics/events after release."""
+        ring: Optional[List[str]] = None
+        with self._lock:
+            for src in held_names:
+                if src == dst:
+                    continue
+                key = (src, dst)
+                fresh = key not in self.edges
+                self.edges[key] = self.edges.get(key, 0) + 1
+                self._adj.setdefault(src, set()).add(dst)
+                if fresh:
+                    path = self._path(dst, src)
+                    if path is not None:
+                        ring = [src] + path
+                        self.cycles_total += 1
+                        if len(self.cycles) < 32:
+                            self.cycles.append(ring)
+        return ring
+
+    def _path(self, src: str, dst: str) -> Optional[List[str]]:
+        if src == dst:
+            return [src]
+        frontier, seen = [[src]], {src}
+        while frontier:
+            nxt = []
+            for path in frontier:
+                for peer in self._adj.get(path[-1], ()):
+                    if peer == dst:
+                        return path + [peer]
+                    if peer not in seen:
+                        seen.add(peer)
+                        nxt.append(path + [peer])
+            frontier = nxt
+        return None
+
+    # ---------------------------------------------------------- pending
+
+    def note_pending(self, ident: int, name: str) -> None:
+        with self._lock:
+            self.pending[ident] = (name, time.monotonic())
+
+    def clear_pending(self, ident: int) -> None:
+        with self._lock:
+            self.pending.pop(ident, None)
+
+    def held_stack(self, ident: int) -> List[_Held]:
+        with self._lock:
+            stack = self.held_by_thread.get(ident)
+            if stack is None:
+                stack = []
+                self.held_by_thread[ident] = stack
+            return stack
+
+    # ---------------------------------------------------------- watchdog
+
+    def ensure_watchdog(self) -> None:
+        with self._lock:
+            if self._watchdog is not None and self._watchdog.is_alive():
+                return
+            self._watchdog = threading.Thread(
+                target=self._watch_loop, name="dl4j-lock-watchdog",
+                daemon=True)
+            self._watchdog.start()
+
+    def _watch_loop(self) -> None:
+        _tls.internal = True  # the watchdog's own locking is not traced
+        while True:
+            stall = stall_threshold_s()
+            time.sleep(min(1.0, max(0.02, stall / 4.0)))
+            try:
+                detail = self._find_stall(stall)
+                if detail is not None:
+                    self._dump_stall(detail)
+            except Exception:
+                pass  # forensics must never kill the process
+
+    def _find_stall(self, stall_s: float) -> Optional[Dict[str, Any]]:
+        now = time.monotonic()
+        with self._lock:
+            for ident, (name, since) in self.pending.items():
+                if now - since > stall_s:
+                    return {"kind": "acquire_blocked", "lock": name,
+                            "thread": ident,
+                            "seconds": round(now - since, 3)}
+            for ident, stack in self.held_by_thread.items():
+                for h in stack:
+                    if now - h.since > stall_s:
+                        return {"kind": "held_too_long",
+                                "lock": h.lock.name, "thread": ident,
+                                "seconds": round(now - h.since, 3)}
+        return None
+
+    def _dump_stall(self, detail: Dict[str, Any]) -> None:
+        """One rate-limited bundle per stall episode: the flight
+        recorder's per-reason limiter is the dedupe — every watchdog
+        tick re-detects the same stall, only the first write lands."""
+        try:
+            from deeplearning4j_tpu.observability.flight import recorder
+        except Exception:
+            return
+        recorder.record_event(
+            "lock_stall",
+            **{("stall_kind" if k == "kind" else k): v
+               for k, v in detail.items()})
+        bundle = recorder.dump(reason=STALL_REASON, force=False)
+        if bundle is None:
+            return
+        payload = snapshot(stall=detail)
+        try:
+            with open(os.path.join(bundle, "locks.json"), "w") as f:
+                json.dump(payload, f, indent=2, default=str)
+        except OSError:
+            pass
+        with self._lock:
+            self.last_stall_bundle = bundle
+            self.stall_dumps += 1
+
+    # ----------------------------------------------------------- metrics
+
+    def wire_metrics(self) -> None:
+        if self._metrics_wired:
+            return
+        self._metrics_wired = True
+        try:
+            from deeplearning4j_tpu import observability as _obs
+
+            _obs.metrics.gauge(
+                "dl4j_lock_order_edges",
+                "Distinct observed lock acquisition-order edges",
+            ).set_function(lambda: float(len(self.edges)))
+        except Exception:
+            self._metrics_wired = False
+
+    def on_cycle(self, ring: List[str]) -> None:
+        """Metric + flight event for one fresh cycle. Called with NO
+        tracer state held; nested metric locking is untraced via the
+        thread-local guard."""
+        prev = getattr(_tls, "internal", False)
+        _tls.internal = True
+        try:
+            try:
+                from deeplearning4j_tpu import observability as _obs
+
+                _obs.metrics.counter(
+                    "dl4j_lock_cycles_total",
+                    "Observed lock-order cycles (potential deadlocks)",
+                ).inc()
+            except Exception:
+                pass
+            try:
+                from deeplearning4j_tpu.observability.flight import recorder
+
+                recorder.record_event("lock_cycle",
+                                      ring=" -> ".join(ring))
+            except Exception:
+                pass
+        finally:
+            _tls.internal = prev
+
+    def reset(self) -> None:
+        """Test hook: drop graph/cycle/stall state (held/pending stacks
+        belong to live threads and are left alone)."""
+        with self._lock:
+            self.edges.clear()
+            self._adj.clear()
+            self.cycles.clear()
+            self.cycles_total = 0
+            self.last_stall_bundle = None
+            self.stall_dumps = 0
+
+
+_registry = _Registry()
+_tls = threading.local()
+
+
+def _internal() -> bool:
+    return getattr(_tls, "internal", False)
+
+
+def _stack() -> List[_Held]:
+    """This thread's held stack, cached in TLS so the acquire hot path
+    touches the global registry lock only on first use per thread."""
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _registry.held_stack(threading.get_ident())
+        _tls.stack = stack
+    return stack
+
+
+class TracedLock:
+    """Wrapper around a ``threading.Lock``/``RLock`` that records per-
+    thread held sets and acquisition-order edges. API-compatible with
+    the wrapped primitive, including the private condition-variable
+    protocol (``_release_save``/``_acquire_restore``/``_is_owned``) so
+    ``threading.Condition`` can drive it."""
+
+    def __init__(self, name: str, inner=None):
+        self.name = str(name)
+        self._inner = inner if inner is not None else threading.Lock()
+        _registry.ensure_watchdog()
+        _registry.wire_metrics()
+
+    # ------------------------------------------------------------- core
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if _internal():
+            return self._inner.acquire(blocking, timeout)
+        ident = threading.get_ident()
+        stack = _stack()
+        reentrant = any(h.lock is self for h in stack)
+        if not reentrant and stack:
+            ring = _registry.record_edges(
+                [h.lock.name for h in stack], self.name)
+            if ring is not None:
+                _registry.on_cycle(ring)
+        if not reentrant:
+            _registry.note_pending(ident, self.name)
+        try:
+            ok = self._inner.acquire(blocking, timeout)
+        finally:
+            if not reentrant:
+                _registry.clear_pending(ident)
+        if ok:
+            stack.append(_Held(self, time.monotonic()))
+        return ok
+
+    def release(self) -> None:
+        if not _internal():
+            stack = _stack()
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i].lock is self:
+                    del stack[i]
+                    break
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self) -> bool:
+        fn = getattr(self._inner, "locked", None)
+        return bool(fn()) if fn is not None else False
+
+    # ------------------------------------- condition-variable protocol
+
+    def _release_save(self):
+        stack = _stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i].lock is self:
+                del stack[i]
+        inner = self._inner
+        if hasattr(inner, "_release_save"):
+            return inner._release_save()
+        inner.release()
+        return None
+
+    def _acquire_restore(self, state) -> None:
+        inner = self._inner
+        if hasattr(inner, "_acquire_restore"):
+            inner._acquire_restore(state)
+        else:
+            inner.acquire()
+        _stack().append(_Held(self, time.monotonic()))
+
+    def _is_owned(self) -> bool:
+        inner = self._inner
+        if hasattr(inner, "_is_owned"):
+            return inner._is_owned()
+        if inner.acquire(False):
+            inner.release()
+            return False
+        return True
+
+    def __repr__(self) -> str:
+        return f"<TracedLock {self.name!r} {self._inner!r}>"
+
+
+# ----------------------------------------------------------------- factory
+
+
+def named_lock(name: str):
+    """A mutex for `name`: plain ``threading.Lock`` normally, a traced
+    wrapper under ``DL4J_TPU_LOCKTRACE=1`` (checked at construction, so
+    long-lived objects pin the mode they were built under)."""
+    if not enabled():
+        return threading.Lock()
+    return TracedLock(name, threading.Lock())
+
+
+def named_rlock(name: str):
+    if not enabled():
+        return threading.RLock()
+    return TracedLock(name, threading.RLock())
+
+
+def named_condition(name: str, lock=None):
+    """A condition variable whose underlying mutex is traced when the
+    tracer is on. Pass `lock` to share an existing (traced or plain)
+    mutex, mirroring ``threading.Condition(lock)``."""
+    if lock is None:
+        lock = named_rlock(name)
+    return threading.Condition(lock)
+
+
+# ---------------------------------------------------------------- snapshot
+
+
+def snapshot(stall: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """The ``locks.json`` payload: all threads' stacks + held/waiting
+    lock state + the observed order graph. Safe to call from any thread
+    (including the watchdog while other threads are deadlocked)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    frames = sys._current_frames()
+    with _registry._lock:
+        held = {ident: [{"lock": h.lock.name,
+                         "held_s": round(time.monotonic() - h.since, 3)}
+                        for h in stack]
+                for ident, stack in _registry.held_by_thread.items()
+                if stack}
+        pending = {ident: {"lock": name,
+                           "blocked_s": round(
+                               time.monotonic() - since, 3)}
+                   for ident, (name, since) in _registry.pending.items()}
+        edges = [{"from": a, "to": b, "count": n}
+                 for (a, b), n in sorted(_registry.edges.items())]
+        cycles = [" -> ".join(ring) for ring in _registry.cycles]
+        cycles_total = _registry.cycles_total
+    threads = []
+    for ident, frame in frames.items():
+        threads.append({
+            "ident": ident,
+            "name": names.get(ident, "?"),
+            "held": held.get(ident, []),
+            "waiting_for": pending.get(ident),
+            "stack": traceback.format_stack(frame),
+        })
+    doc: Dict[str, Any] = {
+        "format": 1,
+        "threads": threads,
+        "edges": edges,
+        "cycles": cycles,
+        "cycles_total": cycles_total,
+    }
+    if stall is not None:
+        doc["stall"] = stall
+    return doc
+
+
+def stats() -> Dict[str, Any]:
+    with _registry._lock:
+        return {
+            "enabled": enabled(),
+            "edges": len(_registry.edges),
+            "cycles_total": _registry.cycles_total,
+            "stall_dumps": _registry.stall_dumps,
+            "last_stall_bundle": _registry.last_stall_bundle,
+        }
+
+
+def reset() -> None:
+    _registry.reset()
+
+
+# ------------------------------------------------------------------ drill
+
+
+def lock_inversion_drill(acquire_timeout_s: float = 2.0,
+                         settle_s: float = 2.0) -> Dict[str, Any]:
+    """Chaos drill (`faultinject` kind ``lock_invert``): two threads
+    forced into AB/BA acquisition. Thread 1 holds A and tries B; thread
+    2 holds B and tries A — a real (bounded) deadlock for up to
+    `acquire_timeout_s`, long enough for the watchdog to observe a stall
+    past ``DL4J_TPU_LOCK_STALL_S`` and dump its one bundle. Returns what
+    the tracer saw; raises if the tracer is disabled (the drill proves
+    the detection machinery, there is nothing to prove without it)."""
+    if not enabled():
+        raise RuntimeError(
+            f"lock_inversion_drill needs {ENV_ENABLE}=1")
+    before = stats()
+    lock_a = named_lock("drill.a")
+    lock_b = named_lock("drill.b")
+    barrier = threading.Barrier(2, timeout=max(5.0, acquire_timeout_s))
+    acquired: Dict[str, bool] = {}
+
+    def leg(first, second, key):
+        with first:
+            try:
+                barrier.wait()
+            except threading.BrokenBarrierError:
+                return
+            got = second.acquire(timeout=acquire_timeout_s)
+            acquired[key] = got
+            if got:
+                second.release()
+
+    t1 = threading.Thread(target=leg, args=(lock_a, lock_b, "ab"),
+                          name="dl4j-drill-ab", daemon=True)
+    t2 = threading.Thread(target=leg, args=(lock_b, lock_a, "ba"),
+                          name="dl4j-drill-ba", daemon=True)
+    t1.start()
+    t2.start()
+    t1.join(timeout=acquire_timeout_s + 10.0)
+    t2.join(timeout=acquire_timeout_s + 10.0)
+    # the watchdog may still be writing locks.json; give it a moment
+    deadline = time.monotonic() + settle_s
+    while (time.monotonic() < deadline
+           and stats()["stall_dumps"] == before["stall_dumps"]):
+        time.sleep(0.02)
+    after = stats()
+    return {
+        "cycle_flagged": after["cycles_total"] > before["cycles_total"],
+        "stall_dumps": after["stall_dumps"] - before["stall_dumps"],
+        "bundle": after["last_stall_bundle"],
+        "acquired": dict(acquired),
+    }

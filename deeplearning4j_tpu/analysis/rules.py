@@ -1,4 +1,4 @@
-"""tpulint rules JX001-JX016.
+"""tpulint rules JX001-JX016 (JX017/JX018 live in concurrency.py).
 
 Each rule is a class with a stable ``id``; registration is
 registry-driven (`@register_rule`) so satellite PRs add rules without
@@ -34,6 +34,11 @@ def get_rules(only=None) -> List["Rule"]:
 class Rule:
     id: str = ""
     description: str = ""
+    #: minimal true-positive snippet, printed by ``tpulint --explain`` and
+    #: asserted to fire by the test suite; path-scoped rules set
+    #: ``example_path`` to a virtual in-scope path.
+    example: str = ""
+    example_path: str = "<snippet>"
 
     def check(self, ctx: ModuleContext) -> Iterator[Finding]:
         raise NotImplementedError
@@ -77,6 +82,15 @@ class HostSyncRule(Rule):
 
     id = "JX001"
     description = "host sync (.item/.block_until_ready/np.asarray/float) in jit-reachable code"
+    example = """\
+import jax
+
+@jax.jit
+def step(x):
+    y = x + 1
+    y.block_until_ready()   # JX001: drains the dispatch queue mid-trace
+    return y
+"""
 
     _SYNC_ATTRS = {"block_until_ready": "drains the dispatch queue",
                    "item": "device->host scalar transfer"}
@@ -124,6 +138,15 @@ class SideEffectRule(Rule):
 
     id = "JX002"
     description = "Python side effects (print/time/random/np.random) under jit"
+    example = """\
+import jax
+import time
+
+@jax.jit
+def step(x):
+    t0 = time.perf_counter()   # JX002: frozen at trace time
+    return x * 2
+"""
 
     _TIME_FNS = {"time", "perf_counter", "monotonic", "process_time",
                  "clock", "time_ns", "perf_counter_ns"}
@@ -183,6 +206,15 @@ class RetraceHazardRule(Rule):
 
     id = "JX003"
     description = "retrace hazards: jit-in-loop, jit(lambda) per call, static_argnums on arrays"
+    example = """\
+import jax
+
+def train(steps, x):
+    for _ in range(steps):
+        f = jax.jit(lambda v: v + 1)   # JX003: fresh program per iteration
+        x = f(x)
+    return x
+"""
 
     def check(self, ctx):
         for node in ast.walk(ctx.tree):
@@ -259,6 +291,14 @@ class Float64Rule(Rule):
 
     id = "JX004"
     description = "float64 literal / implicit x64 promotion in jit-reachable code"
+    example = """\
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x):
+    return x.astype(jnp.float64)   # JX004: TPUs emulate f64 ~10x slower
+"""
 
     def _x64_guarded(self, ctx, node) -> bool:
         for anc in ctx.ancestors(node):
@@ -316,6 +356,19 @@ class ThreadSafetyRule(Rule):
 
     id = "JX005"
     description = "attribute mutated across threads without holding the class lock"
+    example = """\
+import threading
+
+class Worker:
+    def start(self):
+        threading.Thread(target=self._work).start()
+
+    def _work(self):
+        self.count = self.count + 1   # JX005: raced with reset() below
+
+    def reset(self):
+        self.count = 0
+"""
 
     def check(self, ctx):
         classes: Dict[str, List] = {}
@@ -468,6 +521,14 @@ class DtypeSniffRule(Rule):
 
     id = "JX006"
     description = "dtype-sniffing (x.dtype == uint8) outside nn/conf/preprocessors.py"
+    example = """\
+import numpy as np
+
+def ingest(x):
+    if x.dtype == np.uint8:   # JX006: uint8 embedding ids get /255'd too
+        x = x / 255.0
+    return x
+"""
 
     ALLOWED_SUFFIXES = ("nn/conf/preprocessors.py",)
 
@@ -509,6 +570,13 @@ class AotOutsideCompilationRule(Rule):
     id = "JX007"
     description = ("AOT compile machinery (.lower()/.compile()/jax.export) "
                    "outside compilation/")
+    example = """\
+import jax
+
+def warm(fn, x):
+    lowered = jax.jit(fn).lower(x)   # JX007: bypasses the executable store
+    return lowered.compile()
+"""
 
     ALLOWED_SUFFIXES = ("observability/profiler.py",)
 
@@ -578,6 +646,12 @@ class MetricsInHotPathRule(Rule):
     id = "JX008"
     description = ("metrics family creation (registry.counter/gauge/"
                    "histogram) in jit-reachable or looped code")
+    example = """\
+def serve(batches, registry):
+    for b in batches:
+        c = registry.counter("dl4j_batches_total", "batches")  # JX008
+        c.inc()
+"""
 
     _FACTORY = ("counter", "gauge", "histogram")
     _REGISTRY_NAMES = ("metrics", "registry", "reg", "_reg", "_registry")
@@ -638,6 +712,14 @@ class HardcodedComputeDtypeRule(Rule):
     id = "JX009"
     description = ("hardcoded float32 literal / astype in nn/layers/ "
                    "forward code (defeats DtypePolicy compute dtype)")
+    example = """\
+import jax.numpy as jnp
+
+def forward(params, x):
+    h = x.astype(jnp.float32)   # JX009: pins the op to f32 under bf16 policy
+    return h @ params["W"]
+"""
+    example_path = "deeplearning4j_tpu/nn/layers/_example.py"
 
     def _in_promote_types(self, ctx, node) -> bool:
         for anc in ctx.ancestors(node):
@@ -694,6 +776,12 @@ class PallasOutsideKernelsRule(Rule):
     id = "JX010"
     description = ("direct pallas import / pl.pallas_call outside "
                    "kernels/ (bypasses the kernel registry)")
+    example = """\
+from jax.experimental import pallas as pl   # JX010: outside kernels/
+
+def fused(x):
+    return pl.pallas_call(_kernel, out_shape=x)(x)
+"""
 
     def check(self, ctx):
         rel = ctx.rel.replace("\\", "/")
@@ -748,6 +836,16 @@ class SyncStagingInFitLoopRule(Rule):
     description = ("synchronous stage_to_device/device_put in a fit/"
                    "dispatch hot path (staging belongs in "
                    "datasets/staging.py)")
+    example = """\
+from deeplearning4j_tpu.datasets.staging import stage_to_device
+
+class Net:
+    def fit(self, iterator):
+        for ds in iterator:
+            staged = stage_to_device(ds)   # JX011: device idles on the link
+            self._step(staged)
+"""
+    example_path = "deeplearning4j_tpu/nn/_example_engine.py"
 
     _SCALAR_CTORS = {"float32", "float64", "int32", "int64"}
 
@@ -826,6 +924,13 @@ class UnboundedBlockingIORule(Rule):
     description = ("blocking socket/HTTP call without an explicit timeout "
                    "in serving/ or parallel/ (one hung peer hangs the "
                    "fleet)")
+    example = """\
+from urllib.request import urlopen
+
+def scrape_peer(url):
+    return urlopen(url).read()   # JX012: blocks forever on a hung peer
+"""
+    example_path = "deeplearning4j_tpu/serving/_example.py"
 
     # callable name -> index of the positional timeout slot (a call with
     # more positionals than this has passed a timeout positionally)
@@ -904,6 +1009,13 @@ class TracePropagationRule(Rule):
     description = ("outbound HTTP in serving/ or parallel/ not forwarding "
                    "the X-DL4J-Trace context (breaks the cross-process "
                    "span tree)")
+    example = """\
+from urllib.request import urlopen
+
+def forward_request(url, body):
+    return urlopen(url, body, 5.0).read()   # JX013: hop drops the trace
+"""
+    example_path = "deeplearning4j_tpu/serving/_example.py"
 
     _OUTBOUND = {"urlopen", "Request", "HTTPConnection", "HTTPSConnection"}
     _REQUESTS_VERBS = {"get", "post", "put", "delete", "head", "patch",
@@ -988,6 +1100,13 @@ class DenseKVAllocationRule(Rule):
     description = ("dense full-length KV buffer (jnp.zeros sized by "
                    "decode_cache_length) allocated outside the paged "
                    "pool module")
+    example = """\
+import jax.numpy as jnp
+
+def init_cache(conf, slots, heads, dim):
+    return jnp.zeros(   # JX014: slots x capacity rows pinned regardless of depth
+        (slots, conf.decode_cache_length, heads, dim))
+"""
 
     _ALLOCS = {"zeros", "ones", "empty", "full"}
     _MODULES = {"jnp", "jax", "np", "numpy"}
@@ -1067,6 +1186,13 @@ class FrozenLeafTrainingRule(Rule):
     description = ("updater-state allocation or grad computation over "
                    "frozen/LoRA leaves outside nn/transfer.py + "
                    "nn/lora.py")
+    example = """\
+import jax
+
+def finetune_step(params, batch, loss_fn):
+    trainable = {k: v for k, v in params.items() if "__lora_" in k}
+    return jax.grad(loss_fn)(trainable, batch)   # JX015: hand-rolled seam
+"""
 
     _ALLOW = ("nn/transfer.py", "nn/lora.py")
     _GRAD_FNS = {"grad", "value_and_grad"}
@@ -1159,6 +1285,10 @@ class UnboundedLabelCardinalityRule(Rule):
     id = "JX016"
     description = ("metric .labels(...) fed from unbounded per-request "
                    "data (per-request series = cardinality explosion)")
+    example = """\
+def record(counter, request_id):
+    counter.labels(request=request_id).inc()   # JX016: one series per request
+"""
 
     _SUSPECT = {"request_id", "req_id", "prompt", "prompt_ids",
                 "trace_id", "span_id", "user_id", "session_id"}
@@ -1234,3 +1364,9 @@ class UnboundedLabelCardinalityRule(Rule):
                             "label with the exception CLASS or an "
                             "outcome enum and put the message in the "
                             "ledger/flight bundle")
+
+
+# The concurrency rules (JX017/JX018) live in their own module with the
+# interprocedural lock model; importing it here registers them so every
+# entry point that pulls in ALL_RULES sees the full rule set.
+from deeplearning4j_tpu.analysis import concurrency  # noqa: E402,F401

@@ -45,6 +45,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from deeplearning4j_tpu import observability as _obs
+from deeplearning4j_tpu.analysis.locktrace import named_lock
 from deeplearning4j_tpu.util.retry import Backoff
 
 #: Synthetic family reporting per-member scrape health in the federated
@@ -190,13 +191,14 @@ class FleetAggregator:
         #        "cursor": Optional[int]}}
         self._retention_events = max(16, int(retention_events))
         self._trace_state: Dict[str, Dict[str, Any]] = {}
-        self._trace_lock = threading.Lock()
+        self._trace_lock = named_lock("observability.federation.trace")
+        self._trace_inflight: set = set()  # wids being scraped right now
         # Persistent keep-alive connections, one per member netloc: a
         # scrape cycle is 2 GETs x N members — re-dialing TCP for each
         # is the dominant per-poll cost on loopback. Guarded by a lock
         # (http.client connections are not thread-safe).
         self._conns: Dict[str, Any] = {}
-        self._conn_lock = threading.Lock()
+        self._conn_lock = named_lock("observability.federation.conn")
         # One membership lookup serves a whole metrics+trace cycle.
         self._members_ttl_s = 0.5
         self._members_cache: Tuple[float, Dict[str, str]] = (0.0, {})
@@ -257,29 +259,40 @@ class FleetAggregator:
     def _scrape_text(self, url: str) -> str:
         """GET over a persistent per-member connection; one silent
         re-dial absorbs a server-side keep-alive close or a member
-        restart on the same address."""
+        restart on the same address. The connection is CHECKED OUT of
+        the pool for the request's duration: http.client connections
+        are not thread-safe, but holding the pool lock across the GET
+        serialized every member's scrape behind one socket (JX018).
+        Concurrent scrapes of the same netloc each dial their own
+        connection; check-in keeps the latest and closes the evicted
+        one (idle, by construction — a checked-out conn is not in the
+        pool)."""
         u = urllib.parse.urlsplit(url)
         path = u.path + (f"?{u.query}" if u.query else "")
-        with self._conn_lock:
-            for attempt in (0, 1):
-                conn = self._conns.get(u.netloc)
-                if conn is None:
-                    conn = http.client.HTTPConnection(
-                        u.hostname, u.port, timeout=self.scrape_timeout_s)
-                    self._conns[u.netloc] = conn
-                try:
-                    conn.request("GET", path)
-                    resp = conn.getresponse()
-                    body = resp.read()
-                    if resp.status != 200:
-                        raise OSError(f"HTTP {resp.status} from {url}")
-                    return body.decode("utf-8")
-                except Exception:
-                    conn.close()
-                    self._conns.pop(u.netloc, None)
-                    if attempt:
-                        raise
-            raise OSError(f"unreachable: {url}")  # not reached
+        for attempt in (0, 1):
+            with self._conn_lock:
+                conn = self._conns.pop(u.netloc, None)
+            if conn is None:
+                conn = http.client.HTTPConnection(
+                    u.hostname, u.port, timeout=self.scrape_timeout_s)
+            try:
+                conn.request("GET", path)
+                resp = conn.getresponse()
+                body = resp.read()
+                if resp.status != 200:
+                    raise OSError(f"HTTP {resp.status} from {url}")
+            except Exception:
+                conn.close()
+                if attempt:
+                    raise
+                continue
+            with self._conn_lock:
+                evicted = self._conns.get(u.netloc)
+                self._conns[u.netloc] = conn
+            if evicted is not None:
+                evicted.close()
+            return body.decode("utf-8")
+        raise OSError(f"unreachable: {url}")  # not reached
 
     def federate_metrics(self) -> str:
         """One fleet-wide Prometheus exposition: every member's families
@@ -339,14 +352,29 @@ class FleetAggregator:
         st["cursor"] = seq
 
     def _scrape_trace(self, wid: str, base: str) -> None:
-        st = self._trace_state.get(wid)
-        cursor = st["cursor"] if st else None
-        url = base + "/api/trace"
-        if cursor is not None:
-            url += f"?since={cursor}"
-        doc = json.loads(self._scrape_text(url))
-        if isinstance(doc, dict):
-            self._ingest_trace(wid, doc)
+        """Incremental trace scrape of one member. The HTTP GET runs
+        with `_trace_lock` RELEASED (JX018: holding it across member
+        I/O stalled every concurrent /api/trace poll); the per-wid
+        in-flight marker keeps the cursor-read -> scrape -> ingest
+        cycle single-flight, so two concurrent polls can't both fetch
+        `?since=<cursor>` and ingest the same delta twice."""
+        with self._trace_lock:
+            if wid in self._trace_inflight:
+                return  # another poll is already fetching this member
+            self._trace_inflight.add(wid)
+            st = self._trace_state.get(wid)
+            cursor = st["cursor"] if st else None
+        try:
+            url = base + "/api/trace"
+            if cursor is not None:
+                url += f"?since={cursor}"
+            doc = json.loads(self._scrape_text(url))
+            if isinstance(doc, dict):
+                with self._trace_lock:
+                    self._ingest_trace(wid, doc)
+        finally:
+            with self._trace_lock:
+                self._trace_inflight.discard(wid)
 
     def federate_trace(self) -> Dict[str, Any]:
         """One fleet-wide Chrome trace on one wall-clock timeline (``ts``
@@ -364,11 +392,14 @@ class FleetAggregator:
                     self.local_worker_id,
                     self._tracer.export_chrome(
                         since=st["cursor"] if st else None))
-            for wid, base in self.members().items():
-                try:
-                    self._scrape_trace(wid, base)
-                except Exception:
-                    continue
+        # Membership RPC + member scrapes run without the trace lock:
+        # only the state reads/merges above and below hold it.
+        for wid, base in self.members().items():
+            try:
+                self._scrape_trace(wid, base)
+            except Exception:
+                continue
+        with self._trace_lock:
             meta: List[dict] = []
             events: List[dict] = []
             for wid, st in self._trace_state.items():

@@ -47,6 +47,8 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional
 
+from deeplearning4j_tpu.analysis.locktrace import named_lock
+
 
 def _env_flag(name: str, default: str = "1") -> bool:
     return os.environ.get(name, default).lower() not in ("0", "false", "off")
@@ -209,7 +211,7 @@ class RequestLedger:
         self._spool_every = (0 if sample <= 0.0
                              else max(1, int(round(1.0 / min(1.0, sample)))))
         self._ring: deque = deque(maxlen=max(16, int(capacity)))
-        self._lock = threading.Lock()
+        self._lock = named_lock("observability.ledger")
         self._closed = 0
         self._spool_file = None
         self._tenants: Dict[tuple, Dict[str, Any]] = {}

@@ -35,6 +35,7 @@ import weakref
 from typing import Any, Dict, Optional
 
 from deeplearning4j_tpu import observability as _obs
+from deeplearning4j_tpu.analysis.locktrace import named_lock
 
 # Byte categories reported by XLA's CompiledMemoryStats -> gauge `kind`.
 _STAT_KINDS = (
@@ -52,7 +53,7 @@ _M_PROGRAM_HBM = _obs.metrics.gauge(
     "(aliased bytes counted once)",
     label_names=("program", "kind"))
 
-_lock = threading.Lock()
+_lock = named_lock("observability.memory")
 _programs: Dict[str, Dict[str, Any]] = {}   # label -> {bytes, net_ref}
 _trees: Dict[str, Any] = {}                 # name -> weakref to a net
 

@@ -27,6 +27,8 @@ import re
 import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from deeplearning4j_tpu.analysis.locktrace import named_rlock
+
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
 
@@ -220,7 +222,7 @@ class MetricsRegistry:
 
     def __init__(self, enabled: bool = True):
         self._enabled = bool(enabled)
-        self._lock = threading.RLock()
+        self._lock = named_rlock("observability.metrics")
         self._families: Dict[str, _Family] = {}
         self._collectors: List[Callable[["MetricsRegistry"], None]] = []
 

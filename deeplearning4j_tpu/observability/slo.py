@@ -45,6 +45,8 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
+from deeplearning4j_tpu.analysis.locktrace import named_lock
+
 #: (severity, short_window_s, long_window_s, burn_threshold) — an alert
 #: fires when burn exceeds the threshold over BOTH windows.
 BURN_WINDOWS: Tuple[Tuple[str, float, float, float], ...] = (
@@ -215,7 +217,7 @@ class BurnRateEngine:
         # {worker_id: deque[(t, {objective: (bad, total)})]}
         self._rings: Dict[str, deque] = {}
         self._paging: set = set()
-        self._lock = threading.Lock()
+        self._lock = named_lock("observability.slo")
 
     # ------------------------------------------------------------- ingest
 

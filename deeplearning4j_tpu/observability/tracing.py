@@ -32,6 +32,7 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional
 
+from deeplearning4j_tpu.analysis.locktrace import named_lock
 from deeplearning4j_tpu.observability import propagate as _prop
 
 
@@ -141,7 +142,7 @@ class Tracer:
             max_events = int(os.environ.get("DL4J_TPU_TRACE_BUFFER", "16384"))
         self.enabled = bool(enabled)
         self._events: deque = deque(maxlen=max(16, int(max_events)))
-        self._lock = threading.Lock()
+        self._lock = named_lock("observability.tracing")
         # Monotonic count of every event EVER recorded (not just the ones
         # still in the ring): the federation layer's incremental-export
         # cursor. The oldest ring entry's sequence number is always

@@ -43,6 +43,7 @@ import urllib.error
 from typing import Any, Callable, Dict, List, Optional
 
 from deeplearning4j_tpu import observability as _obs
+from deeplearning4j_tpu.analysis.locktrace import named_condition
 from deeplearning4j_tpu.observability import fleet as _fev
 from deeplearning4j_tpu.parallel.coordinator import (
     HEARTBEAT_S,
@@ -104,7 +105,7 @@ class ReplicaServer:
             self.server.add_model(self.server.default_model, path=path)
         self.server.fleet_replica = self
         self.client: Optional[CoordinatorClient] = None
-        self._cond = threading.Condition()
+        self._cond = named_condition("serving.fleet")
         self._request_n = 0
         self._inflight = 0
         self._hang_until = 0.0

@@ -19,6 +19,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from deeplearning4j_tpu.analysis.locktrace import named_rlock
 from deeplearning4j_tpu.serving import metrics as _m
 from deeplearning4j_tpu.serving.errors import (
     ModelNotFoundError,
@@ -250,7 +251,7 @@ class ModelHost:
         self.hbm_budget_bytes = hbm_budget_bytes
         self.on_load = on_load      # server attaches batcher/scheduler here
         self.on_evict = on_evict
-        self._lock = threading.RLock()
+        self._lock = named_rlock("serving.host")
         self._models: Dict[str, ServedModel] = {}
         _m.MODELS_RESIDENT.set_function(
             lambda: sum(1 for m in self._models.values() if m.resident))
@@ -275,7 +276,8 @@ class ModelHost:
             _m.MODEL_DTYPE.labels(model=name, dtype=model.dtype).set(1)
             if model.net is not None and self.on_load is not None:
                 self.on_load(model)
-            self._enforce_budget(keep=model)
+            stoppables = self._enforce_budget(keep=model)
+        self._stop_runtimes(stoppables)
         return model
 
     def names(self) -> List[str]:
@@ -326,59 +328,77 @@ class ModelHost:
             with self._lock:
                 model.loading = False
             raise
-        with self._lock:
-            try:
-                model.net = net
-                model.hbm_bytes = estimate_hbm_bytes(net)
-                _measure_hbm(model)
-                model.dtype = model_dtype(net=net)
-                _m.MODEL_HBM_BYTES.labels(model=model.name).set(
-                    model.hbm_bytes)
-                _m.MODEL_DTYPE.labels(model=model.name,
-                                      dtype=model.dtype).set(1)
-                if self.on_load is not None:
-                    self.on_load(model)
-                self._enforce_budget(keep=model)
-            except Exception:
-                # Publish failed (on_load hook, budget enforcement, ...):
-                # roll back to the evicted state so the next get() retries
-                # the load — a model stuck with loading=True would 503
-                # forever with no recovery path.
+        stoppables: List = []
+        try:
+            with self._lock:
                 try:
-                    self._evict(model)
+                    model.net = net
+                    model.hbm_bytes = estimate_hbm_bytes(net)
+                    _measure_hbm(model)
+                    model.dtype = model_dtype(net=net)
+                    _m.MODEL_HBM_BYTES.labels(model=model.name).set(
+                        model.hbm_bytes)
+                    _m.MODEL_DTYPE.labels(model=model.name,
+                                          dtype=model.dtype).set(1)
+                    if self.on_load is not None:
+                        self.on_load(model)
+                    stoppables = self._enforce_budget(keep=model)
                 except Exception:
-                    model.net = None
-                    model.ready.clear()
-                raise
-            finally:
-                model.loading = False
+                    # Publish failed (on_load hook, budget enforcement,
+                    # ...): roll back to the evicted state so the next
+                    # get() retries the load — a model stuck with
+                    # loading=True would 503 forever with no recovery
+                    # path.
+                    try:
+                        stoppables.extend(self._evict(model))
+                    except Exception:
+                        model.net = None
+                        model.ready.clear()
+                    raise
+                finally:
+                    model.loading = False
+        finally:
+            # Worker joins happen with the lock RELEASED: an eviction
+            # drain must never stall snapshot()/get() on other models.
+            self._stop_runtimes(stoppables)
 
     def resident_bytes(self) -> int:
         with self._lock:
             return sum(m.hbm_bytes for m in self._models.values()
                        if m.resident)
 
-    def _enforce_budget(self, keep: Optional[ServedModel] = None) -> None:
+    def _enforce_budget(self, keep: Optional[ServedModel] = None) -> List:
         """Evict LRU unpinned resident models until under budget. `keep`
         (the model just loaded) is never evicted — a budget smaller than
-        one model still serves that model."""
+        one model still serves that model. Returns the victims' detached
+        runtimes for the caller to stop off-lock."""
+        stoppables: List = []
         if self.hbm_budget_bytes is None:
-            return
+            return stoppables
         while True:
             victims = [m for m in self._models.values()
                        if m.resident and not m.pinned and m is not keep]
             if (sum(m.hbm_bytes for m in self._models.values()
                     if m.resident) <= self.hbm_budget_bytes or not victims):
-                return
-            self._evict(min(victims, key=lambda m: m.last_used))
+                return stoppables
+            stoppables.extend(
+                self._evict(min(victims, key=lambda m: m.last_used)))
 
-    def _evict(self, model: ServedModel) -> None:
+    def _evict(self, model: ServedModel) -> List:
+        """Evict under the host lock, but DETACH the batcher/scheduler
+        instead of stopping them: `stop()` joins worker threads, and a
+        join under `_lock` blocks every `get()`/`snapshot()` for the
+        drain duration (JX018). Callers stop the returned runtimes after
+        releasing the lock; a detached runtime drains its queue exactly
+        as before, it just can't admit new work (the model is no longer
+        resolvable to it)."""
+        stoppables: List = []
         model.ready.clear()
         if model.batcher is not None:
-            model.batcher.stop()
+            stoppables.append(model.batcher)
             model.batcher = None
         if model.scheduler is not None:
-            model.scheduler.stop()
+            stoppables.append(model.scheduler)
             model.scheduler = None
         if self.on_evict is not None:
             self.on_evict(model)
@@ -399,6 +419,17 @@ class ModelHost:
         model.hbm_source = "estimated"
         model.hbm_bytes = (estimate_checkpoint_bytes(model.path)
                            if model.path else 0)
+        return stoppables
+
+    @staticmethod
+    def _stop_runtimes(stoppables: List) -> None:
+        """Join detached batcher/scheduler workers — called with the host
+        lock RELEASED so serving other models never waits on a drain."""
+        for runtime in stoppables:
+            try:
+                runtime.stop()
+            except Exception:
+                pass
 
     # ---------------------------------------------------------- introspect
 
@@ -423,8 +454,8 @@ class ModelHost:
     def stop(self) -> None:
         _m.MODELS_RESIDENT.set_function(None)
         with self._lock:
-            for m in self._models.values():
-                if m.batcher is not None:
-                    m.batcher.stop()
-                if m.scheduler is not None:
-                    m.scheduler.stop()
+            runtimes = [r for m in self._models.values()
+                        for r in (m.batcher, m.scheduler) if r is not None]
+        # Joins off-lock: shutdown of one model's workers must not block a
+        # concurrent snapshot()/names() poll (JX018).
+        self._stop_runtimes(runtimes)

@@ -53,6 +53,7 @@ from urllib.parse import parse_qs, urlparse
 import numpy as np
 
 from deeplearning4j_tpu import observability as _obs
+from deeplearning4j_tpu.analysis.locktrace import named_condition, named_lock
 from deeplearning4j_tpu.observability import fleet as _fev
 from deeplearning4j_tpu.observability import propagate as _prop
 from deeplearning4j_tpu.parallel.coordinator import CoordinatorClient
@@ -239,7 +240,7 @@ class FleetRouter:
             # The poll loop already retries every poll_interval_s; per-RPC
             # retries would only stall it (and the shed-path refresh).
             backoff=Backoff(base_s=0.05, max_s=0.1, tries=1))
-        self._lock = threading.Lock()
+        self._lock = named_lock("serving.router.table")
         self._table: Dict[str, ReplicaInfo] = {}
         # Outstanding requests per worker_id. Lives OUTSIDE the per-poll
         # ReplicaInfo snapshots: a request that spans a table rebuild must
@@ -247,7 +248,12 @@ class FleetRouter:
         # _pick's load score forever.
         self._inflight: Dict[str, int] = {}
         self._quarantine: Dict[str, float] = {}
-        self._refresh_lock = threading.Lock()  # single-flight shed refresh
+        # Single-flight shed refresh: one leader does the coordinator RPC
+        # with NO lock held; followers wait on the condition for the
+        # generation bump (holding a lock across the RPC was JX018 — it
+        # serialized every about-to-shed request behind network I/O).
+        self._refresh_cond = named_condition("serving.router.refresh")
+        self._refreshing = False
         self._refresh_gen = 0
         self._lost_after_s = 15.0
         self._dead_total = 0
@@ -263,7 +269,7 @@ class FleetRouter:
         self._slo_objectives = slo_objectives
         self.slo_window_scale = float(slo_window_scale)
         self._slo_engine = None
-        self._slo_lock = threading.Lock()
+        self._slo_lock = named_lock("serving.router.slo")
 
     # ----------------------------------------------------------- federation
 
@@ -490,15 +496,25 @@ class FleetRouter:
         """Shed-path refresh: membership only, single-flight. Concurrent
         shedding requests share one coordinator RPC — a saturated fleet
         must not dogpile the coordinator (or re-scrape every replica's
-        /metrics) once per about-to-shed request."""
-        gen = self._refresh_gen
-        with self._refresh_lock:
-            if self._refresh_gen != gen:
-                return  # another request just refreshed; reuse its table
-            try:
-                self._refresh_membership()
-            finally:
+        /metrics) once per about-to-shed request. The RPC runs with no
+        lock held: the first caller becomes the leader, everyone who
+        arrives while it is in flight waits on the condition for the
+        generation bump and reuses the leader's table."""
+        with self._refresh_cond:
+            if self._refreshing:
+                gen = self._refresh_gen
+                self._refresh_cond.wait_for(
+                    lambda: self._refresh_gen != gen,
+                    timeout=max(1.0, 2.0 * self.scrape_timeout_s))
+                return
+            self._refreshing = True
+        try:
+            self._refresh_membership()
+        finally:
+            with self._refresh_cond:
                 self._refresh_gen += 1
+                self._refreshing = False
+                self._refresh_cond.notify_all()
 
     def table(self) -> List[Dict[str, Any]]:
         with self._lock:

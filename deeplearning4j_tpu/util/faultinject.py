@@ -53,6 +53,13 @@ and reproducible):
 - ``slow_decode``      — every subsequent request on the replica gains
                          ``ms`` of latency (models decode slowdown; the
                          least-loaded policy should shift traffic away).
+- ``lock_invert``      — runs `analysis.locktrace.lock_inversion_drill`:
+                         two threads forced into AB/BA lock acquisition
+                         for up to ``seconds``. Requires
+                         ``DL4J_TPU_LOCKTRACE=1``; asserts-by-effect
+                         that the tracer flags the order cycle and the
+                         stall watchdog dumps exactly one flight bundle
+                         (drill results land in ``fault.args["result"]``).
 
 ``worker`` omitted means "fires on every worker". Each fault fires at
 most once per process (fire-once), so a restarted worker replaying steps
@@ -76,7 +83,8 @@ from typing import Any, Callable, Dict, List, Optional
 ENV_KNOB = "DL4J_TPU_FAULT_PLAN"
 
 KINDS = ("kill", "preempt", "hang_coordinator", "truncate_chunk",
-         "delay_h2d", "kill_replica", "hang_replica", "slow_decode")
+         "delay_h2d", "kill_replica", "hang_replica", "slow_decode",
+         "lock_invert")
 
 
 @dataclass
@@ -168,6 +176,15 @@ class FaultPlan:
                 time.sleep(float(fault.args.get("ms", 100)) / 1000.0)
             elif fault.kind == "hang_replica":
                 time.sleep(float(fault.args.get("seconds", 1.0)))
+            elif fault.kind == "lock_invert":
+                # Two-thread AB/BA acquisition drill: proves the lock
+                # tracer flags the cycle and the stall watchdog dumps
+                # its one flight bundle (requires DL4J_TPU_LOCKTRACE=1).
+                from deeplearning4j_tpu.analysis import locktrace
+
+                fault.args["result"] = locktrace.lock_inversion_drill(
+                    acquire_timeout_s=float(
+                        fault.args.get("seconds", 2.0)))
             # hang_coordinator / truncate_chunk without a handler: recorded
             # as fired, no action (the injection point lacks the object).
         return fired

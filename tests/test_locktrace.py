@@ -1,0 +1,312 @@
+"""Runtime lock tracer tests (analysis/locktrace.py).
+
+Three layers:
+- TracedLock mechanics: held stacks, acquisition-order edges, online
+  AB/BA cycle detection, the factory's disabled fast path;
+- the chaos drill (`faultinject` kind ``lock_invert``): a real bounded
+  AB/BA deadlock must flag the cycle AND produce exactly one flight
+  bundle whose ``locks.json`` reads the deadlock off one file;
+- regressions for the JX018 lock-narrowing fixes in serving/host.py
+  (eviction joins off-lock) and serving/router.py (single-flight
+  membership refresh with no lock held across the RPC).
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from deeplearning4j_tpu.analysis import locktrace
+from deeplearning4j_tpu.analysis.locktrace import (
+    ENV_ENABLE, ENV_STALL_S, STALL_REASON, TracedLock,
+    named_condition, named_lock, named_rlock,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    locktrace.reset()
+    yield
+    locktrace.reset()
+
+
+# ------------------------------------------------------------- mechanics
+
+
+class TestTracedLockMechanics:
+    def test_nested_acquire_records_edge(self):
+        a, b = TracedLock("t.a"), TracedLock("t.b")
+        with a:
+            with b:
+                pass
+        s = locktrace.stats()
+        assert s["edges"] == 1 and s["cycles_total"] == 0
+        doc = locktrace.snapshot()
+        assert {"from": "t.a", "to": "t.b", "count": 1} in doc["edges"]
+
+    def test_opposite_orders_flag_cycle_at_attempt(self):
+        # The SAME thread taking AB then BA proves detection is at
+        # acquire *start* — no interleave or deadlock needed.
+        a, b = TracedLock("t.a"), TracedLock("t.b")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        s = locktrace.stats()
+        assert s["cycles_total"] == 1
+        assert "t.a -> t.b -> t.a" in locktrace.snapshot()["cycles"][0] \
+            or "t.b -> t.a -> t.b" in locktrace.snapshot()["cycles"][0]
+
+    def test_reentrant_rlock_records_no_self_edge(self):
+        r = TracedLock("t.r", threading.RLock())
+        with r:
+            with r:
+                pass
+        assert locktrace.stats()["edges"] == 0
+
+    def test_release_pops_held_stack(self):
+        a = TracedLock("t.a")
+        with a:
+            doc = locktrace.snapshot()
+            me = [t for t in doc["threads"]
+                  if t["ident"] == threading.get_ident()]
+            assert me and [h["lock"] for h in me[0]["held"]] == ["t.a"]
+        doc = locktrace.snapshot()
+        me = [t for t in doc["threads"]
+              if t["ident"] == threading.get_ident()]
+        assert me and me[0]["held"] == []
+
+    def test_condition_protocol_wait_restores_held(self):
+        cond = threading.Condition(TracedLock("t.cond"))
+        with cond:
+            cond.wait(timeout=0.01)  # _release_save/_acquire_restore
+            doc = locktrace.snapshot()
+            me = [t for t in doc["threads"]
+                  if t["ident"] == threading.get_ident()]
+            assert [h["lock"] for h in me[0]["held"]] == ["t.cond"]
+        me = [t for t in locktrace.snapshot()["threads"]
+              if t["ident"] == threading.get_ident()]
+        assert me[0]["held"] == []
+
+
+class TestFactory:
+    def test_disabled_returns_plain_primitives(self, monkeypatch):
+        monkeypatch.delenv(ENV_ENABLE, raising=False)
+        assert not isinstance(named_lock("x"), TracedLock)
+        assert not isinstance(named_rlock("x"), TracedLock)
+        cond = named_condition("x")
+        assert not isinstance(cond._lock, TracedLock)
+
+    def test_enabled_returns_traced(self, monkeypatch):
+        monkeypatch.setenv(ENV_ENABLE, "1")
+        lk = named_lock("x")
+        assert isinstance(lk, TracedLock) and lk.name == "x"
+        cond = named_condition("y")
+        assert isinstance(cond._lock, TracedLock)
+
+    def test_drill_requires_tracer(self, monkeypatch):
+        monkeypatch.delenv(ENV_ENABLE, raising=False)
+        with pytest.raises(RuntimeError):
+            locktrace.lock_inversion_drill()
+
+
+# ---------------------------------------------------------- chaos drill
+
+
+def _arm_flight(monkeypatch, tmp_path):
+    """Point the flight recorder at tmp_path and clear the lock_stall
+    rate-limit stamp so this test's stall is 'first' again."""
+    from deeplearning4j_tpu.observability.flight import recorder
+
+    monkeypatch.setattr(recorder, "dump_dir", str(tmp_path))
+    recorder._last_dump_at.pop(STALL_REASON, None)
+    return recorder
+
+
+class TestInversionDrill:
+    def test_drill_flags_cycle_and_dumps_one_bundle(self, monkeypatch,
+                                                    tmp_path):
+        monkeypatch.setenv(ENV_ENABLE, "1")
+        monkeypatch.setenv(ENV_STALL_S, "0.25")
+        _arm_flight(monkeypatch, tmp_path)
+
+        from deeplearning4j_tpu.util.faultinject import FaultPlan
+
+        # 2s deadlock window: the watchdog may be mid-way through a stale
+        # 1s tick (computed from the default 30s threshold) when the env
+        # shrinks it — the stall must outlive one full stale tick.
+        plan = FaultPlan.from_json(json.dumps(
+            [{"kind": "lock_invert", "step": 3, "worker": 0,
+              "seconds": 2.0}]))
+        assert plan.maybe_fire(2, 0) == []          # wrong step: no fire
+        fired = plan.maybe_fire(3, 0)
+        assert len(fired) == 1
+        res = fired[0].args["result"]
+
+        assert res["cycle_flagged"], res
+        assert res["stall_dumps"] == 1, res         # exactly one bundle
+        assert res["bundle"] and os.path.isdir(res["bundle"])
+        bundles = [d for d in os.listdir(str(tmp_path))
+                   if os.path.isdir(os.path.join(str(tmp_path), d))]
+        assert len(bundles) == 1
+
+        with open(os.path.join(res["bundle"], "locks.json")) as f:
+            doc = json.load(f)
+        assert doc["format"] == 1
+        assert doc["cycles_total"] >= 1 and doc["cycles"]
+        assert {"from": "drill.a", "to": "drill.b", "count": 1} \
+            in doc["edges"]
+        assert {"from": "drill.b", "to": "drill.a", "count": 1} \
+            in doc["edges"]
+        assert doc["stall"]["kind"] in ("acquire_blocked", "held_too_long")
+        # every thread row carries a readable stack; the drill threads'
+        # held/waiting state was captured mid-deadlock
+        assert doc["threads"]
+        assert all(t["stack"] for t in doc["threads"])
+
+        # fire-once: replaying the same step injects nothing
+        assert plan.maybe_fire(3, 0) == []
+
+    def test_second_stall_in_window_is_rate_limited(self, monkeypatch,
+                                                    tmp_path):
+        monkeypatch.setenv(ENV_ENABLE, "1")
+        monkeypatch.setenv(ENV_STALL_S, "0.25")
+        recorder = _arm_flight(monkeypatch, tmp_path)
+
+        res1 = locktrace.lock_inversion_drill(acquire_timeout_s=2.0)
+        assert res1["stall_dumps"] == 1
+        # Within the recorder's min_interval_s window a second stall
+        # episode re-detects but must NOT produce a second bundle.
+        assert recorder.min_interval_s > 2.0
+        res2 = locktrace.lock_inversion_drill(acquire_timeout_s=0.6,
+                                              settle_s=0.6)
+        assert res2["stall_dumps"] == 0
+        bundles = [d for d in os.listdir(str(tmp_path))
+                   if os.path.isdir(os.path.join(str(tmp_path), d))]
+        assert len(bundles) == 1
+
+
+# ------------------------------------------- JX018 fix regressions
+
+
+class _BlockingRuntime:
+    """A batcher/scheduler stand-in whose stop() blocks until released —
+    models a drain that takes a while."""
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.stopped = threading.Event()
+
+    def stop(self):
+        self.release.wait(timeout=10.0)
+        self.stopped.set()
+
+
+class TestHostEvictionOffLock:
+    def test_snapshot_not_blocked_by_slow_eviction_drain(self):
+        """serving/host.py JX018 fix: stop() joins workers with the host
+        lock RELEASED, so snapshot()/names() stay responsive during a
+        slow drain."""
+        import numpy as np
+
+        from deeplearning4j_tpu.serving.host import ModelHost
+
+        class _Net:
+            params_tree = {"w": np.zeros((4, 4), np.float32)}
+
+        host = ModelHost()
+        model = host.add("m", net=_Net())
+        runtime = _BlockingRuntime()
+        model.batcher = runtime
+
+        t = threading.Thread(target=host.stop, daemon=True)
+        t.start()
+        # the drain is in progress (stop() blocked on the runtime)...
+        assert runtime.release.wait(timeout=0) is False
+        time.sleep(0.05)
+        t0 = time.monotonic()
+        snap = host.snapshot()                     # ...must not wait on it
+        names = host.names()
+        elapsed = time.monotonic() - t0
+        assert elapsed < 1.0, f"snapshot blocked {elapsed:.2f}s behind stop"
+        assert names == ["m"] and snap[0]["name"] == "m"
+        runtime.release.set()
+        t.join(timeout=10.0)
+        assert runtime.stopped.is_set()
+
+    def test_evict_detaches_runtimes_for_off_lock_stop(self):
+        import numpy as np
+
+        from deeplearning4j_tpu.serving.host import ModelHost
+
+        class _Net:
+            params_tree = {"w": np.zeros((4, 4), np.float32)}
+
+        host = ModelHost()
+        model = host.add("m", net=_Net())
+        runtime = _BlockingRuntime()
+        model.batcher = runtime
+        with host._lock:
+            stoppables = host._evict(model)
+        # detached, not stopped: stopping is the caller's off-lock job
+        assert stoppables == [runtime]
+        assert model.batcher is None
+        assert not runtime.stopped.is_set()
+        runtime.release.set()
+        host._stop_runtimes(stoppables)
+        assert runtime.stopped.is_set()
+        host.stop()
+
+
+class TestRouterSingleFlightRefresh:
+    def _router(self):
+        from deeplearning4j_tpu.serving.router import FleetRouter
+
+        return FleetRouter("127.0.0.1:1", http=False)
+
+    def test_concurrent_shed_refreshes_share_one_rpc(self):
+        """serving/router.py JX018 fix: N concurrent shed-path refreshes
+        make ONE coordinator RPC, with no router lock held across it —
+        table() stays responsive while the RPC is in flight."""
+        router = self._router()
+        calls = []
+        in_rpc = threading.Event()
+        release = threading.Event()
+
+        def slow_status():
+            calls.append(1)
+            in_rpc.set()
+            release.wait(timeout=10.0)
+            return {"members": [], "detail": {}}
+
+        router._client.status = slow_status
+        threads = [threading.Thread(
+            target=router._refresh_membership_shared, daemon=True)
+            for _ in range(6)]
+        for t in threads:
+            t.start()
+        assert in_rpc.wait(timeout=5.0)
+        time.sleep(0.05)  # let the followers reach the condition wait
+        t0 = time.monotonic()
+        assert router.table() == []            # not serialized behind RPC
+        assert time.monotonic() - t0 < 1.0
+        release.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        assert not any(t.is_alive() for t in threads)
+        assert len(calls) == 1, f"dogpile: {len(calls)} coordinator RPCs"
+
+    def test_follower_timeout_does_not_hang(self):
+        """A leader that dies mid-RPC must not strand followers: the
+        condition wait is bounded by 2x the scrape timeout."""
+        router = self._router()
+        with router._refresh_cond:
+            router._refreshing = True              # a leader that vanished
+        t0 = time.monotonic()
+        router._refresh_membership_shared()
+        elapsed = time.monotonic() - t0
+        assert elapsed < 10.0                       # bounded, no deadlock

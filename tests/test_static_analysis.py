@@ -978,12 +978,300 @@ def count(counter, model, adapter, reason, outcome_of, fn):
 
 # ------------------------------------------------------------ framework
 
+# --------------------------------------------------------------- JX017
+
+class TestJX017LockOrderInversion:
+    def test_opposite_with_nesting_fires(self):
+        src = """
+import threading
+
+class Transfer:
+    def __init__(self):
+        self._src = threading.Lock()
+        self._dst = threading.Lock()
+
+    def push(self):
+        with self._src:
+            with self._dst:
+                pass
+
+    def pull(self):
+        with self._dst:
+            with self._src:
+                pass
+"""
+        fs = lint(src, ["JX017"])
+        assert rules_of(fs) == {"JX017"}
+        assert len(fs) == 1  # one cycle, reported once
+        assert "Transfer._src" in fs[0].message
+        assert "Transfer._dst" in fs[0].message
+        assert "push" in fs[0].message and "pull" in fs[0].message
+
+    def test_inversion_through_callee_fires(self):
+        # push takes src then dst directly; pull holds dst and CALLS a
+        # helper that takes src — the cycle only exists interprocedurally
+        src = """
+import threading
+
+class Transfer:
+    def __init__(self):
+        self._src = threading.Lock()
+        self._dst = threading.Lock()
+
+    def _grab_src(self):
+        with self._src:
+            pass
+
+    def push(self):
+        with self._src:
+            with self._dst:
+                pass
+
+    def pull(self):
+        with self._dst:
+            self._grab_src()
+"""
+        fs = lint(src, ["JX017"])
+        assert rules_of(fs) == {"JX017"}
+        assert "_grab_src" in fs[0].message
+
+    def test_consistent_order_is_clean(self):
+        src = """
+import threading
+
+class Transfer:
+    def __init__(self):
+        self._src = threading.Lock()
+        self._dst = threading.Lock()
+
+    def push(self):
+        with self._src:
+            with self._dst:
+                pass
+
+    def pull(self):
+        with self._src:
+            with self._dst:
+                pass
+"""
+        assert lint(src, ["JX017"]) == []
+
+    def test_reentrant_same_lock_is_clean(self):
+        src = """
+import threading
+
+class Host:
+    def __init__(self):
+        self._lock = threading.RLock()
+
+    def outer(self):
+        with self._lock:
+            self.inner()
+
+    def inner(self):
+        with self._lock:
+            pass
+"""
+        assert lint(src, ["JX017"]) == []
+
+
+# --------------------------------------------------------------- JX018
+
+class TestJX018BlockingUnderLock:
+    def test_sleep_under_lock_fires(self):
+        src = """
+import threading
+import time
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def refresh(self):
+        with self._lock:
+            time.sleep(1.0)
+"""
+        fs = lint(src, ["JX018"])
+        assert rules_of(fs) == {"JX018"}
+        assert fs[0].severity == "warning"
+        assert "Registry._lock" in fs[0].message
+
+    def test_http_through_callee_fires(self):
+        # the blocking call is in a helper: only the closure sees it
+        src = """
+import threading
+from urllib.request import urlopen
+
+class Router:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def _fetch(self, url):
+        return urlopen(url, timeout=2.0).read()
+
+    def refresh(self, url):
+        with self._lock:
+            self._fetch(url)
+"""
+        fs = lint(src, ["JX018"])
+        assert rules_of(fs) == {"JX018"}
+        assert "network I/O" in fs[0].message
+
+    def test_join_and_queue_get_under_lock_fire(self):
+        src = """
+import threading
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def drain(self, worker, task_queue):
+        with self._lock:
+            worker.join()
+            task_queue.get()
+"""
+        fs = lint(src, ["JX018"])
+        cats = {f.message.split(" while holding")[0] for f in fs}
+        assert cats == {"thread join", "queue wait"}
+
+    def test_snapshot_then_work_outside_is_clean(self):
+        # the fixed shape: snapshot under the lock, block outside it
+        src = """
+import threading
+import time
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+
+    def refresh(self):
+        with self._lock:
+            items = dict(self._items)
+        time.sleep(1.0)
+        with self._lock:
+            self._items.update(items)
+"""
+        assert lint(src, ["JX018"]) == []
+
+    def test_wait_on_own_condition_is_exempt(self):
+        # with self._cond: self._cond.wait() is the one legal block
+        src = """
+import threading
+
+class Queue:
+    def __init__(self):
+        self._cond = threading.Condition()
+
+    def pop(self):
+        with self._cond:
+            self._cond.wait()
+"""
+        assert lint(src, ["JX018"]) == []
+
+    def test_unbounded_wait_on_foreign_event_fires(self):
+        src = """
+import threading
+
+class Loader:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def block_on(self, event):
+        with self._lock:
+            event.wait()
+"""
+        fs = lint(src, ["JX018"])
+        assert rules_of(fs) == {"JX018"}
+        assert "blocking wait" in fs[0].message
+
+    def test_named_lock_factory_is_discovered(self):
+        # adopting the runtime tracer must not blind the static tier
+        src = """
+import time
+from deeplearning4j_tpu.analysis.locktrace import named_lock
+
+class Registry:
+    def __init__(self):
+        self._lock = named_lock("registry")
+
+    def refresh(self):
+        with self._lock:
+            time.sleep(1.0)
+"""
+        fs = lint(src, ["JX018"])
+        assert rules_of(fs) == {"JX018"}
+
+
+class TestConcurrencyCLI:
+    def test_graph_cli_reports_cycle_and_exits_nonzero(self, tmp_path):
+        bad = tmp_path / "transfer.py"
+        bad.write_text(ALL_RULES["JX017"].example)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-m", "deeplearning4j_tpu.analysis.concurrency",
+             str(bad)],
+            capture_output=True, text=True, env=env, timeout=300)
+        assert proc.returncode == 1
+        assert "cycles (JX017):" in proc.stdout
+
+    def test_graph_cli_dot_output(self, tmp_path):
+        bad = tmp_path / "transfer.py"
+        bad.write_text(ALL_RULES["JX017"].example)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-m", "deeplearning4j_tpu.analysis.concurrency",
+             "--dot", str(bad)],
+            capture_output=True, text=True, env=env, timeout=300)
+        assert proc.stdout.startswith("digraph lock_order {")
+        assert 'color="red"' in proc.stdout  # the cycle is highlighted
+
+    def test_package_graph_is_cycle_free(self):
+        """The repo's own lock-order graph must stay acyclic — the
+        in-process twin of the JX017 tier-1 gate."""
+        from deeplearning4j_tpu.analysis.concurrency import package_graph
+
+        _edges, cycles, kinds = package_graph()
+        assert kinds, "lock discovery found nothing — model regressed"
+        assert cycles == [], f"lock-order cycles in the package: {cycles}"
+
+
 class TestLinterFramework:
     def test_registry_has_all_rules(self):
         assert set(ALL_RULES) >= {"JX001", "JX002", "JX003", "JX004",
                                   "JX005", "JX006", "JX007", "JX008",
                                   "JX009", "JX010", "JX011", "JX012",
-                                  "JX013", "JX014", "JX015", "JX016"}
+                                  "JX013", "JX014", "JX015", "JX016",
+                                  "JX017", "JX018"}
+
+    def test_every_rule_example_fires(self):
+        """Each rule's --explain example must be a true positive for
+        exactly that rule — the example IS the rule's spec."""
+        for rid, cls in sorted(ALL_RULES.items()):
+            assert cls.example, f"{rid} has no example"
+            fs = lint_source(cls.example, cls.example_path, rules=[rid])
+            assert rid in rules_of(fs), (
+                f"{rid}'s own example does not fire it")
+
+    def test_explain_cli_prints_docstring_and_example(self):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-m", "deeplearning4j_tpu.analysis",
+             "--explain", "jx017"],
+            capture_output=True, text=True, env=env, timeout=300)
+        assert proc.returncode == 0
+        assert proc.stdout.startswith("JX017")
+        assert "Minimal true positive:" in proc.stdout
+        assert "lock-order inversion" in proc.stdout
+
+    def test_explain_cli_unknown_rule(self):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-m", "deeplearning4j_tpu.analysis",
+             "--explain", "JX999"],
+            capture_output=True, text=True, env=env, timeout=300)
+        assert proc.returncode == 2
+        assert "unknown rule" in proc.stderr
 
     def test_findings_are_typed_and_sorted(self):
         src = """
