@@ -181,18 +181,24 @@ def _timed_fit(net, make_batch, batch, steps, warmup, distinct=4, cached=False):
     return batch * steps / dt, dt / steps
 
 
-def _step_flops(net, x, y):
-    """XLA cost-analysis FLOPs of the engine's actual jitted train step
-    (delegates to the observability profiler — same code path StepProfiler
-    uses, so BENCH and live MFU agree by construction)."""
+def _step_cost(net, x, y):
+    """XLA cost analysis of the engine's actual jitted train step:
+    {"flops": ..., "bytes": ...} (delegates to the observability profiler —
+    same code path StepProfiler uses, so BENCH and live MFU agree by
+    construction). "bytes" is the backend's bytes-accessed estimate, the
+    HBM traffic one step moves."""
     from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
-    from deeplearning4j_tpu.observability import estimate_step_flops
+    from deeplearning4j_tpu.observability import estimate_step_cost
 
     if type(net).__name__ == "ComputationGraph":
         ds = MultiDataSet(features=[np.asarray(x)], labels=[np.asarray(y)])
     else:
         ds = DataSet(np.asarray(x), np.asarray(y))
-    return estimate_step_flops(net, ds)
+    return estimate_step_cost(net, ds)
+
+
+def _step_flops(net, x, y):
+    return _step_cost(net, x, y).get("flops")
 
 
 def _chip_peak_flops():
@@ -200,6 +206,34 @@ def _chip_peak_flops():
     from deeplearning4j_tpu.observability import chip_peak_flops
 
     return chip_peak_flops()
+
+
+def _chip_peak_hbm_bw():
+    """Peak HBM bytes/sec for the local chip (override: BENCH_PEAK_HBM_BW)."""
+    from deeplearning4j_tpu.observability import chip_peak_hbm_bw
+
+    return chip_peak_hbm_bw()
+
+
+def _roofline_entries(prefix, cost, step_time, extra_metrics):
+    """Shared bytes-moved + roofline reporting: emit
+    `<prefix>_bytes_per_step` and, when the chip's HBM bandwidth is known,
+    an `hbm_bound` flag on the MFU-companion entry — True when the
+    memory time (bytes / peak BW) exceeds the compute time
+    (flops / peak FLOPs), i.e. the step sits on the memory roofline and
+    more MFU needs less traffic, not more compute."""
+    nbytes = cost.get("bytes")
+    if not nbytes:
+        return
+    e = _entry(f"{prefix}_bytes_per_step", nbytes, "bytes")
+    peak_bw, peak_fl = _chip_peak_hbm_bw(), _chip_peak_flops()
+    flops = cost.get("flops")
+    if peak_bw:
+        mem_s = nbytes / peak_bw
+        e["hbm_time_frac_of_step"] = round(mem_s / max(step_time, 1e-12), 4)
+        if flops and peak_fl:
+            e["hbm_bound"] = bool(mem_s > flops / peak_fl)
+    extra_metrics[e["metric"]] = e
 
 
 # ----------------------------------------------------------------- configs
@@ -1359,7 +1393,8 @@ def bench_resnet50(steps, warmup):
     extra_metrics = {}
     rng = np.random.RandomState(0)
     x, y = mk(rng, batch)
-    flops = _step_flops(net, x, y)
+    cost = _step_cost(net, x, y)
+    flops = cost.get("flops")
     peak = _chip_peak_flops()
     if flops and peak:
         mfu = flops / step_time / peak
@@ -1371,6 +1406,10 @@ def bench_resnet50(steps, warmup):
             "dl4j_train_mfu",
             "Model FLOPs utilization: flops/step / step_time / chip peak"
         ).set(mfu)
+    # Roofline companion to MFU: HBM bytes one step moves, and whether the
+    # step is memory-bound at the chip's peak bandwidth (the fused
+    # bottleneck kernel attacks exactly this term — PERF.md §27).
+    _roofline_entries("resnet50_train", cost, step_time, extra_metrics)
 
     # Streaming variant: every batch crosses the host->device link. Few
     # steps on purpose — the shared tunnel's transfer latency varies by
@@ -1452,6 +1491,66 @@ def bench_resnet50_bf16(steps, warmup):
     head["h2d_bytes_ratio_vs_f32"] = round(
         bf16_bytes / max(f32_bytes, 1e-9), 3)
     return head
+
+
+def bench_resnet50_fused_bottleneck(steps, warmup):
+    """A/B the fused bottleneck-block kernel on the same fused-graph model:
+    auto kernel resolution vs DL4J_TPU_KERNELS=xla forced fallback, same
+    run, same data. Reports fused throughput, the fused-vs-fallback ratio,
+    the impl auto-resolution actually picked (so a CPU run's ratio ~1.0 is
+    self-explaining: both arms ran the XLA composite), and the roofline
+    companion entries for the fused arm (PERF.md §27 — the kernel's whole
+    point is the bytes term)."""
+    from deeplearning4j_tpu import kernels as kern
+    from deeplearning4j_tpu.models.resnet import resnet50
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    batch = int(os.environ.get("BENCH_BATCH_RESNET50_FUSED", "32"))
+    image = int(os.environ.get("BENCH_IMAGE_RESNET50_FUSED", "64"))
+
+    def mk(rng, b):
+        x = rng.rand(b, image, image, 3).astype("float32")
+        return (x, np.eye(1000, dtype="float32")[rng.randint(0, 1000, b)])
+
+    def run_arm(forced_mode):
+        prev = os.environ.get("DL4J_TPU_KERNELS")
+        try:
+            if forced_mode is None:
+                os.environ.pop("DL4J_TPU_KERNELS", None)
+            else:
+                os.environ["DL4J_TPU_KERNELS"] = forced_mode
+            kern.registry.clear_cache()
+            conf = resnet50(n_classes=1000, image=image, dtype="bfloat16",
+                            fused_blocks=True)
+            conf.global_conf.dtype_policy = {"name": "mixed_bfloat16",
+                                             "transfer_dtype": "bfloat16"}
+            net = ComputationGraph(conf).init()
+            sps, step_time = _timed_fit(net, mk, batch, steps, warmup,
+                                        distinct=2, cached=True)
+            res = kern.registry.resolve("bottleneck_block")
+            return net, sps, step_time, res
+        finally:
+            if prev is None:
+                os.environ.pop("DL4J_TPU_KERNELS", None)
+            else:
+                os.environ["DL4J_TPU_KERNELS"] = prev
+            kern.registry.clear_cache()
+
+    net, fused_sps, step_time, res = run_arm(None)
+    _, fb_sps, _, _ = run_arm("xla")
+
+    head = _entry("resnet50_fused_bottleneck_fit_samples_per_sec_per_chip",
+                  fused_sps, "samples/sec/chip")
+    head["vs_xla_fallback_same_run"] = round(fused_sps / max(fb_sps, 1e-9), 2)
+    head["auto_resolved_impl"] = res.impl
+    head["auto_resolved_reason"] = res.reason
+
+    extra_metrics = {}
+    rng = np.random.RandomState(0)
+    x, y = mk(rng, batch)
+    _roofline_entries("resnet50_fused_bottleneck", _step_cost(net, x, y),
+                      step_time, extra_metrics)
+    return head, extra_metrics
 
 
 def bench_lm_int8_serving(steps, warmup):
@@ -2034,7 +2133,8 @@ def main():
     warmup = int(os.environ.get("BENCH_WARMUP", "5"))
     configs = os.environ.get(
         "BENCH_CONFIGS",
-        "resnet50,resnet50_bf16,lenet,char_rnn,char_rnn_fused_lstm,"
+        "resnet50,resnet50_bf16,resnet50_fused_bottleneck,"
+        "lenet,char_rnn,char_rnn_fused_lstm,"
         "lenet_step,lenet_superstep,fused_update_superstep,"
         "lenet_cold_warm,lenet_pipeline_overlap,word2vec,vgg16,"
         "flash_attn,flash_tri,transformer,"
@@ -2099,6 +2199,10 @@ def main():
     if "resnet50_bf16" in configs:
         e = bench_resnet50_bf16(max(8, steps // 3), warmup)
         extra[e["metric"]] = e
+    if "resnet50_fused_bottleneck" in configs:
+        e, more = bench_resnet50_fused_bottleneck(max(8, steps // 3), warmup)
+        extra[e["metric"]] = e
+        extra.update(more)
     if "serving_slo" in configs:
         for e in bench_serving_slo(steps, warmup):
             extra[e["metric"]] = e

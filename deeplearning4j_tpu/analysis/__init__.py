@@ -6,7 +6,7 @@ This package is the JAX port's equivalent, split in two:
 
 - **Static** (`linter.py`, `rules.py`, `concurrency.py`): an AST pass
   over every module in the package with framework-aware rules
-  (JX001-JX018) for the failure modes that are *silent* on TPU:
+  (JX001-JX019) for the failure modes that are *silent* on TPU:
 
   ========  ========================================================
   JX001     host sync (.item/.block_until_ready/np.asarray) under jit
@@ -28,6 +28,8 @@ This package is the JAX port's equivalent, split in two:
   JX016     metric labels fed from unbounded per-request data
   JX017     lock-order inversion across code paths (deadlock cycle)
   JX018     blocking call (dispatch/HTTP/join/sleep/RPC) under a lock
+  JX019     residual add + activation unfused next to a conv in
+            nn/layers/ (route through the bottleneck_block seam)
   ========  ========================================================
 
   JX017/JX018 come from the interprocedural lock model in

@@ -1,4 +1,4 @@
-"""tpulint rules JX001-JX016 (JX017/JX018 live in concurrency.py).
+"""tpulint rules JX001-JX016 and JX019 (JX017/JX018 live in concurrency.py).
 
 Each rule is a class with a stable ``id``; registration is
 registry-driven (`@register_rule`) so satellite PRs add rules without
@@ -1364,6 +1364,121 @@ def record(counter, request_id):
                             "label with the exception CLASS or an "
                             "outcome enum and put the message in the "
                             "ledger/flight bundle")
+
+
+@register_rule
+class UnfusedResidualTailRule(Rule):
+    """JX019: residual add + activation left as separate ops next to a conv.
+
+    The residual tail of a conv block — `out = conv_out + shortcut` then
+    `act(out)` as standalone statements — is exactly the elementwise
+    traffic the fused `bottleneck_block` kernel exists to eliminate
+    (PERF.md §27): each standalone op reads and writes the full activation
+    tensor through HBM, and at ResNet shapes the tail's bytes rival the
+    convs' FLOP time. A layer forward that convolves and then stitches
+    the residual/activation by hand should route the whole block through
+    the `BottleneckBlock` layer (`nn/layers/bottleneck.py`) — or another
+    `kernels.registry` seam — so the Pallas path can keep the
+    intermediates in VMEM and the XLA fallback stays the single fusion
+    candidate XLA already handles.
+
+    Bias adds (`out + params["b"]`) are exempt: one operand names the
+    param leaf, and XLA folds them into the conv epilogue. The rule keys
+    on an add of two LOCAL intermediates (both bare names) whose result —
+    or the add expression itself — feeds an activation call, in a
+    function that also calls a convolution.
+    """
+
+    id = "JX019"
+    description = ("residual add + activation as separate ops adjacent to "
+                   "a conv in nn/layers/ forward code (unfused block tail; "
+                   "route through the bottleneck_block kernel seam)")
+    example = """\
+import jax
+
+def forward(params, x, shortcut):
+    y = jax.lax.conv_general_dilated(x, params["W"], (1, 1), "SAME")
+    out = y + shortcut   # JX019: residual tail outside the fused block
+    return jax.nn.relu(out)
+"""
+    example_path = "deeplearning4j_tpu/nn/layers/_example.py"
+
+    _ACT_NAMES = ("relu", "relu6", "gelu", "sigmoid", "tanh", "silu",
+                  "swish", "elu", "leaky_relu", "softplus", "hard_swish")
+
+    def _is_conv_call(self, node) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        name = (terminal_attr(node.func)
+                if isinstance(node.func, ast.Attribute)
+                else node.func.id if isinstance(node.func, ast.Name)
+                else None)
+        return bool(name) and ("conv" in name)
+
+    def _residual_add(self, node):
+        """The `a + b` BinOp where both operands are bare local names —
+        a residual merge, not a bias/param epilogue."""
+        if (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add)
+                and isinstance(node.left, ast.Name)
+                and isinstance(node.right, ast.Name)
+                and node.left.id != node.right.id):
+            return node
+        return None
+
+    def _is_activation_call(self, node, act_aliases) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        if isinstance(node.func, ast.Attribute):
+            return terminal_attr(node.func) in self._ACT_NAMES
+        if isinstance(node.func, ast.Name):
+            return node.func.id in act_aliases
+        # `activations.resolve(conf.activation)(out)`: calling the call
+        return (isinstance(node.func, ast.Call)
+                and isinstance(node.func.func, ast.Attribute)
+                and terminal_attr(node.func.func) == "resolve")
+
+    def check(self, ctx):
+        rel = ctx.rel.replace("\\", "/")
+        if "nn/layers/" not in rel:
+            return
+        for info in ctx.functions.values():
+            body = list(walk_body(info.node))
+            if not any(self._is_conv_call(n) for n in body):
+                continue
+            # Names bound to resolved activation fns and to residual adds.
+            act_aliases, residual = set(), {}
+            for node in body:
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    tgt = node.targets[0].id
+                    if (isinstance(node.value, ast.Call)
+                            and isinstance(node.value.func, ast.Attribute)
+                            and terminal_attr(node.value.func) == "resolve"):
+                        act_aliases.add(tgt)
+                    add = self._residual_add(node.value)
+                    if add is not None:
+                        residual[tgt] = add
+            for node in body:
+                if not self._is_activation_call(node, act_aliases):
+                    continue
+                for arg in node.args:
+                    hit = None
+                    if isinstance(arg, ast.Name) and arg.id in residual:
+                        hit = residual[arg.id]
+                    elif self._residual_add(arg) is not None:
+                        hit = arg
+                    if hit is None:
+                        continue
+                    yield self.finding(
+                        ctx, hit,
+                        "residual add + activation run as standalone "
+                        "elementwise ops next to a conv: each one "
+                        "round-trips the full activation tensor through "
+                        "HBM — route the block through the fused "
+                        "`bottleneck_block` kernel seam "
+                        "(nn/layers/bottleneck.py) so the tail stays "
+                        "in VMEM on the Pallas path")
+                    break
 
 
 # The concurrency rules (JX017/JX018) live in their own module with the

@@ -15,7 +15,11 @@ and resolves once per jit signature. Kernels:
 - ``norm_act``        — BatchNorm/LayerNorm normalize+affine+activation
                         (`nn/layers/normalization.py`);
 - ``flash_attention`` — the PERF.md §6 flash kernel, migrated here from
-                        `ops/flash_attention.py` (shim kept).
+                        `ops/flash_attention.py` (shim kept);
+- ``bottleneck_block``— the fused ResNet bottleneck chain (conv1x1/BN/act
+                        x3 + residual in one VMEM residency, PERF.md §27),
+                        `nn/layers/bottleneck.py`'s seam, with an
+                        int8-weight inference variant for serving.
 
 `DL4J_TPU_KERNELS=auto|xla|pallas` (+ per-kernel
 `DL4J_TPU_KERNEL_<NAME>`) select the mode; `python -m

@@ -12,7 +12,9 @@ verbatim: same ops, same order, so the traced jaxpr — and therefore the
 trained bits — are identical to the pre-PR engines whenever the fallback
 is active (`DL4J_TPU_KERNELS=xla` or auto off-TPU).
 
-Availability (auto mode): TPU backend, float32, sigmoid gate activation,
+Availability (auto mode): TPU backend, float32 or bfloat16 compute (the
+recurrent matmul always accumulates in f32 via `preferred_element_type`;
+outputs are cast back to the operand dtype), sigmoid gate activation,
 cell activation in the supported elementwise set, `n_out` a lane (128)
 multiple and batch a sublane (8) multiple, and the weights + activations
 of one step fitting VMEM. Forced `pallas` drops the backend/tiling
@@ -49,8 +51,8 @@ def _pallas_available(backend, shapes, dtypes, meta=(), forced=False):
         return False, f"gate activation {gate!r} not expressible in-kernel"
     if act is not None and act not in _CELL_ACTS:
         return False, f"cell activation {act!r} not expressible in-kernel"
-    if dtypes and any(d != "float32" for d in dtypes):
-        return False, f"dtype {dtypes} != float32"
+    if dtypes and not set(dtypes) <= {"float32", "bfloat16"}:
+        return False, f"dtype {sorted(set(dtypes))} not in (float32, bfloat16)"
     if forced and backend != "tpu":
         return True, "forced (interpret mode off-TPU)"
     if backend != "tpu":
@@ -157,17 +159,19 @@ def _cell_kernel(n_out: int, peephole: bool, masked: bool, act_name: str,
         out = m * h
     else:
         out = h
-    ho[...] = h
-    co[...] = c
-    oo[...] = out
+    # Gate math runs in f32 (matmul `preferred_element_type`); the output
+    # refs carry the operand dtype (bf16 under mixed policies).
+    ho[...] = h.astype(ho.dtype)
+    co[...] = c.astype(co.dtype)
+    oo[...] = out.astype(oo.dtype)
 
 
 @functools.lru_cache(maxsize=64)
 def _pallas_call(batch: int, n_out: int, peephole: bool, masked: bool,
-                 act_name: str, interpret: bool):
+                 act_name: str, dtype: str, interpret: bool):
     from jax.experimental import pallas as pl
 
-    out = jax.ShapeDtypeStruct((batch, n_out), jnp.float32)
+    out = jax.ShapeDtypeStruct((batch, n_out), jnp.dtype(dtype))
     return pl.pallas_call(
         lambda *refs: _cell_kernel(n_out, peephole, masked, act_name, refs),
         out_shape=(out, out, out),
@@ -176,9 +180,10 @@ def _pallas_call(batch: int, n_out: int, peephole: bool, masked: bool,
 
 
 def pallas_cell(batch: int, n_out: int, peephole: bool, masked: bool,
-                act_name: str, interpret: bool):
+                act_name: str, dtype: str, interpret: bool):
     """Fused-cell callable with the `xla_cell` signature."""
-    call = _pallas_call(batch, n_out, peephole, masked, act_name, interpret)
+    call = _pallas_call(batch, n_out, peephole, masked, act_name, dtype,
+                        interpret)
 
     def cell(xw_t, h_prev, c_prev, RW, pw, m_t):
         args = [xw_t, h_prev, c_prev, RW]
@@ -205,7 +210,7 @@ def resolve_cell(*, batch, n_out, dtype, peephole, masked, gate_activation,
         from deeplearning4j_tpu.kernels import _diff
 
         fused = pallas_cell(int(batch), int(n_out), bool(peephole),
-                            bool(masked), str(activation),
+                            bool(masked), str(activation), str(dtype),
                             interpret=jax.default_backend() != "tpu")
         # The cell runs inside the engines' value_and_grad: Pallas forward,
         # XLA-reference backward (kernels/_diff.py).

@@ -12,9 +12,9 @@ The XLA fallbacks are the LITERAL pre-registry expressions moved here
 verbatim — same ops, same order — so `DL4J_TPU_KERNELS=xla` (and auto
 off-TPU) produces bit-identical jaxprs to the pre-PR layers.
 
-Availability (auto): TPU backend, float32, activation in the in-kernel
-set, feature dim a lane (128) multiple and row count a sublane (8)
-multiple. Forced `pallas` keeps the structural constraints and runs
+Availability (auto): TPU backend, float32 or bfloat16, activation in the
+in-kernel set, feature dim a lane (128) multiple and row count a sublane
+(8) multiple. Forced `pallas` keeps the structural constraints and runs
 interpret mode off-TPU (the CPU parity tests' path).
 """
 
@@ -40,8 +40,8 @@ def _pallas_available(backend, shapes, dtypes, meta=(), forced=False):
     act = m.get("act")
     if act is not None and act not in _ACTS:
         return False, f"activation {act!r} not expressible in-kernel"
-    if dtypes and any(d != "float32" for d in set(dtypes)):
-        return False, f"dtype {sorted(set(dtypes))} != float32"
+    if dtypes and not set(dtypes) <= {"float32", "bfloat16"}:
+        return False, f"dtype {sorted(set(dtypes))} not in (float32, bfloat16)"
     if forced and backend != "tpu":
         return True, "forced (interpret mode off-TPU)"
     if backend != "tpu":
@@ -108,13 +108,13 @@ def _ln_kernel(eps, act_name, x_ref, g_ref, b_ref, o_ref):
 
 @functools.lru_cache(maxsize=64)
 def _norm_call(op: str, rows: int, feats: int, eps: float, act_name: str,
-               interpret: bool):
+               dtype: str, interpret: bool):
     from jax.experimental import pallas as pl
 
     body = functools.partial(
         _bn_kernel if op == "batchnorm" else _ln_kernel, eps, act_name)
     return pl.pallas_call(
-        body, out_shape=jax.ShapeDtypeStruct((rows, feats), jnp.float32),
+        body, out_shape=jax.ShapeDtypeStruct((rows, feats), jnp.dtype(dtype)),
         interpret=interpret)
 
 
@@ -148,7 +148,7 @@ def batchnorm_norm_act(x, mean, var, gamma, beta, eps, activation):
 
     feats = x.shape[-1]
     call = _norm_call("batchnorm", _row_view(x).shape[0], int(feats),
-                      float(eps), str(activation),
+                      float(eps), str(activation), str(x.dtype),
                       interpret=jax.default_backend() != "tpu")
     # Pallas forward, XLA-reference backward: the seam sits inside the
     # engines' value_and_grad (kernels/_diff.py).
@@ -171,7 +171,7 @@ def layernorm_norm_act(x, gamma, beta, eps, activation):
 
     feats = x.shape[-1]
     call = _norm_call("layernorm", _row_view(x).shape[0], int(feats),
-                      float(eps), str(activation),
+                      float(eps), str(activation), str(x.dtype),
                       interpret=jax.default_backend() != "tpu")
     f = _diff.pallas_fwd_ref_bwd(
         call, lambda xv, g, b: layernorm_xla(xv, g, b, eps, activation))

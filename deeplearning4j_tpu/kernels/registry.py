@@ -48,6 +48,9 @@ MODES = ("auto", "xla", "pallas")
 
 # Kernel name -> module that registers its candidates at import.
 KERNEL_MODULES = {
+    # Fused ResNet bottleneck chain (PR 19): conv1x1/BN/act x3 + residual
+    # in one VMEM residency; XLA fallback is the unfused vertex chain.
+    "bottleneck_block": "deeplearning4j_tpu.kernels.bottleneck_block",
     "lstm_cell": "deeplearning4j_tpu.kernels.lstm_cell",
     "fused_update": "deeplearning4j_tpu.kernels.fused_update",
     "norm_act": "deeplearning4j_tpu.kernels.norm_act",
@@ -225,6 +228,37 @@ def _resolve_uncached(kernel, mode, source, backend, shapes, dtypes,
     # No candidate available (should not happen: every kernel registers an
     # unconditional XLA fallback) — surface the last probe's reason.
     return last
+
+
+def probe(kernel: str, *, backend: Optional[str] = None, shapes: Tuple = (),
+          dtypes: Tuple = (), meta: Tuple = ()):
+    """Dry-run every candidate of `kernel` at a hypothetical signature —
+    the ``--probe`` CLI's payload for debugging forced-kernel rollouts.
+
+    Unlike `resolve()` this is NOT memoized and probes ALL candidates
+    (each with `forced=True` when the active mode names it, mirroring
+    `_resolve_uncached`'s semantics), so the report shows the refusal
+    reason per candidate, not just the winner. No jit, no trace — pure
+    availability checks. Returns ``(selected_impl, rows)``."""
+    if backend is None:
+        backend = _default_backend()
+    _ensure(kernel)
+    mode, source = mode_for(kernel)
+    rows = []
+    for c in _REGISTRY[kernel]:
+        forced = mode == c.name
+        ok, reason = c.is_available(backend, shapes, dtypes, meta=meta,
+                                    forced=forced)
+        rows.append({"impl": c.name, "available": bool(ok),
+                     "forced": forced, "reason": reason})
+    selected = None
+    if mode != "auto":
+        selected = next((r["impl"] for r in rows
+                         if r["impl"] == mode and r["available"]), None)
+    if selected is None:
+        selected = next((r["impl"] for r in rows if r["available"]),
+                        rows[-1]["impl"] if rows else None)
+    return selected, rows
 
 
 def describe(backend: Optional[str] = None):

@@ -15,6 +15,7 @@ from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers import (
     ActivationLayer,
     BatchNormalization,
+    BottleneckBlock,
     ConvolutionLayer,
     GlobalPoolingLayer,
     OutputLayer,
@@ -57,9 +58,24 @@ def _bottleneck(b, name, inp, filters, stride, project: bool):
     return f"{name}_relu"
 
 
+def _bottleneck_fused(b, name, inp, filters, stride, project: bool):
+    """The same bottleneck as ONE fused layer (kernels/bottleneck_block.py):
+    the block-boundary seam the fused builder emits instead of the
+    five-vertex chain. With `DL4J_TPU_KERNELS=xla` the layer's fallback is
+    the unfused chain verbatim, so numerics are unchanged either way."""
+    b.add_layer(
+        f"{name}_block",
+        BottleneckBlock(filters=filters, stride=stride, project=project,
+                        activation="relu"),
+        inp,
+    )
+    return f"{name}_block"
+
+
 def resnet50(
     n_classes: int = 1000, image: int = 224, channels: int = 3,
     seed: int = 123, lr: float = 0.1, dtype: str = "bfloat16",
+    fused_blocks: bool = False,
 ) -> ComputationGraphConfiguration:
     b = (
         NeuralNetConfiguration.builder()
@@ -75,10 +91,11 @@ def resnet50(
                 x)
     x = "stem_pool"
     stages = [(64, 3, 1), (128, 4, 2), (256, 6, 2), (512, 3, 2)]
+    block = _bottleneck_fused if fused_blocks else _bottleneck
     for si, (filters, blocks, first_stride) in enumerate(stages):
         for bi in range(blocks):
             stride = (first_stride, first_stride) if bi == 0 else (1, 1)
-            x = _bottleneck(b, f"s{si}_b{bi}", x, filters, stride, project=(bi == 0))
+            x = block(b, f"s{si}_b{bi}", x, filters, stride, project=(bi == 0))
     b.add_layer("avgpool", GlobalPoolingLayer(pooling_type="avg"), x)
     b.add_layer("fc",
                 OutputLayer(n_out=n_classes, activation="softmax",

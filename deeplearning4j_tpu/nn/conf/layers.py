@@ -52,6 +52,9 @@ def is_bias_param(name: str) -> bool:
     return (
         name in ("b", "vb", "beta")
         or name.startswith(("b_", "eb", "db"))
+        # Per-branch BN shift params of the fused BottleneckBlock
+        # (beta_a/beta_b/beta_c/beta_proj): bias semantics like "beta".
+        or name.startswith("beta_")
         or name.endswith("B")
     )
 
@@ -436,6 +439,76 @@ class BatchNormalization(FeedForwardLayer):
 
     def state_shapes(self):
         return {"mean": (self.n_out,), "var": (self.n_out,)}
+
+
+@register_layer
+@dataclass
+class BottleneckBlock(FeedForwardLayer):
+    """Fused ResNet bottleneck block (PR 19): conv1x1 -> BN+act ->
+    conv3x3 -> BN+act -> conv1x1 -> BN -> residual add -> act as ONE
+    layer, dispatched through the `bottleneck_block` kernel seam
+    (`kernels/bottleneck_block.py`). The unfused equivalent is the
+    five-vertex chain `models/resnet.py::_bottleneck` emits; this layer
+    is what `resnet50(fused_blocks=True)` emits instead — plain conv
+    stacks are untouched.
+
+    `filters` is the squeeze width (branch a/b); the block's output is
+    `4 * filters` channels. `project=True` adds the 1x1 projection
+    shortcut (stage boundaries); otherwise the input rides the residual
+    unchanged (requires n_in == 4 * filters, the resnet invariant).
+    BN hyperparameters mirror `BatchNormalization` (decay 0.9, eps 1e-5,
+    minibatch stats in train mode).
+    """
+
+    filters: int = 64
+    stride: Tuple[int, int] = (1, 1)
+    project: bool = False
+    decay: float = 0.9
+    eps: float = 1e-5
+    is_minibatch: bool = True
+
+    def __post_init__(self):
+        self.stride = _tuple2(self.stride)
+
+    def branch_names(self) -> Tuple[str, ...]:
+        return ("a", "b", "c") + (("proj",) if self.project else ())
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        sh, sw = self.stride
+        return InputType.convolutional(
+            -(-input_type.height // sh), -(-input_type.width // sw),
+            4 * self.filters)
+
+    def set_n_in(self, input_type: InputType, override: bool) -> None:
+        if override or not self.n_in:
+            self.n_in = input_type.channels
+        self.n_out = 4 * self.filters
+
+    def default_preprocessor(self, input_type: InputType):
+        # NHWC in, NHWC out — never flatten (overrides FeedForwardLayer's
+        # CnnToFeedForward default).
+        return None
+
+    def param_shapes(self):
+        f1, f3 = self.filters, 4 * self.filters
+        shapes = {
+            "W_a": (1, 1, self.n_in, f1), "gamma_a": (f1,), "beta_a": (f1,),
+            "W_b": (3, 3, f1, f1), "gamma_b": (f1,), "beta_b": (f1,),
+            "W_c": (1, 1, f1, f3), "gamma_c": (f3,), "beta_c": (f3,),
+        }
+        if self.project:
+            shapes.update({"W_proj": (1, 1, self.n_in, f3),
+                           "gamma_proj": (f3,), "beta_proj": (f3,)})
+        return shapes
+
+    def state_shapes(self):
+        f1, f3 = self.filters, 4 * self.filters
+        shapes = {"mean_a": (f1,), "var_a": (f1,),
+                  "mean_b": (f1,), "var_b": (f1,),
+                  "mean_c": (f3,), "var_c": (f3,)}
+        if self.project:
+            shapes.update({"mean_proj": (f3,), "var_proj": (f3,)})
+        return shapes
 
 
 @register_layer

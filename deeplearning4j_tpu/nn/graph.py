@@ -294,7 +294,7 @@ class ComputationGraph:
                 # Params stored at param_dtype, cast (or dequantized) to the
                 # policy's compute dtype at use (nn/params.py).
                 lparams = params_mod.prep_layer_params(params.get(name, {}),
-                                                       cdt)
+                                                       cdt, layer=layer)
                 out, lstate_new, mask = get_impl(layer)(
                     layer, lparams, state.get(name, {}), x,
                     rng=lrng, train=train, mask=mask,

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from deeplearning4j_tpu.nn.layers import (
     attention,
+    bottleneck,
     convolution,
     feedforward,
     moe,
@@ -33,6 +34,7 @@ LAYER_IMPLS = {
     "AutoEncoder": feedforward.autoencoder_apply,
     "RBM": feedforward.rbm_apply,
     "ConvolutionLayer": convolution.conv2d_apply,
+    "BottleneckBlock": bottleneck.bottleneck_apply,
     "SubsamplingLayer": convolution.subsampling_apply,
     "LocalResponseNormalization": convolution.lrn_apply,
     "BatchNormalization": normalization.batchnorm_apply,

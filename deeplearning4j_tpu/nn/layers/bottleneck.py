@@ -1,0 +1,29 @@
+"""Fused ResNet bottleneck block layer.
+
+Thin adapter over the `bottleneck_block` kernel seam
+(`kernels/bottleneck_block.py`): the whole conv/BN/act/residual chain is
+one dispatch — XLA fallback is the unfused vertex chain verbatim, the
+Pallas path keeps the intermediates in VMEM. Batch statistics come back
+as kernel outputs; the EMA update lives HERE (engine-side, the same
+expression as `normalization.py::batchnorm_apply`) so training semantics
+are identical to the unfused layers under either impl.
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.kernels import bottleneck_block as _kernel
+
+
+def bottleneck_apply(conf, params, state, x, *, rng=None, train=False,
+                     mask=None):
+    out, stats = _kernel.bottleneck_forward(
+        x, params, state,
+        stride=conf.stride, project=conf.project, eps=conf.eps,
+        activation=conf.activation,
+        train=bool(train) and conf.is_minibatch)
+    if stats is None:
+        return out, state, mask
+    decay = conf.decay
+    new_state = {k: decay * state[k] + (1.0 - decay) * stats[k]
+                 for k in stats}
+    return out, new_state, mask

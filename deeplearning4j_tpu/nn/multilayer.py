@@ -289,7 +289,8 @@ class MultiLayerNetwork:
             lrng = jax.random.fold_in(rng, i) if rng is not None else None
             # Params stored at param_dtype, cast (or dequantized) to the
             # policy's compute dtype at use (nn/params.py).
-            lparams = params_mod.prep_layer_params(params.get(lk, {}), cdt)
+            lparams = params_mod.prep_layer_params(params.get(lk, {}), cdt,
+                                                   layer=layer)
             lstate = state.get(lk, {})
             x, lstate_new, mask = get_impl(layer)(
                 layer, lparams, lstate, x, rng=lrng, train=train, mask=mask

@@ -19,6 +19,7 @@ import numpy as np
 from deeplearning4j_tpu.nn.conf.enums import WeightInit
 from deeplearning4j_tpu.nn.conf.layers import (
     BatchNormalization,
+    BottleneckBlock,
     ConvolutionLayer,
     GravesBidirectionalLSTM,
     GravesLSTM,
@@ -34,6 +35,11 @@ from deeplearning4j_tpu.nn.weights import init_weights
 def _fans(conf: Layer, name: str, shape: Tuple[int, ...]) -> Tuple[float, float]:
     """Fan-in/out per param, following the reference's initializer conventions."""
     if isinstance(conf, ConvolutionLayer) and name == "W":
+        kh, kw, cin, cout = shape
+        return (cin * kh * kw, cout * kh * kw)
+    if isinstance(conf, BottleneckBlock) and len(shape) == 4:
+        # Per-branch conv kernels (HWIO): same fans as ConvolutionLayer
+        # so fused and unfused blocks draw identical init statistics.
         kh, kw, cin, cout = shape
         return (cin * kh * kw, cout * kh * kw)
     if isinstance(conf, MoELayer) and len(shape) == 3:
@@ -65,6 +71,11 @@ def init_layer_params(conf: Layer, rng: jax.Array, dtype=jnp.float32) -> Dict[st
         if type(conf).__name__ == "LayerNormalization":
             params[name] = (jnp.ones(shape, dtype) if name == "gamma"
                             else jnp.zeros(shape, dtype))
+            continue
+        if isinstance(conf, BottleneckBlock) and name.startswith("gamma_"):
+            # Per-branch BN scale: ones, like BatchNormalization's default
+            # gamma (beta_* lands in the bias path below -> zeros).
+            params[name] = jnp.ones(shape, dtype)
             continue
         is_bias = is_bias_param(name) and name != "beta"
         is_peephole = name.startswith("pW")
@@ -110,7 +121,8 @@ def cast_floating(tree, dtype):
         if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
 
 
-def prep_layer_params(lparams: Dict[str, jnp.ndarray], compute_dtype):
+def prep_layer_params(lparams: Dict[str, jnp.ndarray], compute_dtype,
+                      layer: Layer = None):
     """Per-use param prep shared by both engines' `_forward_fn` (traced):
     floating leaves cast to the policy's compute dtype, int8 leaves with a
     `<name>__scale` companion (post-training quantization —
@@ -125,7 +137,20 @@ def prep_layer_params(lparams: Dict[str, jnp.ndarray], compute_dtype):
     is the (possibly dequantized-int8) weight — adapters compose with
     quantized bases and the rank-r delta fuses into the consuming
     matmul. (`<name>__lora_scale` is consumed by the `__scale` suffix
-    skip below; only the factor pair needs explicit handling.)"""
+    skip below; only the factor pair needs explicit handling.)
+
+    `layer` (optional, the conf) lets a layer opt out of engine-side
+    dequantization: the fused BottleneckBlock keeps int8 weights and
+    their `__scale` siblings intact so the Pallas body dequantizes
+    in-register — one byte per weight over the wire instead of four.
+    Its XLA fallback applies the exact dequant expression from here."""
+    if type(layer).__name__ == "BottleneckBlock":
+        out = {}
+        for k, a in lparams.items():
+            out[k] = (a.astype(compute_dtype)
+                      if jnp.issubdtype(a.dtype, jnp.floating)
+                      and not k.endswith("__scale") else a)
+        return out
     out: Dict[str, jnp.ndarray] = {}
     for k, a in lparams.items():
         if k.endswith(("__scale", "__lora_a", "__lora_b")):
@@ -157,6 +182,8 @@ def init_layer_state(conf: Layer, dtype=jnp.float32) -> Dict[str, jnp.ndarray]:
     state = {}
     for name, shape in conf.state_shapes().items():
         if isinstance(conf, BatchNormalization) and name == "var":
+            state[name] = jnp.ones(shape, dtype)
+        elif isinstance(conf, BottleneckBlock) and name.startswith("var_"):
             state[name] = jnp.ones(shape, dtype)
         else:
             state[name] = jnp.zeros(shape, dtype)

@@ -41,13 +41,15 @@ from deeplearning4j_tpu.observability.metrics import (
     install_builtin_collectors)
 from deeplearning4j_tpu.observability.tracing import NOOP_SPAN, Tracer
 from deeplearning4j_tpu.observability.profiler import (
-    StepProfiler, chip_peak_flops, estimate_step_flops)
+    StepProfiler, chip_peak_flops, chip_peak_hbm_bw, estimate_step_cost,
+    estimate_step_flops)
 
 __all__ = [
     "metrics", "tracer", "config", "StepProfiler", "MetricsRegistry",
     "Tracer", "DEFAULT_BUCKETS", "WIDE_BUCKETS", "enable", "disable",
     "iteration_span", "host_nbytes", "install_jax_compile_hook",
     "bench_snapshot", "prometheus_payload", "chip_peak_flops",
+    "chip_peak_hbm_bw", "estimate_step_cost",
     "estimate_step_flops", "flight", "FlightRecorder", "memory",
     "propagate", "install_build_info", "request_ledger", "RequestLedger",
     "slo",

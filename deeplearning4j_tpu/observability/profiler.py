@@ -42,10 +42,20 @@ def estimate_step_flops(net, ds) -> Optional[float]:
     """XLA cost-analysis FLOPs of the engine's actual jitted train step for
     one staged batch (`bench.py` delegates here). Returns None when the
     backend does not report flops."""
+    return estimate_step_cost(net, ds).get("flops")
+
+
+def estimate_step_cost(net, ds) -> Dict[str, Optional[float]]:
+    """XLA cost analysis of the jitted train step for one staged batch:
+    ``{"flops": ..., "bytes": ...}`` where ``bytes`` is the backend's
+    "bytes accessed" estimate — the HBM traffic one step moves, the
+    numerator of the roofline check `bench.py` prints next to MFU. Either
+    value is None when the backend does not report it."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
+    out: Dict[str, Optional[float]] = {"flops": None, "bytes": None}
     try:
         clock = (jnp.asarray(0.0, jnp.float32), jax.random.PRNGKey(0))
         fn = net._get_jit("train_step")
@@ -77,9 +87,12 @@ def estimate_step_flops(net, ds) -> Optional[float]:
         if isinstance(cost, (list, tuple)):
             cost = cost[0]
         flops = float(cost.get("flops", 0.0))
-        return flops if flops > 0 else None
+        nbytes = float(cost.get("bytes accessed", 0.0))
+        out["flops"] = flops if flops > 0 else None
+        out["bytes"] = nbytes if nbytes > 0 else None
+        return out
     except Exception:
-        return None
+        return out
 
 
 def chip_peak_flops() -> Optional[float]:
@@ -105,6 +118,35 @@ def chip_peak_flops() -> Optional[float]:
     for key, peak in table:
         if key in kind:
             return peak
+    return None
+
+
+def chip_peak_hbm_bw() -> Optional[float]:
+    """Peak HBM bandwidth (bytes/sec) of the local accelerator (env
+    override: DL4J_TPU_PEAK_HBM_BW / BENCH_PEAK_HBM_BW). Paired with the
+    cost-analysis "bytes accessed" estimate this yields the roofline
+    memory-time bound bench.py compares against compute time. None on
+    CPU / unknown chips — callers must treat the roofline flag as
+    unavailable, not as compute-bound."""
+    env = os.environ.get("DL4J_TPU_PEAK_HBM_BW") or os.environ.get(
+        "BENCH_PEAK_HBM_BW")
+    if env:
+        return float(env)
+    try:
+        import jax
+
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return None
+    table = [
+        ("v5 lite", 819e9), ("v5e", 819e9),
+        ("v5p", 2765e9), ("v5", 2765e9),
+        ("v6", 1640e9), ("trillium", 1640e9),
+        ("v4", 1228e9), ("v3", 900e9), ("v2", 700e9),
+    ]
+    for key, bw in table:
+        if key in kind:
+            return bw
     return None
 
 

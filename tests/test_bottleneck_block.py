@@ -1,0 +1,305 @@
+"""Fused ResNet bottleneck kernel (kernels/bottleneck_block.py) tests:
+Pallas-vs-XLA parity in interpret mode on CPU (forward float-close, f32
+and bf16, identity and projection shortcuts, train and inference),
+gradient parity through the `kernels/_diff.py` pairing, the int8-weight
+inference variant, and the acceptance bit-identity contract — a graph
+built from the fused `BottleneckBlock` layer under `DL4J_TPU_KERNELS=xla`
+trains bit-identically to the same graph built from per-layer vertices.
+PERF.md §27."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import NeuralNetConfiguration
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.kernels import bottleneck_block as bb
+from deeplearning4j_tpu.kernels import registry
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    BottleneckBlock,
+    GlobalPoolingLayer,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.models.resnet import _bottleneck, _bottleneck_fused, _conv_bn
+from deeplearning4j_tpu.checkpoint import quantize
+
+N_CLASSES = 3
+
+_ENV_VARS = ["DL4J_TPU_KERNELS"] + [
+    "DL4J_TPU_KERNEL_" + k.upper() for k in registry.kernel_names()]
+
+
+@pytest.fixture(autouse=True)
+def _clean_kernel_env(monkeypatch):
+    for var in _ENV_VARS:
+        monkeypatch.delenv(var, raising=False)
+    registry.clear_cache()
+    yield
+    registry.clear_cache()
+
+
+def _block_inputs(rng, *, b=2, h=6, w=6, filters=2, project=False,
+                  stride=(1, 1), dtype="float32"):
+    """Random x/params/state for one block. The identity shortcut needs
+    cin == 4*filters (the resnet invariant)."""
+    dt = jnp.dtype(dtype)
+    f1, f3 = filters, 4 * filters
+    cin = f3
+    x = jnp.asarray(rng.randn(b, h, w, cin), dt)
+    shapes = {"W_a": (1, 1, cin, f1), "W_b": (3, 3, f1, f1),
+              "W_c": (1, 1, f1, f3)}
+    feats = {"a": f1, "b": f1, "c": f3}
+    if project:
+        shapes["W_proj"] = (1, 1, cin, f3)
+        feats["proj"] = f3
+    params, state = {}, {}
+    for n, f in feats.items():
+        params[f"gamma_{n}"] = jnp.asarray(rng.rand(f) + 0.5, dt)
+        params[f"beta_{n}"] = jnp.asarray(rng.randn(f) * 0.1, dt)
+        state[f"mean_{n}"] = jnp.asarray(rng.randn(f) * 0.1, jnp.float32)
+        state[f"var_{n}"] = jnp.asarray(rng.rand(f) + 0.5, jnp.float32)
+    for k, s in shapes.items():
+        params[k] = jnp.asarray(rng.randn(*s) * 0.2, dt)
+    return x, params, state
+
+
+def _run(monkeypatch, mode, x, params, state, *, stride=(1, 1),
+         project=False, train=True):
+    monkeypatch.setenv("DL4J_TPU_KERNEL_BOTTLENECK_BLOCK", mode)
+    registry.clear_cache()
+    return bb.bottleneck_forward(x, params, state, stride=stride,
+                                 project=project, eps=1e-5,
+                                 activation="relu", train=train)
+
+
+_TOLS = {"float32": dict(rtol=2e-5, atol=2e-5),
+         "bfloat16": dict(rtol=6e-2, atol=6e-2)}
+
+
+class TestKernelParity:
+    @pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+    @pytest.mark.parametrize("project,stride", [
+        (False, (1, 1)), (True, (1, 1)), (True, (2, 2))])
+    def test_train_forward_and_stats(self, monkeypatch, project, stride,
+                                     dtype):
+        rng = np.random.RandomState(11)
+        x, params, state = _block_inputs(rng, project=project, stride=stride,
+                                         dtype=dtype)
+        yr, sr = _run(monkeypatch, "xla", x, params, state, stride=stride,
+                      project=project)
+        yp, sp = _run(monkeypatch, "pallas", x, params, state, stride=stride,
+                      project=project)
+        assert yp.dtype == jnp.dtype(dtype)
+        assert set(sp) == set(bb.stat_keys(project))
+        np.testing.assert_allclose(np.asarray(yp, np.float32),
+                                   np.asarray(yr, np.float32),
+                                   **_TOLS[dtype])
+        for k in sp:
+            np.testing.assert_allclose(np.asarray(sp[k], np.float32),
+                                       np.asarray(sr[k], np.float32),
+                                       **_TOLS[dtype])
+
+    @pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+    @pytest.mark.parametrize("project", [False, True])
+    def test_infer_forward(self, monkeypatch, project, dtype):
+        rng = np.random.RandomState(12)
+        x, params, state = _block_inputs(rng, project=project, dtype=dtype)
+        yr, _ = _run(monkeypatch, "xla", x, params, state, project=project,
+                     train=False)
+        yp, _ = _run(monkeypatch, "pallas", x, params, state, project=project,
+                     train=False)
+        assert yp.dtype == jnp.dtype(dtype)
+        np.testing.assert_allclose(np.asarray(yp, np.float32),
+                                   np.asarray(yr, np.float32),
+                                   **_TOLS[dtype])
+
+    def test_grads_match_fallback(self, monkeypatch):
+        # pallas_call has no autodiff rule; the block must still sit inside
+        # the engines' value_and_grad with the XLA composite's VJP
+        # (kernels/_diff.py pairing).
+        rng = np.random.RandomState(13)
+        x, params, state = _block_inputs(rng, project=True)
+
+        def grads_with(mode):
+            monkeypatch.setenv("DL4J_TPU_KERNEL_BOTTLENECK_BLOCK", mode)
+            registry.clear_cache()
+
+            def loss(p, xv):
+                y, _ = bb.bottleneck_forward(xv, p, state, stride=(1, 1),
+                                             project=True, eps=1e-5,
+                                             activation="relu", train=True)
+                return jnp.sum(y ** 2)
+
+            return jax.grad(loss, argnums=(0, 1))(params, x)
+
+        gp, gr = grads_with("pallas"), grads_with("xla")
+        for p, r in zip(jax.tree_util.tree_leaves(gp),
+                        jax.tree_util.tree_leaves(gr)):
+            np.testing.assert_allclose(np.asarray(p), np.asarray(r),
+                                       rtol=2e-4, atol=2e-5)
+
+    def test_int8_inference_parity(self, monkeypatch):
+        rng = np.random.RandomState(14)
+        x, params, state = _block_inputs(rng, project=True)
+        qparams = dict(params)
+        for n in ("a", "b", "c", "proj"):
+            q, scale = quantize.quantize_array(np.asarray(params[f"W_{n}"]))
+            qparams[f"W_{n}"] = jnp.asarray(q)
+            qparams[f"W_{n}__scale"] = jnp.asarray(scale)
+        yr, _ = _run(monkeypatch, "xla", x, qparams, state, project=True,
+                     train=False)
+        yp, _ = _run(monkeypatch, "pallas", x, qparams, state, project=True,
+                     train=False)
+        np.testing.assert_allclose(np.asarray(yp), np.asarray(yr),
+                                   rtol=2e-5, atol=2e-5)
+        # ... and the quantized block tracks the float one loosely.
+        yf, _ = _run(monkeypatch, "xla", x, params, state, project=True,
+                     train=False)
+        np.testing.assert_allclose(np.asarray(yp), np.asarray(yf),
+                                   rtol=0.2, atol=0.2)
+
+    def test_int8_train_refused(self, monkeypatch):
+        rng = np.random.RandomState(15)
+        x, params, state = _block_inputs(rng)
+        for n in ("a", "b", "c"):
+            q, scale = quantize.quantize_array(np.asarray(params[f"W_{n}"]))
+            params[f"W_{n}"] = jnp.asarray(q)
+            params[f"W_{n}__scale"] = jnp.asarray(scale)
+        with pytest.raises(ValueError, match="inference-only"):
+            bb.bottleneck_forward(x, params, state, stride=(1, 1),
+                                  project=False, eps=1e-5, activation="relu",
+                                  train=True)
+
+    def test_probe_reports_all_candidates(self):
+        selected, rows = registry.probe(
+            "bottleneck_block", backend="cpu",
+            shapes=(2, 6, 6, 8, 2, 8, 1, 1), dtypes=("float32",),
+            meta=(("train", True), ("project", False), ("act", "relu"),
+                  ("int8", False)))
+        assert selected == "xla"
+        by_name = {r["impl"]: r for r in rows}
+        assert not by_name["pallas"]["available"]
+        assert "TPU backend" in by_name["pallas"]["reason"]
+        assert by_name["xla"]["available"]
+
+
+# --------------------------------------------------------------------------
+# Acceptance bit-identity: fused layer vs unfused vertex chain, both under
+# DL4J_TPU_KERNELS=xla, with the unfused net's initialization mapped onto
+# the fused layer's parameter names.
+
+
+def _graph_conf(fused: bool, image=6, filters=2):
+    b = (NeuralNetConfiguration.builder()
+         .seed(21).learning_rate(0.01).updater("nesterovs").momentum(0.9)
+         .weight_init("relu").dtype("float32")
+         .graph_builder()
+         .add_inputs("input"))
+    x = _conv_bn(b, "stem", "input", 4 * filters, (1, 1), (1, 1))
+    block = _bottleneck_fused if fused else _bottleneck
+    x = block(b, "b0", x, filters, (1, 1), project=False)
+    x = block(b, "b1", x, filters, (2, 2), project=True)
+    b.add_layer("avgpool", GlobalPoolingLayer(pooling_type="avg"), x)
+    b.add_layer("fc", OutputLayer(n_out=N_CLASSES, activation="softmax",
+                                  loss_function="mcxent",
+                                  weight_init="xavier"), "avgpool")
+    return (b.set_outputs("fc")
+            .set_input_types(InputType.convolutional(image, image, 3))
+            .build())
+
+
+def _cp(a):
+    # TRUE copy: jnp.asarray(np.asarray(x)) is zero-copy on CPU, which
+    # would alias donated fit buffers and read back recycled memory.
+    return jnp.array(np.array(a))
+
+
+def _map_unfused_to_fused(nu, nf):
+    """Copy the unfused net's initialization onto the fused net's
+    per-block parameter/state names."""
+    pf = {k: {p: _cp(v) for p, v in d.items()} for k, d in nf.params_tree.items()}
+    sf = {k: {p: _cp(v) for p, v in d.items()} for k, d in nf.state.items()}
+    pu, su = nu.params_tree, nu.state
+    for shared in ("stem_conv", "stem_bn", "fc"):
+        pf[shared] = {p: _cp(v) for p, v in pu[shared].items()}
+    sf["stem_bn"] = {p: _cp(v) for p, v in su["stem_bn"].items()}
+    for blk, project in (("b0", False), ("b1", True)):
+        branches = ("a", "b", "c") + (("proj",) if project else ())
+        dst = f"{blk}_block"
+        for n in branches:
+            pf[dst][f"W_{n}"] = _cp(pu[f"{blk}_{n}_conv"]["W"])
+            pf[dst][f"gamma_{n}"] = _cp(pu[f"{blk}_{n}_bn"]["gamma"])
+            pf[dst][f"beta_{n}"] = _cp(pu[f"{blk}_{n}_bn"]["beta"])
+            sf[dst][f"mean_{n}"] = _cp(su[f"{blk}_{n}_bn"]["mean"])
+            sf[dst][f"var_{n}"] = _cp(su[f"{blk}_{n}_bn"]["var"])
+    nf.params_tree, nf.state = pf, sf
+    return nf
+
+
+def _batches(n=3, b=4, image=6):
+    rng = np.random.RandomState(33)
+    out = []
+    for _ in range(n):
+        X = rng.randn(b, image, image, 3).astype(np.float32)
+        Y = np.eye(N_CLASSES, dtype=np.float32)[rng.randint(0, N_CLASSES, b)]
+        out.append(DataSet(X, Y))
+    return out
+
+
+class TestFusedLayerBitIdentity:
+    def test_xla_mode_matches_unfused_chain(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_KERNELS", "xla")
+        registry.clear_cache()
+        nu = ComputationGraph(_graph_conf(fused=False)).init()
+        nf = _map_unfused_to_fused(nu, ComputationGraph(_graph_conf(fused=True)).init())
+
+        x0 = np.asarray(_batches(n=1)[0].features)
+        np.testing.assert_array_equal(np.asarray(nf.output(x0)),
+                                      np.asarray(nu.output(x0)))
+
+        for ds in _batches():
+            nu.fit(ds)
+            nf.fit(ds)
+
+        pu, pf = nu.params_tree, nf.params_tree
+        for blk, project in (("b0", False), ("b1", True)):
+            branches = ("a", "b", "c") + (("proj",) if project else ())
+            for n in branches:
+                np.testing.assert_array_equal(
+                    np.asarray(pf[f"{blk}_block"][f"W_{n}"]),
+                    np.asarray(pu[f"{blk}_{n}_conv"]["W"]))
+                np.testing.assert_array_equal(
+                    np.asarray(pf[f"{blk}_block"][f"gamma_{n}"]),
+                    np.asarray(pu[f"{blk}_{n}_bn"]["gamma"]))
+                np.testing.assert_array_equal(
+                    np.asarray(nf.state[f"{blk}_block"][f"mean_{n}"]),
+                    np.asarray(nu.state[f"{blk}_{n}_bn"]["mean"]))
+                np.testing.assert_array_equal(
+                    np.asarray(nf.state[f"{blk}_block"][f"var_{n}"]),
+                    np.asarray(nu.state[f"{blk}_{n}_bn"]["var"]))
+        for shared in ("stem_conv", "stem_bn", "fc"):
+            for p in pu[shared]:
+                np.testing.assert_array_equal(np.asarray(pf[shared][p]),
+                                              np.asarray(pu[shared][p]))
+
+    def test_forced_pallas_fused_net_trains(self, monkeypatch):
+        # The fused layer's Pallas path (interpret on CPU) must survive a
+        # real fit loop — value_and_grad through the _diff pairing — and
+        # land float-close to the fallback.
+        def train(mode):
+            monkeypatch.setenv("DL4J_TPU_KERNELS", mode)
+            registry.clear_cache()
+            net = ComputationGraph(_graph_conf(fused=True)).init()
+            for ds in _batches(n=2):
+                net.fit(ds)
+            return net
+
+        np_, nx = train("pallas"), train("xla")
+        for p, r in zip(jax.tree_util.tree_leaves(np_.params_tree),
+                        jax.tree_util.tree_leaves(nx.params_tree)):
+            np.testing.assert_allclose(np.asarray(p), np.asarray(r),
+                                       rtol=1e-3, atol=1e-4)

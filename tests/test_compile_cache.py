@@ -404,3 +404,78 @@ class TestServingWarmup:
             assert times[0] <= max(2.0 * steady, 0.25)
         finally:
             server.stop()
+
+
+# ------------------------------------------- fused-bottleneck serving warmup
+
+
+class TestBottleneckServingWarmup:
+    """PR 19 regression: `warmup_buckets` (the serving batcher's warm path)
+    must warm the fused `BottleneckBlock` layer's resolved kernel signature
+    for resnet-family checkpoints — an int8-quantized fused checkpoint then
+    serves over HTTP with ZERO XLA compiles across the bucket ladder."""
+
+    def _fused_conf(self):
+        from deeplearning4j_tpu.models.resnet import (_bottleneck_fused,
+                                                      _conv_bn)
+        from deeplearning4j_tpu.nn.conf.layers import GlobalPoolingLayer
+
+        b = (NeuralNetConfiguration.builder()
+             .seed(9).learning_rate(0.01).updater("nesterovs").momentum(0.9)
+             .weight_init("relu").dtype("float32")
+             .graph_builder().add_inputs("input"))
+        x = _conv_bn(b, "stem", "input", 8, (1, 1), (1, 1))
+        x = _bottleneck_fused(b, "b0", x, 2, (1, 1), project=False)
+        x = _bottleneck_fused(b, "b1", x, 2, (2, 2), project=True)
+        b.add_layer("avgpool", GlobalPoolingLayer(pooling_type="avg"), x)
+        b.add_layer("fc", OutputLayer(n_out=3, activation="softmax",
+                                      loss_function="mcxent",
+                                      weight_init="xavier"), "avgpool")
+        return (b.set_outputs("fc")
+                .set_input_types(InputType.convolutional(6, 6, 3))
+                .build())
+
+    def test_int8_checkpoint_serves_zero_compiles_after_warmup(
+            self, cache_dir, tmp_path):
+        from deeplearning4j_tpu.checkpoint import load_any, save_checkpoint
+        from deeplearning4j_tpu.checkpoint.quantize import quantize_checkpoint
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.serving import InferenceServer
+
+        rng = np.random.RandomState(5)
+        X = rng.randn(4, 6, 6, 3).astype(np.float32)
+        Y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 4)]
+        net = ComputationGraph(self._fused_conf()).init()
+        net.fit(DataSet(X, Y))
+        src = str(tmp_path / "step1")
+        dst = str(tmp_path / "step1-int8")
+        save_checkpoint(net, src)
+        quantize_checkpoint(src, dst)
+        srv = load_any(dst)
+        blk = srv.params_tree["b0_block"]
+        assert blk["W_a"].dtype == np.int8 and "W_a__scale" in blk
+
+        obs.install_jax_compile_hook(obs.metrics)
+        server = InferenceServer(srv, max_batch_size=4, max_delay_ms=1.0,
+                                 warmup=True).start()
+        try:
+            assert server.wait_ready(timeout=300)
+            # Reference outputs first: the direct output() below runs at
+            # exact (unpadded) row counts, which are NOT all bucket shapes.
+            refs = {rows: np.asarray(srv.output(X[:rows]))[0]
+                    for rows in (1, 2, 3, 4)}
+            compiles_before = _counter_total("dl4j_xla_compiles_total")
+            for rows in (1, 2, 3, 4):  # every bucket of the ladder
+                req = urllib.request.Request(
+                    server.url + "/predict",
+                    data=json.dumps({"data": X[:rows].tolist()}).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    preds = np.asarray(json.loads(resp.read())["predictions"])
+                assert preds.shape == (rows, 3)
+                np.testing.assert_allclose(preds, refs[rows], rtol=1e-4,
+                                           atol=1e-5)
+            assert (_counter_total("dl4j_xla_compiles_total")
+                    == compiles_before)
+        finally:
+            server.stop()

@@ -1236,13 +1236,99 @@ class TestConcurrencyCLI:
         assert cycles == [], f"lock-order cycles in the package: {cycles}"
 
 
+class TestJX019UnfusedResidualTail:
+    def _lint(self, src, path="deeplearning4j_tpu/nn/layers/fake_block.py"):
+        return lint_source(src, path, rules=["JX019"])
+
+    def test_residual_then_named_activation_fires(self):
+        src = """
+import jax
+
+def forward(params, x, shortcut):
+    y = jax.lax.conv_general_dilated(x, params["W"], (1, 1), "SAME")
+    out = y + shortcut
+    return jax.nn.relu(out)
+"""
+        fs = self._lint(src)
+        assert rules_of(fs) == {"JX019"}
+        assert "bottleneck_block" in fs[0].message
+
+    def test_residual_through_resolved_activation_fires(self):
+        src = """
+import jax
+from deeplearning4j_tpu.nn import activations
+
+def forward(conf, params, x, shortcut):
+    y = jax.lax.conv_general_dilated(x, params["W"], (1, 1), "SAME")
+    act = activations.resolve(conf.activation)
+    out = y + shortcut
+    return act(out)
+"""
+        assert rules_of(self._lint(src)) == {"JX019"}
+
+    def test_inline_residual_inside_activation_fires(self):
+        src = """
+import jax
+from deeplearning4j_tpu.nn import activations
+
+def forward(conf, params, x, shortcut):
+    y = jax.lax.conv_general_dilated(x, params["W"], (1, 1), "SAME")
+    return activations.resolve(conf.activation)(y + shortcut)
+"""
+        assert rules_of(self._lint(src)) == {"JX019"}
+
+    def test_bias_add_epilogue_is_clean(self):
+        # conv2d_apply's shape: the add's right operand names the param
+        # leaf — XLA folds bias into the conv epilogue, nothing to fuse.
+        src = """
+import jax
+from deeplearning4j_tpu.nn import activations
+
+def forward(conf, params, x):
+    out = jax.lax.conv_general_dilated(x, params["W"], (1, 1), "SAME")
+    out = out + params["b"].astype(out.dtype)
+    return activations.resolve(conf.activation)(out)
+"""
+        assert self._lint(src) == []
+
+    def test_residual_without_conv_is_clean(self):
+        # Transformer residuals around matmuls are a different traffic
+        # story (the attention kernels own that fusion); the rule is
+        # scoped to conv blocks.
+        src = """
+import jax
+
+def forward(params, x, shortcut):
+    y = x @ params["W"]
+    out = y + shortcut
+    return jax.nn.relu(out)
+"""
+        assert self._lint(src) == []
+
+    def test_outside_layers_is_clean(self):
+        src = """
+import jax
+
+def forward(params, x, shortcut):
+    y = jax.lax.conv_general_dilated(x, params["W"], (1, 1), "SAME")
+    out = y + shortcut
+    return jax.nn.relu(out)
+"""
+        assert self._lint(src, path="deeplearning4j_tpu/models/fake.py") == []
+
+    def test_package_is_clean(self):
+        # nn/layers/ routes fused blocks through the bottleneck_block
+        # kernel seam; no hand-stitched residual tails remain.
+        assert [f for f in lint_package(rules=["JX019"])] == []
+
+
 class TestLinterFramework:
     def test_registry_has_all_rules(self):
         assert set(ALL_RULES) >= {"JX001", "JX002", "JX003", "JX004",
                                   "JX005", "JX006", "JX007", "JX008",
                                   "JX009", "JX010", "JX011", "JX012",
                                   "JX013", "JX014", "JX015", "JX016",
-                                  "JX017", "JX018"}
+                                  "JX017", "JX018", "JX019"}
 
     def test_every_rule_example_fires(self):
         """Each rule's --explain example must be a true positive for
