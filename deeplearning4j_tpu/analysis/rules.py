@@ -1,4 +1,5 @@
-"""tpulint rules JX001-JX016 and JX019 (JX017/JX018 live in concurrency.py).
+"""tpulint rules JX001-JX016, JX019 and JX020 (JX017/JX018 live in
+concurrency.py).
 
 Each rule is a class with a stable ``id``; registration is
 registry-driven (`@register_rule`) so satellite PRs add rules without
@@ -1479,6 +1480,68 @@ def forward(params, x, shortcut):
                         "(nn/layers/bottleneck.py) so the tail stays "
                         "in VMEM on the Pallas path")
                     break
+
+
+@register_rule
+class ShardingOutsideParallelRule(Rule):
+    """JX020: NamedSharding/PartitionSpec constructed outside `parallel/`.
+
+    Mirror of JX007/JX010 for the partitioning layer: a `NamedSharding(
+    mesh, P(...))` hand-built in model/serving/checkpoint code hardcodes
+    one mesh topology at the construction site — it bypasses
+    `parallel/mesh.py`'s layout rules (`param_shardings`' head-aware
+    attention specs, `kv_page_sharding`'s head-dim pin, `replicated`),
+    silently disagrees with what `shard_params` installed on the same
+    tree, and leaves no single place to audit which axes a subsystem
+    partitions over. Spec construction lives in `parallel/`; everything
+    else asks it (`mesh.replicated(...)`, `mesh.axis_sharding(...)`,
+    `mesh.kv_page_sharding(...)`, `param_shardings(...)`) — callers then
+    inherit rule fixes (and the PERF.md §28 layout model) for free.
+    """
+
+    id = "JX020"
+    description = ("NamedSharding/PartitionSpec constructed (or imported) "
+                   "outside parallel/ — layout decisions bypass the mesh "
+                   "rule layer (use parallel.mesh helpers)")
+    example = """\
+from jax.sharding import NamedSharding, PartitionSpec  # JX020
+
+def place(mesh, tree):
+    s = NamedSharding(mesh, PartitionSpec(None, "model"))
+    return s
+"""
+    example_path = "deeplearning4j_tpu/serving/_example.py"
+
+    _NAMES = ("NamedSharding", "PartitionSpec")
+
+    def check(self, ctx):
+        rel = ctx.rel.replace("\\", "/")
+        if ("/parallel/" in rel or rel.startswith("parallel/")
+                or "/analysis/" in rel):
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom):
+                names = [a.name for a in node.names]
+                hit = [n for n in self._NAMES if n in names]
+                if hit:
+                    yield self.finding(
+                        ctx, node,
+                        f"importing {', '.join(hit)} outside parallel/: "
+                        "sharding specs are built by parallel/mesh.py's "
+                        "rule layer — call mesh.replicated / "
+                        "mesh.axis_sharding / param_shardings instead")
+            elif isinstance(node, ast.Call):
+                func = node.func
+                name = (func.id if isinstance(func, ast.Name)
+                        else terminal_attr(func)
+                        if isinstance(func, ast.Attribute) else None)
+                if name in self._NAMES:
+                    yield self.finding(
+                        ctx, node,
+                        f"`{name}(...)` constructed outside parallel/: "
+                        "this hardcodes a mesh layout at the call site; "
+                        "route it through a parallel.mesh helper so the "
+                        "layout rules stay auditable in one place")
 
 
 # The concurrency rules (JX017/JX018) live in their own module with the

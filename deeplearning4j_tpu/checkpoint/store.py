@@ -435,14 +435,15 @@ def restore_checkpoint(path: str, net=None, mesh=None,
 
     p_sh = u_sh = s_sh = None
     if mesh is not None:
-        p_sh = mesh_mod.param_shardings(net.params_tree, mesh, model_axis)
+        p_sh = mesh_mod.param_shardings(net.params_tree, mesh, model_axis,
+                                        net=net)
         if net.opt_state is not None:
-            u_sh = mesh_mod.param_shardings(net.opt_state, mesh, model_axis)
+            u_sh = mesh_mod.param_shardings(net.opt_state, mesh, model_axis,
+                                            net=net)
         if net.state:
             import jax
-            from jax.sharding import NamedSharding, PartitionSpec as P
 
-            repl = NamedSharding(mesh, P())
+            repl = mesh_mod.replicated(mesh)
             s_sh = jax.tree_util.tree_map(lambda _: repl, net.state)
 
     net.params_tree = _restore_tree(net.params_tree, _PARAMS, index, path,

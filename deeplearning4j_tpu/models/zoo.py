@@ -493,7 +493,7 @@ class DecodeStepper:
     program.
     """
 
-    def __init__(self, cg, slots: int):
+    def __init__(self, cg, slots: int, context=None):
         import jax
 
         if slots < 1:
@@ -504,6 +504,15 @@ class DecodeStepper:
         self._declared = cg._declared_state()
         self._state = None  # batched rnn overlay; allocated on first install
         self._rng0 = jax.random.PRNGKey(0)
+        # Tensor-parallel serving (`PERF.md §28`): a ParallelContext whose
+        # model axis the caller already sharded `cg.params_tree` over
+        # (`parallel/mesh.shard_params`). Every prefill/step dispatch runs
+        # inside it, so the jit cache + AOT fingerprints key the sharded
+        # program distinctly and the traced layers see the mesh. The
+        # dispatch inputs carry explicit NamedShardings (params from
+        # shard_params, KV overlay from `_alloc`), so one decode step
+        # compiles to ONE GSPMD program with XLA-inserted collectives.
+        self.context = context
         # Multi-tenant serving (serving/scheduler.py): an adapter-merged
         # params tree substituted for `cg.params_tree` on the next
         # dispatches. Params are jit ARGUMENTS, not statics, so swapping
@@ -519,6 +528,17 @@ class DecodeStepper:
     def _params(self):
         return (self.cg.params_tree if self.params_override is None
                 else self.params_override)
+
+    def _in_context(self):
+        """Context manager active around every jitted dispatch: installs
+        the stepper's ParallelContext (no-op wrapper when unsharded, so an
+        externally-installed context is left alone)."""
+        from contextlib import nullcontext
+
+        from deeplearning4j_tpu.parallel.context import parallel_context
+
+        return (parallel_context(self.context) if self.context is not None
+                else nullcontext())
 
     # -- prompt path ------------------------------------------------------
 
@@ -546,9 +566,10 @@ class DecodeStepper:
                 f"decode cache capacity {self.capacity}")
         x = np.zeros((1, pad_to, 1), np.float32)
         x[0, :n, 0] = ids
-        fn = self.cg._get_jit("output", train=False, keep_rnn_state=True)
-        outs, new_state = fn(self._params(), self.cg.state,
-                             [jnp.asarray(x)], None, self._rng0)
+        with self._in_context():
+            fn = self.cg._get_jit("output", train=False, keep_rnn_state=True)
+            outs, new_state = fn(self._params(), self.cg.state,
+                                 [jnp.asarray(x)], None, self._rng0)
         rnn = rnn_mod.split_rnn_state(new_state, self._declared)
         # Rewind every cursor from pad_to to the real length.
         rnn = {layer: {k: (jnp.int32(n) if jnp.ndim(v) == 0 else v)
@@ -616,10 +637,11 @@ class DecodeStepper:
 
         if self._state is None:
             raise RuntimeError("no sequence installed; call prefill/install")
-        fn = self.cg._get_jit("output", train=False, keep_rnn_state=True)
-        state = rnn_mod.merge_rnn_state(self.cg.state, self._state)
-        outs, new_state = fn(self._params(), state,
-                             [jnp.asarray(x)], None, self._rng0)
+        with self._in_context():
+            fn = self.cg._get_jit("output", train=False, keep_rnn_state=True)
+            state = rnn_mod.merge_rnn_state(self.cg.state, self._state)
+            outs, new_state = fn(self._params(), state,
+                                 [jnp.asarray(x)], None, self._rng0)
         self._state = rnn_mod.split_rnn_state(new_state, self._declared)
         out = np.asarray(outs[0])
         return out if out.ndim == 3 else out[:, None, :]
@@ -695,10 +717,10 @@ class PagedDecodeStepper(DecodeStepper):
     """
 
     def __init__(self, cg, slots: int, page_size: int = 64,
-                 pages: int = None):
+                 pages: int = None, context=None):
         from deeplearning4j_tpu.models.kv_pool import KVPagePool
 
-        super().__init__(cg, slots)
+        super().__init__(cg, slots, context=context)
         self.pool = KVPagePool(slots=self.slots, capacity=self.capacity,
                                page_size=page_size, pages=pages)
         self.page_size = self.pool.page_size
@@ -711,25 +733,61 @@ class PagedDecodeStepper(DecodeStepper):
             "pages": self.pool.num_pages, "slots": self.slots,
         }
 
+    def _page_sharding(self, n_heads: int):
+        """NamedSharding for `[pages, page_size, H, Dh]` storage under the
+        stepper's context, or None when unsharded (no context/model axis,
+        or heads don't divide the axis — then pages replicate, exactly
+        like the misaligned layer's params)."""
+        ctx = self.context
+        if ctx is None or ctx.model_axis is None:
+            return None
+        n = ctx.axis_size("model")
+        if n <= 1 or n_heads % n:
+            return None
+        from deeplearning4j_tpu.parallel import mesh as mesh_mod
+
+        return mesh_mod.kv_page_sharding(ctx.mesh, 4, ctx.model_axis)
+
     def _alloc(self, template):
+        import jax
         import jax.numpy as jnp
 
         page, P = self.page_size, self.pool.num_pages
         self._state, self._attn_layers = {}, []
+        repl = None
+        if self.context is not None:
+            from deeplearning4j_tpu.parallel import mesh as mesh_mod
+
+            repl = mesh_mod.replicated(self.context.mesh)
+
+        def put(a, sharding):
+            # Explicit placement is the GSPMD in-spec: page storage
+            # partitions on the head dim, cursors/tables replicate, and
+            # the jitted step inherits the layout (computation follows
+            # data). Unsharded steppers keep plain uncommitted arrays.
+            if sharding is not None:
+                return jax.device_put(a, sharding)
+            return a if repl is None else jax.device_put(a, repl)
+
         for layer, s in template.items():
             if "k_cache" in s:
                 k, v = s["k_cache"], s["v_cache"]
+                ps = self._page_sharding(k.shape[2])
                 self._state[layer] = {
-                    "k_pages": jnp.zeros((P, page) + k.shape[2:], k.dtype),
-                    "v_pages": jnp.zeros((P, page) + v.shape[2:], v.dtype),
-                    "kv_pos": jnp.zeros((self.slots,), jnp.int32),
+                    "k_pages": put(
+                        jnp.zeros((P, page) + k.shape[2:], k.dtype), ps),
+                    "v_pages": put(
+                        jnp.zeros((P, page) + v.shape[2:], v.dtype), ps),
+                    "kv_pos": put(
+                        jnp.zeros((self.slots,), jnp.int32), None),
                 }
                 self._attn_layers.append(layer)
             else:
                 self._state[layer] = {
-                    kk: jnp.zeros((self.slots,), jnp.int32)
+                    kk: put(jnp.zeros((self.slots,), jnp.int32), None)
                     if jnp.ndim(vv) == 0
-                    else jnp.zeros((self.slots,) + vv.shape[1:], vv.dtype)
+                    else put(jnp.zeros((self.slots,) + vv.shape[1:],
+                                       vv.dtype), None)
                     for kk, vv in s.items()
                 }
 
@@ -825,5 +883,13 @@ class PagedDecodeStepper(DecodeStepper):
                 s["k_pages"] = s["k_pages"].at[dst].set(s["k_pages"][src])
                 s["v_pages"] = s["v_pages"].at[dst].set(s["v_pages"][src])
         pt = jnp.asarray(self.pool.table)
+        if self.context is not None:
+            import jax
+
+            from deeplearning4j_tpu.parallel import mesh as mesh_mod
+
+            # Host-authoritative table, replicated on every chip: the
+            # paged gather/scatter indexes it shard-locally.
+            pt = jax.device_put(pt, mesh_mod.replicated(self.context.mesh))
         for layer in self._attn_layers:
             self._state[layer]["page_table"] = pt

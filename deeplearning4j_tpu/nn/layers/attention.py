@@ -150,6 +150,21 @@ def self_attention_apply(conf, params, state, x, *, rng=None, train=False,
             k.reshape(B * T, H, Dh))
         vp = vp.at[phys.reshape(-1), off.reshape(-1)].set(
             v.reshape(B * T, H, Dh))
+        ctx = current_context()
+        if (ctx is not None and ctx.model_axis is not None
+                and ctx.axis_size("model") > 1
+                and H % ctx.axis_size("model") == 0):
+            # Tensor-parallel decode (PERF.md §28): pin the page storage to
+            # its head partitioning THROUGH the scatter, so XLA never
+            # round-trips pages to a replicated layout between steps — q/k/v
+            # arrive head-sharded from the column-parallel projections, the
+            # scatter and the paged read stay shard-local, and the step's
+            # only collective is Wo's row-parallel all-reduce.
+            from deeplearning4j_tpu.parallel import mesh as _mesh_mod
+
+            _pin = _mesh_mod.kv_page_sharding(ctx.mesh, 4, ctx.model_axis)
+            kp = jax.lax.with_sharding_constraint(kp, _pin)
+            vp = jax.lax.with_sharding_constraint(vp, _pin)
         o = _fa.paged_decode_attention(q, kp, vp, pt, pos, conf.causal)
         out = o.reshape(B, T, conf.n_out) @ params["Wo"] + params["oB"]
         out = activations.resolve(conf.activation)(out)

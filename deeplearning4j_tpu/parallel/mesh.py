@@ -16,11 +16,24 @@ Axes:
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu import observability as _obs
+
+#: Every ≥`min_shard_size` 2-D leaf the sharding rules left fully
+#: replicated. A big matrix silently falling through the divisibility
+#: gates (odd head count, misaligned vocab) costs full-copy HBM on every
+#: chip — this counter makes that visible on /metrics instead of only in
+#: an OOM three layers later. Incremented by `shard_params`; use
+#: `describe_shardings` to see WHICH leaves.
+M_REPLICATED_LEAVES = _obs.metrics.counter(
+    "dl4j_params_replicated_leaves",
+    "Large (>=min_shard_size) 2-D param leaves left fully replicated by "
+    "param_shardings rules")
 
 
 def create_mesh(
@@ -97,15 +110,90 @@ def batch_shardings(mesh: Mesh, tree, axis: str = "data"):
     )
 
 
+def _layer_confs(net) -> Dict[str, object]:
+    """Param-tree top-level key -> layer conf, for either engine (layer key
+    for MultiLayerNetwork, vertex name for ComputationGraph)."""
+    found: Dict[str, object] = {}
+    layers = getattr(net, "layers", None)
+    if layers is not None:
+        for lk, layer in zip(net.layer_keys, layers):
+            found[lk] = layer
+    for name, v in (getattr(net, "layer_vertices", None) or {}).items():
+        found[name] = v.layer
+    return found
+
+
+#: Layer conf class names whose params stay replicated on purpose: small
+#: per-feature vectors (norms) and token tables (embeddings — the decode
+#:  path gathers one row per token, so splitting the vocab dim buys an
+#: all-gather per step for ~nothing at serving batch sizes).
+_REPLICATED_LAYER_TYPES = frozenset({
+    "EmbeddingLayer", "BatchNormalization", "LocalResponseNormalization",
+    "ActivationLayer", "DropoutLayer",
+})
+
+
+def _layer_param_specs(conf, axis_size: int,
+                       model_axis: str) -> Optional[Dict[str, P]]:
+    """Megatron-style per-param PartitionSpecs for one layer conf, or None
+    when this layer type has no head-aware rule (caller falls back to the
+    generic divisibility rule). A returned dict may still map a param to
+    P() — that's an INTENTIONAL replication, not a fallback."""
+    kind = type(conf).__name__
+    if kind == "SelfAttentionLayer":
+        # Head-aligned: column-splitting Wq/Wk/Wv's last dim by the axis
+        # size keeps whole heads per shard only when n_heads divides, and
+        # the attention kernel reshapes to [B, T, H, Dh] — a non-aligned
+        # split would slice through a head. Wo is row-parallel (its input
+        # is the head-sharded concat); XLA all-reduces the partial sums.
+        if getattr(conf, "n_heads", 0) % axis_size:
+            return None
+        return {
+            "Wq": P(None, model_axis), "qB": P(model_axis),
+            "Wk": P(None, model_axis),
+            "Wv": P(None, model_axis), "vB": P(model_axis),
+            "Wo": P(model_axis, None), "oB": P(),
+        }
+    if kind in _REPLICATED_LAYER_TYPES:
+        return {pn: P() for pn in conf.param_shapes()}
+    if kind == "DenseLayer":
+        n_in = getattr(conf, "n_in", 0)
+        n_out = getattr(conf, "n_out", 0)
+        if n_out >= n_in and n_out % axis_size == 0:
+            # Expanding matmul (an MLP up-projection): column-parallel,
+            # bias shards with the output features.
+            return {"W": P(None, model_axis), "b": P(model_axis)}
+        if n_in % axis_size == 0:
+            # Contracting matmul (MLP down-projection): row-parallel over
+            # the already-sharded input features; the bias is added after
+            # the all-reduce, so it replicates.
+            return {"W": P(model_axis, None), "b": P()}
+        return None
+    return None
+
+
 def param_shardings(params, mesh: Mesh, model_axis: Optional[str] = None,
-                    min_shard_size: int = 2048):
+                    min_shard_size: int = 2048, net=None):
     """Sharding pytree for params: replicated by default; with `model_axis`,
     2-D weight matrices whose output dim divides the axis size (and is big
     enough to be worth sharding) split along their last dim (Megatron-style
-    column parallel — XLA inserts the matching collectives)."""
-    axis_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get(model_axis, 1)
+    column parallel — XLA inserts the matching collectives).
 
-    def rule(a):
+    With `net`, the rules become layer-aware: attention QKV/output
+    projections partition on heads (column/row-parallel, gated on
+    `n_heads % axis_size == 0`), DenseLayer matmuls split column-wise when
+    expanding and row-wise when contracting, and embeddings/norms stay
+    replicated — the layout PERF.md §28 documents. Layers without a
+    specific rule fall back to the generic last-dim divisibility rule."""
+    axis_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get(model_axis, 1)
+    by_layer: Dict[str, Dict[str, P]] = {}
+    if net is not None and model_axis is not None and axis_size > 1:
+        for key, conf in _layer_confs(net).items():
+            specs = _layer_param_specs(conf, axis_size, model_axis)
+            if specs is not None:
+                by_layer[key] = specs
+
+    def generic(a):
         if (
             model_axis is not None
             and axis_size > 1
@@ -117,7 +205,72 @@ def param_shardings(params, mesh: Mesh, model_axis: Optional[str] = None,
             return NamedSharding(mesh, P(*([None] * (a.ndim - 1)), model_axis))
         return NamedSharding(mesh, P())
 
-    return jax.tree_util.tree_map(rule, params)
+    def rule(path, a):
+        for i, k in enumerate(path):
+            specs = by_layer.get(getattr(k, "key", None))
+            if specs is None:
+                continue
+            # Updater state mirrors the param dict, so the param name is
+            # somewhere below the layer key even when slots nest deeper.
+            for k2 in path[i + 1:]:
+                spec = specs.get(getattr(k2, "key", None))
+                if spec is not None:
+                    return NamedSharding(mesh, spec)
+            break
+        return generic(a)
+
+    return jax.tree_util.tree_map_with_path(rule, params)
+
+
+def describe_shardings(net, mesh: Mesh, model_axis: Optional[str] = None,
+                       min_shard_size: int = 2048) -> List[dict]:
+    """Per-leaf layout report for `shard_params(net, mesh, ...)` — what
+    WOULD be placed where. Each row: ``{path, shape, bytes, spec,
+    replicated, large_replicated}``; `large_replicated` marks the leaves
+    `dl4j_params_replicated_leaves` counts (≥ min_shard_size elements,
+    ndim ≥ 2, fully replicated) — the "is 90% of my HBM secretly on every
+    chip" question answered in one call."""
+    ps = param_shardings(net.params_tree, mesh, model_axis,
+                         min_shard_size=min_shard_size, net=net)
+    rows: List[dict] = []
+    flat, _ = jax.tree_util.tree_flatten_with_path(net.params_tree)
+    flat_s = jax.tree_util.tree_leaves(
+        ps, is_leaf=lambda s: isinstance(s, NamedSharding))
+    for (path, a), s in zip(flat, flat_s):
+        spec = s.spec if isinstance(s, NamedSharding) else P()
+        replicated = all(dim is None for dim in spec)
+        rows.append({
+            "path": jax.tree_util.keystr(path),
+            "shape": tuple(getattr(a, "shape", ())),
+            "bytes": int(getattr(a, "nbytes", 0)),
+            "spec": str(spec),
+            "replicated": replicated,
+            "large_replicated": bool(
+                replicated and getattr(a, "ndim", 0) >= 2
+                and int(np.prod(getattr(a, "shape", (0,)))) >= min_shard_size),
+        })
+    return rows
+
+
+def axis_sharding(mesh: Mesh, ndim: int, dim: int,
+                  axis: Optional[str]) -> NamedSharding:
+    """Partition one dimension over `axis`, replicate the rest (the
+    single construction seam layer/stepper code goes through — tpulint
+    JX020 keeps NamedSharding construction inside parallel/)."""
+    spec = [None] * ndim
+    if axis is not None:
+        spec[dim] = axis
+    return NamedSharding(mesh, P(*spec))
+
+
+def kv_page_sharding(mesh: Mesh, ndim: int,
+                     model_axis: Optional[str]) -> NamedSharding:
+    """Paged KV storage `[pages, page_size, H, Dh]`: partition the head
+    dim (2) over the model axis — the same split the attention QKV
+    column-parallel rules give q/k/v, so the paged scatter + decode
+    attention run shard-local with zero KV collectives. Page tables,
+    refcounts and cursors stay replicated/host-side."""
+    return axis_sharding(mesh, ndim, 2, model_axis)
 
 
 def _moe_layers(net) -> Dict[str, object]:
@@ -160,7 +313,10 @@ def shard_params(net, mesh: Mesh, model_axis: Optional[str] = None,
             placed = own_on_device(placed)
         return placed
 
-    ps = param_shardings(net.params_tree, mesh, model_axis)
+    ps = param_shardings(net.params_tree, mesh, model_axis, net=net)
+    for row in describe_shardings(net, mesh, model_axis):
+        if row["large_replicated"]:
+            M_REPLICATED_LEAVES.inc()
     moe = _moe_layers(net) if expert_axis in mesh.shape else {}
     for lk, layer in moe.items():
         for pn in ("w1", "b_1", "w2", "b_2"):
@@ -169,7 +325,7 @@ def shard_params(net, mesh: Mesh, model_axis: Optional[str] = None,
                 mesh, P(expert_axis, *([None] * (a.ndim - 1))))
     net.params_tree = jax.tree_util.tree_map(put, net.params_tree, ps)
     if net.opt_state is not None:
-        os_shard = param_shardings(net.opt_state, mesh, model_axis)
+        os_shard = param_shardings(net.opt_state, mesh, model_axis, net=net)
         expert_param_names = {"w1", "b_1", "w2", "b_2"}
         for lk in moe:
             # Updater state mirrors the param dict (tree_map(zeros_like)),

@@ -58,6 +58,16 @@ ROLE_WARMING = "replica:warming"
 ROLE_DRAINING = "replica:draining"
 
 
+def shard_role(shard_index: int, shard_count: int, state: str = "") -> str:
+    """Role string for one member of a tensor-parallel shard group:
+    ``replica:shard<i>/<n>`` (+ ``:warming`` / ``:draining``). The shard
+    topology rides the coordinator's ONLY per-member metadata plane — the
+    role string — so the router can reassemble groups from membership
+    alone (`router.parse_replica_role`), with no new coordinator RPCs."""
+    base = f"replica:shard{int(shard_index)}/{int(shard_count)}"
+    return f"{base}:{state}" if state else base
+
+
 def compiles_total() -> int:
     """Process-total `dl4j_xla_compiles_total` (0 when the jax compile
     hook isn't installed) — the number the rolling-update ledger and the
@@ -79,6 +89,7 @@ class ReplicaServer:
 
     def __init__(self, coordinator_address: str, *, name: str = "replica",
                  net=None, path=None, replica_index: int = 0,
+                 shard_index: int = 0, shard_count: int = 1,
                  host: str = "127.0.0.1", port: int = 0,
                  heartbeat_s: Optional[float] = None, warm: bool = True,
                  fault_plan: Optional[FaultPlan] = None,
@@ -89,6 +100,27 @@ class ReplicaServer:
                              "checkpoint path")
         self.coordinator_address = str(coordinator_address)
         self.name = str(name)
+        self.shard_index = int(shard_index)
+        self.shard_count = int(shard_count)
+        if self.shard_count > 1:
+            if not (0 <= self.shard_index < self.shard_count):
+                raise ValueError(
+                    f"shard_index {shard_index} out of range for "
+                    f"shard_count {shard_count}")
+            # Group identity = the member-name prefix before '#': every
+            # member of group "lm" is "lm#<i>", so peers find each other in
+            # the membership table by name alone.
+            if "#" not in self.name:
+                self.name = f"{self.name}#{self.shard_index}"
+            self.role_live = shard_role(self.shard_index, self.shard_count)
+            self.role_warming = shard_role(self.shard_index,
+                                           self.shard_count, "warming")
+            self.role_draining = shard_role(self.shard_index,
+                                            self.shard_count, "draining")
+        else:
+            self.role_live = ROLE_LIVE
+            self.role_warming = ROLE_WARMING
+            self.role_draining = ROLE_DRAINING
         self.replica_index = int(replica_index)
         self.warm = bool(warm)
         self.heartbeat_s = (HEARTBEAT_S if heartbeat_s is None
@@ -117,6 +149,15 @@ class ReplicaServer:
         # temporary _draining a rolling update sets and clears.
         self._terminating = False
         self._reloading = False
+        # Sharded-group failure plane (shard_count > 1 only): the peer
+        # watchdog sets _group_failed when a sibling shard dies hard, the
+        # admission seam then 503s new work and the schedulers fail
+        # in-flight generations (-> router 502, never a hang or a silently
+        # truncated completion).
+        self._group_failed: Optional[str] = None
+        self._peer_watch: Optional[threading.Thread] = None
+        self._peer_roles: Dict[str, str] = {}
+        self._peers_armed = False
         self._fault_handlers: Dict[str, Callable[[Fault], None]] = {
             "kill_replica": lambda f: os._exit(137),
             "hang_replica": self._on_hang_fault,
@@ -136,17 +177,22 @@ class ReplicaServer:
         self.server.start()
         worker_id = f"{self.name}@{self.server.host}:{self.server.port}"
         self.client = CoordinatorClient(self.coordinator_address, worker_id,
-                                        role=ROLE_WARMING)
-        self.client.join(role=ROLE_WARMING)
+                                        role=self.role_warming)
+        self.client.join(role=self.role_warming)
         self.client.start_heartbeats(self.heartbeat_s)
         _fev.record_event("replica_warming", replica=self.name,
                           url=self.url)
         if self.warm:
             self._warm_all()
         self.server._ready.set()
-        self.client.join(role=ROLE_LIVE)
+        self.client.join(role=self.role_live)
         _fev.record_event("replica_join", replica=self.name, url=self.url)
         self._install_sigterm()
+        if self.shard_count > 1:
+            self._peer_watch = threading.Thread(
+                target=self._watch_peers, name="dl4j-shard-peer-watch",
+                daemon=True)
+            self._peer_watch.start()
         return self
 
     def _warm_all(self) -> None:
@@ -207,6 +253,13 @@ class ReplicaServer:
             time.sleep(min(remaining, 0.05))
         if self._slow_ms > 0:
             time.sleep(self._slow_ms / 1000.0)
+        if self._group_failed is not None:
+            # A sibling shard is gone: this member cannot produce a correct
+            # answer on its own, and refusing BEFORE admission is the clean
+            # failover signal (503; the router retries another unit).
+            raise ReplicaDrainingError(
+                f"shard group for {self.name!r} lost a member "
+                f"({self._group_failed}); retry another replica")
         if self._draining.is_set():
             raise ReplicaDrainingError(
                 f"replica {self.name!r} is draining; retry another replica")
@@ -221,6 +274,91 @@ class ReplicaServer:
     def inflight(self) -> int:
         with self._cond:
             return self._inflight
+
+    # -------------------------------------------------------- shard group
+
+    @property
+    def group(self) -> Optional[str]:
+        """Group id (member-name prefix before '#'); None when unsharded."""
+        if self.shard_count <= 1:
+            return None
+        return self.name.rsplit("#", 1)[0]
+
+    def _watch_peers(self) -> None:
+        """Sharded-group death watchdog (runs only when shard_count > 1).
+
+        Polls coordinator membership at heartbeat cadence for the sibling
+        shards (same name prefix). Arms once the FULL group has been seen
+        live together; after that, a peer that vanishes without ever
+        showing the draining role — or whose lease goes stale past half
+        the reap threshold — is a hard death. A decode step that spans the
+        group cannot complete correctly once a member is gone, so the
+        watchdog fails in-flight generations immediately
+        (`scheduler.abort_inflight` -> 500 -> router `PartialFailureError`
+        502: an explicit error, never a hang or a silently truncated
+        completion) and flips `_group_failed` so new work is refused with
+        a pre-admission 503. Group-wide routability decays on its own:
+        the dead member's lease expiry removes it from the table, and the
+        router requires a COMPLETE live group to route."""
+        prefix = self.group + "#"
+        while not self._stopped.wait(self.heartbeat_s):
+            if self._draining.is_set() or self._terminating:
+                return
+            if self._group_failed is not None:
+                return
+            try:
+                doc = self.client.status()
+            except Exception:
+                continue  # coordinator unreachable: peers may be fine
+            detail = doc.get("detail", {})
+            lost_after_s = float(doc.get("lost_after_s", 15.0))
+            seen: Dict[str, str] = {}
+            stale: Dict[str, float] = {}
+            for wid in doc.get("members", []):
+                member_name = wid.partition("@")[0]
+                if member_name == self.name \
+                        or not member_name.startswith(prefix):
+                    continue
+                info = detail.get(wid, {})
+                seen[wid] = str(info.get("role", ""))
+                stale[wid] = float(info.get("lease_age_s", 0.0))
+            if not self._peers_armed:
+                live_peers = sum(
+                    1 for role in seen.values()
+                    if role and not role.endswith((":warming", ":draining")))
+                if live_peers >= self.shard_count - 1:
+                    self._peers_armed = True
+                    self._peer_roles = dict(seen)
+                continue
+            for wid, last_role in list(self._peer_roles.items()):
+                if last_role.endswith(":draining"):
+                    # Clean goodbye in progress (retire / rolling update):
+                    # its disappearance later is NOT a death.
+                    if wid not in seen:
+                        self._peer_roles.pop(wid)
+                    continue
+                if wid not in seen:
+                    self._on_peer_lost(wid, "lease-reaped")
+                    return
+                if stale.get(wid, 0.0) >= 0.5 * lost_after_s:
+                    self._on_peer_lost(wid, "lease stale")
+                    return
+            self._peer_roles.update(seen)
+
+    def _on_peer_lost(self, wid: str, why: str) -> None:
+        reason = (f"shard group {self.group!r} lost member "
+                  f"{wid.partition('@')[0]!r} ({why})")
+        self._group_failed = reason
+        _fev.record_event("shard_peer_lost", replica=self.name,
+                          peer=wid, why=why)
+        for name in self.server.models.names():
+            try:
+                model = self.server.models.get(name)
+            except Exception:
+                continue
+            sched = getattr(model, "scheduler", None)
+            if sched is not None:
+                sched.abort_inflight(reason)
 
     # -------------------------------------------------------------- faults
 
@@ -279,7 +417,7 @@ class ReplicaServer:
         _fev.record_event("replica_draining", replica=self.name)
         if self.client is not None:
             try:
-                self.client.join(role=ROLE_DRAINING)
+                self.client.join(role=self.role_draining)
             except Exception:
                 pass  # coordinator gone: still drain locally
         self._wait_inflight_zero(timeout_s if timeout_s is not None
@@ -319,7 +457,7 @@ class ReplicaServer:
         self._draining.set()
         if self.client is not None:
             try:
-                self.client.join(role=ROLE_DRAINING)
+                self.client.join(role=self.role_draining)
             except Exception:
                 pass
         self._wait_inflight_zero(self.drain_timeout_s)
@@ -364,7 +502,7 @@ class ReplicaServer:
         elif error is None or restored:
             self._draining.clear()
             if self.client is not None:
-                self.client.join(role=ROLE_LIVE)
+                self.client.join(role=self.role_live)
         seconds = round(time.monotonic() - t0, 4)
         if error is not None:
             _fev.record_event("rolling_update_failed", replica=self.name,
@@ -431,7 +569,8 @@ class FleetManager:
 
     def spawn(self, name: Optional[str] = None, port: int = 0,
               replica_index: Optional[int] = None,
-              extra_env: Optional[Dict[str, str]] = None) -> str:
+              extra_env: Optional[Dict[str, str]] = None,
+              extra_args: Optional[List[str]] = None) -> str:
         idx = self._next_index if replica_index is None else int(
             replica_index)
         self._next_index = max(self._next_index, idx) + 1
@@ -444,6 +583,7 @@ class FleetManager:
         if self.heartbeat_s is not None:
             cmd += ["--heartbeat-s", str(self.heartbeat_s)]
         cmd += self.extra_args
+        cmd += list(extra_args or [])
         env = dict(os.environ)
         env.update(self.env)
         env.update(extra_env or {})
@@ -455,6 +595,29 @@ class FleetManager:
             cmd, env=env, stdout=stdout,
             stderr=subprocess.STDOUT if stdout is not None else None)
         return name
+
+    def spawn_group(self, group: str, shards: int, *,
+                    model_parallel: Optional[int] = None,
+                    extra_env: Optional[Dict[str, str]] = None,
+                    extra_args: Optional[List[str]] = None) -> List[str]:
+        """Spawn one tensor-parallel shard group: `shards` member
+        processes named ``<group>#<i>`` carrying ``replica:shard<i>/<n>``
+        roles. The router treats the group as ONE routable unit (entry =
+        shard 0); health is the AND of every member's lease. On CPU each
+        member emulates its shard over a local host-device mesh, so
+        `model_parallel` (default = `shards`) is forced into the child's
+        XLA_FLAGS before its backends initialize."""
+        ways = shards if model_parallel is None else int(model_parallel)
+        env = dict(extra_env or {})
+        names: List[str] = []
+        for i in range(int(shards)):
+            args = ["--shard-index", str(i), "--shard-count", str(shards)]
+            if ways > 1:
+                args += ["--model-parallel", str(ways)]
+            args += list(extra_args or [])
+            names.append(self.spawn(name=f"{group}#{i}",
+                                    extra_env=env, extra_args=args))
+        return names
 
     def alive(self) -> Dict[str, bool]:
         return {n: p.poll() is None for n, p in self.procs.items()}
@@ -499,48 +662,78 @@ class FleetManager:
         A replica whose reload FAILS (``ok=False`` or an HTTP error from
         the reload endpoint) ABORTS the rollout: the same checkpoint would
         fail identically on every remaining replica, and continuing would
-        walk the whole fleet into the same bad deploy."""
+        walk the whole fleet into the same bad deploy.
+
+        Sharded groups roll as ONE unit: every member of the group is
+        reloaded before the rollout moves on, and the group counts as
+        rejoined only when ALL its members are live again — the router
+        refuses to route to a partially-updated group (it is incomplete
+        the whole time), so a generation can never straddle two
+        checkpoint versions of one model."""
         from deeplearning4j_tpu.serving.router import post_json
 
         results: Dict[str, Any] = {}
         deadline = time.monotonic() + timeout_s
-        for row in router.table():
+        rows = router.table()
+        done_groups: set = set()
+        for row in rows:
             if row["state"] != "live":
                 continue
-            try:
-                summary = post_json(
-                    row["url"] + "/admin/reload", {"path": str(new_path)},
-                    timeout_s=timeout_s)
-            except urllib.error.HTTPError as e:
-                # The reload endpoint itself errored (bad checkpoint,
-                # replica terminating, ...). HTTPError subclasses OSError,
-                # so catch it FIRST — this is a failed deploy, not a dead
-                # replica, and it must stop the rollout.
-                results[row["name"]] = {"ok": False,
-                                        "error": f"HTTP {e.code}"}
-                _fev.record_event("rolling_update_aborted",
-                                  replica=row["name"],
-                                  error=f"HTTP {e.code}")
+            group = row.get("group")
+            if group is None:
+                unit = [row]
+            else:
+                if group in done_groups:
+                    continue
+                done_groups.add(group)
+                unit = sorted(
+                    (r for r in rows if r.get("group") == group),
+                    key=lambda r: r.get("shard_index") or 0)
+            aborted = False
+            for member in unit:
+                try:
+                    summary = post_json(
+                        member["url"] + "/admin/reload",
+                        {"path": str(new_path)}, timeout_s=timeout_s)
+                except urllib.error.HTTPError as e:
+                    # The reload endpoint itself errored (bad checkpoint,
+                    # replica terminating, ...). HTTPError subclasses
+                    # OSError, so catch it FIRST — this is a failed
+                    # deploy, not a dead replica, and it must stop the
+                    # rollout.
+                    results[member["name"]] = {"ok": False,
+                                               "error": f"HTTP {e.code}"}
+                    _fev.record_event("rolling_update_aborted",
+                                      replica=member["name"],
+                                      error=f"HTTP {e.code}")
+                    aborted = True
+                    break
+                except OSError as e:
+                    # The replica died between the table snapshot and its
+                    # turn (its lease may not have expired yet, so it
+                    # still read as live). The router discovers that on
+                    # its own; the rollout moves on to the survivors.
+                    results[member["name"]] = {"ok": False,
+                                               "error": str(e)}
+                    continue
+                results[member["name"]] = summary
+                if not summary.get("ok"):
+                    _fev.record_event("rolling_update_aborted",
+                                      replica=member["name"],
+                                      error=str(summary.get("error")))
+                    aborted = True
+                    break
+            if aborted:
                 break
-            except OSError as e:
-                # The replica died between the table snapshot and its turn
-                # (its lease may not have expired yet, so it still read as
-                # live). The router discovers that on its own; the rollout
-                # moves on to the survivors.
-                results[row["name"]] = {"ok": False, "error": str(e)}
-                continue
-            results[row["name"]] = summary
-            if not summary.get("ok"):
-                _fev.record_event("rolling_update_aborted",
-                                  replica=row["name"],
-                                  error=str(summary.get("error")))
-                break
-            # Don't drain the next replica until the router has actually
+            # Don't drain the next unit until the router has actually
             # observed this one back in the live set — otherwise its stale
             # table can briefly show zero routable replicas and shed.
-            while time.monotonic() < deadline:
-                if any(r["name"] == row["name"] and r["state"] == "live"
-                       for r in router.table()):
+            want = {m["name"] for m in unit
+                    if results.get(m["name"], {}).get("ok")}
+            while want and time.monotonic() < deadline:
+                live = {r["name"] for r in router.table()
+                        if r["state"] == "live"}
+                if want <= live:
                     break
                 time.sleep(0.05)
         return results
@@ -694,20 +887,45 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--replica-index", type=int, default=0)
+    ap.add_argument("--shard-index", type=int, default=0)
+    ap.add_argument("--shard-count", type=int, default=1)
+    ap.add_argument("--model-parallel", type=int, default=1)
     ap.add_argument("--heartbeat-s", type=float, default=None)
     ap.add_argument("--max-batch-size", type=int, default=32)
     ap.add_argument("--max-delay-ms", type=float, default=2.0)
     ap.add_argument("--decode-slots", type=int, default=4)
     ap.add_argument("--queue-depth", type=int, default=256)
+    ap.add_argument("--kv-cache", default="dense",
+                    choices=("dense", "paged"))
+    ap.add_argument("--kv-page-size", type=int, default=64)
+    ap.add_argument("--kv-pages", type=int, default=None)
     ap.add_argument("--no-warm", action="store_true")
     args = ap.parse_args(argv)
 
+    if args.model_parallel > 1:
+        # Must land in XLA_FLAGS before jax initializes its backends (a
+        # jax.devices() probe here would itself trigger that init), so
+        # inspect the env var, not the backend.
+        import re
+
+        from deeplearning4j_tpu.parallel.distributed import (
+            force_host_device_count,
+        )
+
+        m = re.search(r"--xla_force_host_platform_device_count=(\d+)",
+                      os.environ.get("XLA_FLAGS", ""))
+        if m is None or int(m.group(1)) < args.model_parallel:
+            force_host_device_count(args.model_parallel)
+
     replica = ReplicaServer(
         args.coordinator, name=args.name, path=args.path,
-        replica_index=args.replica_index, host=args.host, port=args.port,
+        replica_index=args.replica_index, shard_index=args.shard_index,
+        shard_count=args.shard_count, host=args.host, port=args.port,
         heartbeat_s=args.heartbeat_s, warm=not args.no_warm,
         max_batch_size=args.max_batch_size, max_delay_ms=args.max_delay_ms,
-        decode_slots=args.decode_slots, queue_depth=args.queue_depth)
+        decode_slots=args.decode_slots, queue_depth=args.queue_depth,
+        kv_cache=args.kv_cache, kv_page_size=args.kv_page_size,
+        kv_pages=args.kv_pages, model_parallel=args.model_parallel)
     replica.start()
     print(json.dumps({"event": "ready", "name": args.name,
                       "url": replica.url, "pid": os.getpid()}), flush=True)

@@ -41,6 +41,34 @@ def estimate_hbm_bytes(net) -> int:
     return total
 
 
+def per_chip_bytes(tree) -> int:
+    """Summed bytes of ONE device's shard of every leaf — the number that
+    actually hits a single chip's HBM. `leaf.nbytes` is the GLOBAL array
+    size regardless of sharding, so under N-way model parallelism it
+    overstates per-chip residency by ~N; the addressable-shard walk is
+    what the sharded-decode bench gates on."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards:
+            total += int(shards[0].data.nbytes)
+        else:
+            total += int(getattr(leaf, "nbytes", 0))
+    return total
+
+
+def sharding_desc(context=None) -> str:
+    """Operator-facing layout string for `/v1/models` and the
+    `dl4j_serving_model_sharding` info gauge: ``none`` (replicated
+    single-chip serving) or ``model:<n>-way``."""
+    if context is None or context.model_axis is None:
+        return "none"
+    n = context.axis_size("model")
+    return "none" if n <= 1 else f"model:{n}-way"
+
+
 def estimate_checkpoint_bytes(path) -> int:
     """Footprint estimate WITHOUT loading: the COMMIT manifest's summed
     file sizes (sharded store), the latest committed step under a manager
@@ -177,6 +205,11 @@ class ServedModel:
                           else estimate_checkpoint_bytes(path)
                           if path is not None else 0)
         self.dtype = model_dtype(net=net, path=path)
+        # Tensor-parallel serving: the server's `_attach` shards the net
+        # over a model mesh axis and records the ParallelContext + the
+        # operator-facing layout string here (`sharding_desc`).
+        self.context = None
+        self.sharding = "none"
         # name -> {"tree": delta, "rank": int, "bytes": int,
         #          "pinned": bool, "merged": full tree or None (lazy)}
         self.adapters: Dict[str, dict] = {}
@@ -276,6 +309,10 @@ class ModelHost:
             _m.MODEL_DTYPE.labels(model=name, dtype=model.dtype).set(1)
             if model.net is not None and self.on_load is not None:
                 self.on_load(model)
+            # After on_load: the attach hook is what shards the net and
+            # stamps the layout.
+            _m.MODEL_SHARDING.labels(model=name,
+                                     sharding=model.sharding).set(1)
             stoppables = self._enforce_budget(keep=model)
         self._stop_runtimes(stoppables)
         return model
@@ -342,6 +379,8 @@ class ModelHost:
                                           dtype=model.dtype).set(1)
                     if self.on_load is not None:
                         self.on_load(model)
+                    _m.MODEL_SHARDING.labels(
+                        model=model.name, sharding=model.sharding).set(1)
                     stoppables = self._enforce_budget(keep=model)
                 except Exception:
                     # Publish failed (on_load hook, budget enforcement,
@@ -446,6 +485,7 @@ class ModelHost:
                 "hbm_bytes": int(m.hbm_bytes),
                 "hbm_source": m.hbm_source,
                 "dtype": m.dtype,
+                "sharding": m.sharding,
                 "path": m.path,
                 "lm": m.scheduler is not None,
                 "adapters": m.adapter_rows(),

@@ -77,6 +77,14 @@ MODEL_DTYPE = _obs.metrics.gauge(
     "(float32/bfloat16/...). Join on {model} with "
     "dl4j_serving_model_hbm_bytes to attribute HBM by precision",
     label_names=("model", "dtype"))
+MODEL_SHARDING = _obs.metrics.gauge(
+    "dl4j_serving_model_sharding",
+    "Info gauge (value 1): the parameter/KV layout each hosted model "
+    "actually serves — 'none' (replicated single-chip) or "
+    "'model:<n>-way' (tensor-parallel over a model mesh axis). Join on "
+    "{model} with dl4j_serving_model_hbm_bytes: under n-way sharding "
+    "that gauge reports GLOBAL bytes, per-chip is ~1/n",
+    label_names=("model", "sharding"))
 MODELS_RESIDENT = _obs.metrics.gauge(
     "dl4j_serving_models_resident",
     "Hosted models currently resident (loaded) in this process")

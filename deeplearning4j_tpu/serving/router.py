@@ -39,6 +39,7 @@ it) stops receiving fresh traffic after its first timeout.
 from __future__ import annotations
 
 import json
+import re
 import socket
 import threading
 import time
@@ -70,6 +71,25 @@ ROLE_DRAINING = "replica:draining"
 
 _STATE_BY_ROLE = {ROLE_LIVE: "live", ROLE_WARMING: "warming",
                   ROLE_DRAINING: "draining"}
+
+# Tensor-parallel shard-group member (serving/fleet.py shard_role):
+# replica:shard<i>/<n>[:warming|:draining]. The shard topology rides the
+# role string — the coordinator's only per-member metadata plane.
+_SHARD_ROLE_RE = re.compile(
+    r"^replica:shard(\d+)/(\d+)(?::(warming|draining))?$")
+
+
+def parse_replica_role(role: str):
+    """Role string -> (state, shard_index, shard_count), or None for
+    non-replica roles (trainers/routers share the coordinator).
+    Unsharded replicas come back as (state, None, 1)."""
+    state = _STATE_BY_ROLE.get(role)
+    if state is not None:
+        return state, None, 1
+    m = _SHARD_ROLE_RE.match(role or "")
+    if m is None:
+        return None
+    return (m.group(3) or "live"), int(m.group(1)), int(m.group(2))
 
 
 class PartialFailureError(ServingError):
@@ -191,12 +211,20 @@ class ReplicaInfo:
     seen_at: float        # monotonic time of the poll that produced this
     load: float = 0.0     # scraped queue depth + busy decode slots
     scrape_ok: bool = True
+    # Tensor-parallel shard-group membership (None/1/None = unsharded).
+    # A group routes as ONE unit through its shard-0 entry member, and
+    # only while EVERY member is live with a fresh lease.
+    shard_index: Optional[int] = None
+    shard_count: int = 1
+    group: Optional[str] = None
 
     def row(self) -> Dict[str, Any]:
         return {"worker_id": self.worker_id, "name": self.name,
                 "url": self.url, "state": self.state,
                 "lease_age_s": self.lease_age_s, "load": self.load,
-                "scrape_ok": self.scrape_ok}
+                "scrape_ok": self.scrape_ok,
+                "shard_index": self.shard_index,
+                "shard_count": self.shard_count, "group": self.group}
 
 
 class FleetRouter:
@@ -459,18 +487,22 @@ class FleetRouter:
         rows: Dict[str, ReplicaInfo] = {}
         for wid in doc.get("members", []):
             role = detail.get(wid, {}).get("role", "trainer")
-            state = _STATE_BY_ROLE.get(role)
-            if state is None:
+            parsed = parse_replica_role(role)
+            if parsed is None:
                 continue  # trainers/routers share the coordinator
+            state, shard_index, shard_count = parsed
             name, _, addr = wid.partition("@")
             if not addr:
                 continue
+            group = (name.rsplit("#", 1)[0] if shard_index is not None
+                     else None)
             rows[wid] = ReplicaInfo(
                 worker_id=wid, name=name, url=f"http://{addr}",
                 state=state,
                 lease_age_s=float(
                     detail.get(wid, {}).get("lease_age_s", 0.0)),
-                seen_at=now)
+                seen_at=now, shard_index=shard_index,
+                shard_count=shard_count, group=group)
         with self._lock:
             self._lost_after_s = float(
                 doc.get("lost_after_s", self._lost_after_s))
@@ -530,18 +562,45 @@ class FleetRouter:
             return sum(1 for r in self._table.values()
                        if r.state == state)
 
+    def _healthy_groups(self, now: float, stale_cut: float) -> Set[str]:
+        """Shard groups currently routable: EVERY member present (all
+        shard indices 0..n-1), live, lease fresh. Health is the AND of
+        the members' leases — one dead shard makes the whole group
+        unroutable within one lease (the reaper evicts the dead member,
+        completeness breaks). Caller holds self._lock."""
+        members: Dict[str, List[ReplicaInfo]] = {}
+        for r in self._table.values():
+            if r.group is not None:
+                members.setdefault(r.group, []).append(r)
+        healthy: Set[str] = set()
+        for group, rows in members.items():
+            want = max(r.shard_count for r in rows)
+            shards = {r.shard_index for r in rows}
+            if (shards == set(range(want))
+                    and all(r.state == "live"
+                            and (r.lease_age_s + (now - r.seen_at))
+                            <= stale_cut for r in rows)):
+                healthy.add(group)
+        return healthy
+
     def _pick(self, exclude: Set[str]) -> Optional[ReplicaInfo]:
-        """Least-loaded live replica: fresh lease, not quarantined, not
-        already tried by this request. None -> the fleet has no capacity
-        for this request (shed)."""
+        """Least-loaded routable unit: fresh lease, not quarantined, not
+        already tried by this request. A unit is an unsharded live
+        replica OR a complete shard group (picked through its shard-0
+        entry member). None -> the fleet has no capacity for this
+        request (shed)."""
         now = time.monotonic()
         with self._lock:
             stale_cut = self.stale_lease_fraction * self._lost_after_s
+            healthy_groups = self._healthy_groups(now, stale_cut)
             candidates = [
                 r for r in self._table.values()
                 if r.state == "live" and r.worker_id not in exclude
                 and self._quarantine.get(r.worker_id, 0.0) <= now
                 and (r.lease_age_s + (now - r.seen_at)) <= stale_cut
+                and (r.group is None
+                     or (r.shard_index == 0
+                         and r.group in healthy_groups))
             ]
             if not candidates:
                 return None
@@ -660,9 +719,13 @@ class FleetRouter:
                 note_failure(rep)
                 if idempotent:
                     raise _Failover(f"{rep.name}: HTTP {e.code}")
+                # Carry the upstream reason: "HTTP 500" alone hides the
+                # difference between a decode crash and a shard-group
+                # member death, and the caller only gets one shot at it.
                 raise PartialFailureError(
                     f"{route} failed on {rep.name} after admission "
-                    f"(HTTP {e.code}); not retried: non-idempotent")
+                    f"(HTTP {e.code}: {body.get('error')}); "
+                    "not retried: non-idempotent")
             except (OSError, TimeoutError) as e:
                 cause = _unwrap(e)
                 refused = isinstance(cause, ConnectionRefusedError)

@@ -117,7 +117,7 @@ class GenerationScheduler:
                  kv_pages: Optional[int] = None,
                  prefix_cache: Optional[bool] = None,
                  prefix_cache_entries: int = 32,
-                 draft=None, spec_k: int = 4):
+                 draft=None, spec_k: int = 4, context=None):
         from deeplearning4j_tpu.models.zoo import (DecodeStepper,
                                                    PagedDecodeStepper)
 
@@ -133,12 +133,17 @@ class GenerationScheduler:
         self.model_name = model_name
         self.mode = mode
         self.kv = kv
+        # Tensor-parallel serving: the host sharded `cg` over
+        # `context.mesh` at load; the stepper runs every dispatch inside
+        # the context so the whole decode loop serves GSPMD programs.
+        self.context = context
         if kv == "paged":
             self.stepper = PagedDecodeStepper(cg, slots,
                                               page_size=page_size,
-                                              pages=kv_pages)
+                                              pages=kv_pages,
+                                              context=context)
         else:
-            self.stepper = DecodeStepper(cg, slots)
+            self.stepper = DecodeStepper(cg, slots, context=context)
         self.slots = self.stepper.slots
         self.capacity = self.stepper.capacity
         # Draft-model speculative decoding: a second (small) stepper
@@ -168,6 +173,11 @@ class GenerationScheduler:
         # the list of names to warm per-adapter dispatch for.
         self.adapter_params = None
         self.adapter_names = None
+        # Set by `abort_inflight` (a sharded replica group losing a peer):
+        # every active and queued generation fails with this reason at the
+        # next step boundary — the caller gets a clean error instead of a
+        # hang or a silently truncated sequence.
+        self._abort: Optional[str] = None
         self.prompt_buckets = prompt_bucket_ladder(self.capacity,
                                                    prompt_buckets)
         self._queue: "queue.Queue[Optional[GenerationRequest]]" = queue.Queue(
@@ -210,6 +220,19 @@ class GenerationScheduler:
 
     def qsize(self) -> int:
         return self._queue.qsize()
+
+    def abort_inflight(self, reason: str) -> None:
+        """Fail every active and queued generation with `reason` at the
+        next step boundary, and every later submit on arrival, until
+        `clear_abort()`. Used by the sharded-group peer watchdog
+        (`serving/fleet.py`): when a shard member dies, the survivors'
+        in-flight sequences can never finish coherently — surfacing a
+        prompt error beats a hang (the client) or a truncation passed off
+        as completion (the caller's training data)."""
+        self._abort = str(reason)
+
+    def clear_abort(self) -> None:
+        self._abort = None
 
     # ------------------------------------------------------------- warmup
 
@@ -450,6 +473,14 @@ class GenerationScheduler:
         busy_gauge = _m.DECODE_SLOTS_BUSY.labels(model=self.model_name)
         step_hist = _m.DECODE_STEP_SECONDS.labels(model=self.model_name)
         while True:
+            if self._abort is not None and active:
+                # Group failure: fail the batch at this step boundary.
+                for slot, req in list(active.items()):
+                    req.error = self._abort
+                    req.event.set()
+                    self._clear_slot(slot)
+                    free.append(slot)
+                active.clear()
             # Admission happens ONLY here — a step boundary. Continuous
             # mode refills any free slot mid-flight; drain mode waits for
             # the whole batch to finish (the control arm for the bench).
@@ -464,6 +495,10 @@ class GenerationScheduler:
                 if req is None:
                     self._shutdown(active)
                     return
+                if self._abort is not None:
+                    req.error = self._abort
+                    req.event.set()
+                    continue
                 now = time.monotonic()
                 if req.cancelled or (req.deadline is not None
                                      and now > req.deadline):

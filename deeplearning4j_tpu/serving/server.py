@@ -76,6 +76,7 @@ class InferenceServer:
                  kv_pages: Optional[int] = None,
                  prefix_cache: Optional[bool] = None,
                  draft=None, spec_k: int = 4,
+                 model_parallel: int = 1,
                  default_model: str = "default"):
         self.host = host
         self.port = port
@@ -104,7 +105,15 @@ class InferenceServer:
         self.prefix_cache = prefix_cache
         self.draft = draft
         self.spec_k = int(spec_k)
+        # Tensor-parallel serving (PERF.md §28): n > 1 builds a
+        # ("data", "model") mesh over this process's devices at attach
+        # time, shards each hosted model's params over the model axis
+        # (`parallel/mesh.shard_params` head-aware rules) and runs the
+        # decode loop under the matching ParallelContext — per-chip HBM
+        # drops ~1/n and XLA inserts the collectives.
+        self.model_parallel = int(model_parallel)
         self.default_model = default_model
+        self._contexts: dict = {}  # ways -> shared ParallelContext
         self.models = ModelHost(hbm_budget_bytes=hbm_budget_bytes,
                                 on_load=self._attach)
         self._ready = threading.Event()
@@ -153,6 +162,7 @@ class InferenceServer:
                   prefix_cache: object = _UNSET,
                   draft: object = _UNSET,
                   spec_k: Optional[int] = None,
+                  model_parallel: Optional[int] = None,
                   pinned: Optional[bool] = None):
         """Host another model (server-level knobs are the defaults). With
         `path`, the checkpoint loads now and can be LRU-evicted/reloaded
@@ -192,14 +202,52 @@ class InferenceServer:
                              else prefix_cache),
             "draft": (self.draft if draft is _UNSET else draft),
             "spec_k": (self.spec_k if spec_k is None else int(spec_k)),
+            "model_parallel": (self.model_parallel if model_parallel is None
+                               else int(model_parallel)),
         }
         return self.models.add(name, net=net, path=path, pinned=pinned,
                                **opts)
+
+    def _parallel_context(self, ways: int):
+        """The server's ("data", "model") mesh context for `ways`-way
+        tensor parallelism, built once and shared by every model that
+        asks for the same width (one mesh -> one jit-cache/fingerprint
+        identity across models and reloads)."""
+        import jax
+
+        from deeplearning4j_tpu.parallel import mesh as mesh_mod
+        from deeplearning4j_tpu.parallel.context import ParallelContext
+
+        ctx = self._contexts.get(ways)
+        if ctx is None:
+            n_dev = len(jax.devices())
+            if ways > n_dev:
+                raise ValueError(
+                    f"model_parallel={ways} needs {ways} devices; this "
+                    f"process has {n_dev}")
+            mesh = mesh_mod.create_mesh((1, ways), ("data", "model"))
+            ctx = ParallelContext(mesh, model_axis="model")
+            self._contexts[ways] = ctx
+        return ctx
 
     def _attach(self, model) -> None:
         """ModelHost on_load hook: build + start the model's serving
         runtime (runs at add time and again after an eviction reload)."""
         o = model.options
+        ways = int(o.get("model_parallel") or 1)
+        if ways > 1:
+            from deeplearning4j_tpu.parallel import mesh as mesh_mod
+            from deeplearning4j_tpu.serving.host import sharding_desc
+
+            ctx = self._parallel_context(ways)
+            # Restore-onto-mesh: the freshly loaded (or reloaded) params
+            # land sharded before any program traces against them.
+            mesh_mod.shard_params(model.net, ctx.mesh, model_axis="model")
+            model.context = ctx
+            model.sharding = sharding_desc(ctx)
+        else:
+            model.context = None
+            model.sharding = "none"
         model.batcher = ShapeBucketBatcher(
             model.net, model_name=model.name,
             max_batch_size=o["max_batch_size"], buckets=o["batch_buckets"],
@@ -222,7 +270,8 @@ class InferenceServer:
                     kv=o["kv_cache"], page_size=o["kv_page_size"],
                     kv_pages=o["kv_pages"],
                     prefix_cache=o["prefix_cache"],
-                    draft=o["draft"], spec_k=o["spec_k"])
+                    draft=o["draft"], spec_k=o["spec_k"],
+                    context=model.context)
                 model.scheduler.adapter_params = model.adapter_params
                 model.scheduler.adapter_names = (
                     lambda: sorted(model.adapters))

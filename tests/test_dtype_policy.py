@@ -449,6 +449,14 @@ class TestInt8Serving:
                     in scrape
                     or 'dl4j_serving_model_dtype{model="q",dtype="int8"} 1'
                     in scrape)
+            # The sharding info gauge rides the same one-scrape surface:
+            # an unsharded host reports layout 'none' (PR 20 exports
+            # 'model:<n>-way' for tensor-parallel models).
+            assert rows["q"]["sharding"] == "none"
+            assert ('dl4j_serving_model_sharding{model="q",sharding="none"}'
+                    ' 1' in scrape
+                    or 'dl4j_serving_model_sharding{sharding="none",'
+                    'model="q"} 1' in scrape)
         finally:
             server.stop()
 

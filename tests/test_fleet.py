@@ -854,6 +854,54 @@ class TestFleetInProcess:
             coord.close()
 
 
+# ------------------------------------------- sharded-group routing units
+
+
+class TestShardGroupRouting:
+    def test_parse_replica_role(self):
+        from deeplearning4j_tpu.serving.router import parse_replica_role
+
+        assert parse_replica_role("replica") == ("live", None, 1)
+        assert parse_replica_role("replica:warming") == ("warming", None, 1)
+        assert parse_replica_role("replica:shard2/4") == ("live", 2, 4)
+        assert parse_replica_role("replica:shard0/4:draining") == (
+            "draining", 0, 4)
+        assert parse_replica_role("trainer") is None
+
+    def _shard(self, group, i, n, port, state="live", lease=0.0):
+        return ReplicaInfo(
+            worker_id=f"{group}#{i}@127.0.0.1:{port}", name=f"{group}#{i}",
+            url=f"http://127.0.0.1:{port}", state=state,
+            lease_age_s=lease, seen_at=time.monotonic(), load=0.0,
+            shard_index=i, shard_count=n, group=group)
+
+    def test_complete_group_routes_through_its_entry_member(self):
+        r = _router_with([self._shard("g", i, 4, 1000 + i)
+                          for i in range(4)])
+        assert r._pick(exclude=set()).name == "g#0"
+
+    def test_incomplete_group_is_unroutable(self):
+        # Member g#3 missing (lease-reaped): the other three are alive
+        # and fresh, but the UNIT is gone — no candidate at all.
+        r = _router_with([self._shard("g", i, 4, 1000 + i)
+                          for i in range(3)])
+        assert r._pick(exclude=set()) is None
+
+    def test_one_stale_member_lease_fails_the_whole_group(self):
+        rows = [self._shard("g", i, 4, 1000 + i) for i in range(4)]
+        rows[2].lease_age_s = 1e9
+        assert _router_with(rows)._pick(exclude=set()) is None
+        # An unsharded replica alongside the broken group still routes.
+        solo = _info("solo", 2000, load=99.0)
+        assert _router_with(rows + [solo])._pick(
+            exclude=set()).name == "solo"
+
+    def test_warming_member_keeps_group_out_of_rotation(self):
+        rows = [self._shard("g", i, 2, 1000 + i) for i in range(2)]
+        rows[1].state = "warming"
+        assert _router_with(rows)._pick(exclude=set()) is None
+
+
 # ------------------------------------------------- multi-process chaos CI
 
 
@@ -1003,6 +1051,121 @@ class TestFleetChaos:
                   15.0, what="retired replica left the table")
             assert router.load_stats()["dead"] == 0
             assert router.predict(x, timeout_s=15.0).shape == (1, 2)
+        finally:
+            router.stop()
+            manager.stop_all()
+            coord.close()
+
+
+# ------------------------------------- sharded-group multi-process chaos
+
+
+def _lm_ckpt(tmp_path):
+    from deeplearning4j_tpu.models import zoo
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    conf = zoo.transformer_lm(vocab_size=32, t=16, d_model=32, n_heads=4,
+                              n_blocks=2, decode_cache_length=2048)
+    return _save(ComputationGraph(conf).init(), tmp_path / "lm_ckpt")
+
+
+class TestShardGroupChaos:
+    def test_kill_one_member_fails_group_cleanly(self, tmp_path):
+        """Acceptance chaos drill: a 4-process tensor-parallel shard
+        group (one LM, `--model-parallel 4`, paged KV) serves
+        generations as ONE routable unit. SIGKILLing one member
+        mid-decode must (a) surface the in-flight generation as a clean
+        502 (`PartialFailureError`) — never a hang, never a silently
+        truncated completion passed off as success — and (b) make the
+        router drop the whole group from rotation within ~one lease, so
+        new generations shed instead of reaching a broken group."""
+        ckpt = _lm_ckpt(tmp_path)
+        coord = Coordinator(lost_after_s=1.0).start()
+        addr = coord.address
+        manager = FleetManager(addr, ckpt, heartbeat_s=0.25,
+                               env=_sub_env(),
+                               log_dir=str(tmp_path / "logs"))
+        router = FleetRouter(addr, poll_interval_s=0.1,
+                             request_timeout_s=120.0, http=False).start()
+        try:
+            manager.spawn_group("lm", 4, extra_args=[
+                "--decode-slots", "2", "--kv-cache", "paged",
+                "--kv-page-size", "64"])
+            _wait(lambda: sum(1 for r in router.table()
+                              if r["state"] == "live"
+                              and r.get("group") == "lm") == 4,
+                  240.0, what="4 live shard members")
+            rows = {r["name"]: r for r in router.table()}
+            assert rows["lm#0"]["shard_count"] == 4
+            assert rows["lm#0"]["shard_index"] == 0
+            entry_url = rows["lm#0"]["url"]
+
+            # Sanity: the complete group answers end to end (the ids
+            # echo the prompt followed by the generated tokens).
+            out = router.generate([1, 2, 3], 4, timeout_s=120.0,
+                                  temperature=0.0)
+            assert out[:3] == [1, 2, 3] and len(out) == 7
+
+            result = {}
+
+            def long_generate():
+                try:
+                    result["ids"] = router.generate(
+                        [1, 2, 3, 4, 5], 1990, timeout_s=120.0,
+                        temperature=0.0)
+                except Exception as e:
+                    result["error"] = e
+
+            t = threading.Thread(target=long_generate, daemon=True)
+            t.start()
+
+            def decoding():
+                with urllib.request.urlopen(entry_url + "/metrics",
+                                            timeout=5.0) as resp:
+                    text = resp.read().decode()
+                return sum_metric_families(
+                    text, ("dl4j_serving_decode_slots_busy",)) >= 1
+
+            _wait(decoding, 120.0, what="generation admitted to a slot")
+            t_kill = time.monotonic()
+            manager.kill("lm#3")
+
+            # (b) Group unroutable within ~one lease: the survivors'
+            # peer watchdog 503s new admissions and the dead member's
+            # lease expiry breaks group completeness; either way a fresh
+            # generation shows a clean shed, never a broken answer.
+            def group_unroutable():
+                try:
+                    router.generate([9], 2, timeout_s=10.0,
+                                    temperature=0.0)
+                    return False
+                except (ServerOverloadedError, PartialFailureError):
+                    return True
+
+            _wait(group_unroutable, 10.0, every_s=0.05,
+                  what="router drops the broken group")
+            detect_s = time.monotonic() - t_kill
+            assert detect_s < 4.0, detect_s  # ~1.0s lease + poll slack
+
+            # (a) The in-flight generation fails FAST and EXPLICITLY.
+            t.join(30.0)
+            assert not t.is_alive(), "in-flight generation hung"
+            assert "error" in result, (
+                "generation completed despite a dead shard member: "
+                f"{result.get('ids', [])[:8]}...")
+            assert isinstance(result["error"], PartialFailureError), \
+                repr(result["error"])
+            assert "shard group" in str(result["error"])
+
+            # The dead member is lease-reaped; the table shows the
+            # incomplete group and a hard death, and new work sheds.
+            _wait(lambda: "lm#3" not in {r["name"]
+                                         for r in router.table()},
+                  10.0, what="dead member reaped from the table")
+            assert manager.procs["lm#3"].returncode in (-9, 137)
+            with pytest.raises(ServerOverloadedError):
+                router.generate([9], 2, timeout_s=10.0, temperature=0.0)
+            assert router.counts()["shed"] >= 1
         finally:
             router.stop()
             manager.stop_all()

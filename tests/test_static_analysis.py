@@ -1322,13 +1322,68 @@ def forward(params, x, shortcut):
         assert [f for f in lint_package(rules=["JX019"])] == []
 
 
+class TestJX020ShardingOutsideParallel:
+    def _lint(self, src, path="deeplearning4j_tpu/serving/fake.py"):
+        return lint_source(src, path, rules=["JX020"])
+
+    def test_construction_outside_parallel_fires(self):
+        src = """
+from jax.sharding import NamedSharding, PartitionSpec
+
+def place(mesh, tree):
+    return NamedSharding(mesh, PartitionSpec(None, "model"))
+"""
+        fs = self._lint(src)
+        assert rules_of(fs) == {"JX020"}
+        # Import AND both constructor calls are each a finding.
+        assert len(fs) == 3
+
+    def test_attribute_construction_fires(self):
+        src = """
+import jax
+
+def place(mesh):
+    return jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+"""
+        assert rules_of(self._lint(src)) == {"JX020"}
+
+    def test_mesh_helpers_are_clean(self):
+        # The sanctioned shape: ask parallel/mesh.py for the layout.
+        src = """
+from deeplearning4j_tpu.parallel import mesh as mesh_mod
+
+def place(mesh, tree, context):
+    reps = mesh_mod.replicated(mesh)
+    pages = mesh_mod.kv_page_sharding(mesh, 4, context.model_axis)
+    return reps, pages
+"""
+        assert self._lint(src) == []
+
+    def test_inside_parallel_is_clean(self):
+        src = """
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+def axis_sharding(mesh, ndim, dim, axis):
+    spec = [None] * ndim
+    spec[dim] = axis
+    return NamedSharding(mesh, P(*spec))
+"""
+        assert self._lint(
+            src, path="deeplearning4j_tpu/parallel/mesh.py") == []
+
+    def test_package_is_clean(self):
+        # Every spec construction in the package lives in parallel/ (the
+        # checkpoint restore-onto-mesh path asks mesh.replicated()).
+        assert [f for f in lint_package(rules=["JX020"])] == []
+
+
 class TestLinterFramework:
     def test_registry_has_all_rules(self):
         assert set(ALL_RULES) >= {"JX001", "JX002", "JX003", "JX004",
                                   "JX005", "JX006", "JX007", "JX008",
                                   "JX009", "JX010", "JX011", "JX012",
                                   "JX013", "JX014", "JX015", "JX016",
-                                  "JX017", "JX018", "JX019"}
+                                  "JX017", "JX018", "JX019", "JX020"}
 
     def test_every_rule_example_fires(self):
         """Each rule's --explain example must be a true positive for
